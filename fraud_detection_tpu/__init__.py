@@ -1,0 +1,33 @@
+"""fraud_detection_tpu — a TPU-native fraud-detection framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of the reference system
+(wtfashwin/fraud-detection): offline training (StandardScaler + SMOTE +
+LogisticRegression with data-parallel gradient allreduce over ICI), online
+batched scoring, closed-form linear-SHAP explainability, experiment tracking
+with an AUC-gated model registry, and an async-worker service shell — all
+designed TPU-first:
+
+- numerics are pure, jittable functions over pytrees with explicit PRNG keys;
+- parallelism is expressed with `jax.sharding.Mesh` + NamedSharding and XLA
+  collectives over ICI (not host-side process groups);
+- shapes are static under `jit`; dynamic quantities (resample counts, batch
+  padding) are resolved on host before tracing;
+- the service shell is backend-agnostic (``DEVICE=tpu|cpu``).
+
+Layout (mirrors SURVEY.md §7's two-tier architecture):
+
+- :mod:`fraud_detection_tpu.parallel` — mesh/topology, sharding, collectives
+- :mod:`fraud_detection_tpu.ops`      — scaler, SMOTE, logistic solvers,
+  metrics, linear SHAP, batched scorer
+- :mod:`fraud_detection_tpu.models`   — high-level model classes
+- :mod:`fraud_detection_tpu.data`     — CSV loading, splits, synthetic data
+- :mod:`fraud_detection_tpu.tracking` — experiment tracking + model registry
+- :mod:`fraud_detection_tpu.ckpt`     — checkpoints + sklearn-compatible
+  artifact import/export
+- :mod:`fraud_detection_tpu.service`  — HTTP API, task queue, XAI worker,
+  persistence, observability
+"""
+
+__version__ = "0.1.0"
+
+from fraud_detection_tpu import config as config  # noqa: F401

@@ -1,0 +1,162 @@
+"""Environment-variable configuration.
+
+The reference configures everything through environment variables (SURVEY.md
+§5 "Config/flag system"; reference files train_model.py:22,118-120,152,
+api/app.py:30, db/db.py:6, api/utils.py:11-12). This module keeps every name
+from the reference and adds the TPU-specific knobs (``DEVICE``, mesh shape).
+
+All lookups are lazy (read at call time, not import time) so tests can
+monkeypatch the environment.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def _get(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def _get_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def _get_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
+# --------------------------------------------------------------------------
+# Data / training (reference: train_model.py:22, preprocess.py:15)
+# --------------------------------------------------------------------------
+
+def data_csv() -> str:
+    return _get("DATA_CSV", "data/creditcard.csv")
+
+
+def device_backend() -> str:
+    """``tpu`` | ``cpu`` — selects the compute backend for the numerics tier."""
+    return _get("DEVICE", "tpu")
+
+
+def mesh_data_axis() -> int:
+    """Number of devices on the data axis; 0 = all available."""
+    return _get_int("MESH_DATA", 0)
+
+
+def mesh_model_axis() -> int:
+    return _get_int("MESH_MODEL", 1)
+
+
+# --------------------------------------------------------------------------
+# Tracking / registry (reference: train_model.py:118-120,152, api/app.py:30)
+# --------------------------------------------------------------------------
+
+def tracking_uri() -> str:
+    return _get("MLFLOW_TRACKING_URI", "file:./mlruns")
+
+
+def experiment_name() -> str:
+    return _get("MLFLOW_EXPERIMENT", "fraud-detection")
+
+
+def model_name() -> str:
+    return _get("MLFLOW_MODEL_NAME", "fraud")
+
+
+def auc_threshold() -> float:
+    return _get_float("MLFLOW_AUC_THRESHOLD", 0.95)
+
+
+def model_stage() -> str:
+    return _get("MLFLOW_MODEL_STAGE", "prod")
+
+
+# --------------------------------------------------------------------------
+# Serving / artifacts (reference: api/utils.py:11-12, .env)
+# --------------------------------------------------------------------------
+
+def model_path() -> str:
+    return _get("MODEL_PATH", "models/logistic_model.joblib")
+
+
+def feature_names_path() -> str:
+    return _get("FEATURE_NAMES_PATH", "models/feature_names.json")
+
+
+def scaler_path() -> str:
+    return _get("SCALER_PATH", "models/scaler.joblib")
+
+
+# --------------------------------------------------------------------------
+# Service tier (reference: xai_tasks.py:59, db/db.py:6, api/app.py:89-90)
+# --------------------------------------------------------------------------
+
+def broker_url() -> str:
+    """Task-queue broker. Native default is a SQLite-backed queue; a
+    ``redis://``/``sentinel://`` URL selects Redis when the client lib is
+    installed (reference default: sentinel://redis-master:26379/0)."""
+    return _get("CELERY_BROKER_URL", "sqlite:///taskq.db")
+
+
+def database_url() -> str:
+    """Results DB. Native default is SQLite; ``postgresql://`` URLs are used
+    when psycopg2 is installed (reference default in db/db.py:6-9)."""
+    return _get("DATABASE_URL", "sqlite:///fraud.db")
+
+
+def otel_endpoint() -> str:
+    return _get("OTEL_EXPORTER_OTLP_ENDPOINT", "")
+
+
+def otel_service_name() -> str:
+    return _get("OTEL_SERVICE_NAME", "fraud-api")
+
+
+def worker_metrics_port() -> int:
+    return _get_int("WORKER_METRICS_PORT", 8001)
+
+
+# --------------------------------------------------------------------------
+# Synthetic data (reference: scripts/generate_synthetic_data.py:32-33)
+# --------------------------------------------------------------------------
+
+def ci_synthetic_samples() -> int:
+    return _get_int("CI_SYNTHETIC_SAMPLES", 500)
+
+
+def test_synthetic_samples() -> int:
+    return _get_int("TEST_SYNTHETIC_SAMPLES", 2000)
+
+
+# --------------------------------------------------------------------------
+# Micro-batching scorer knobs (new; no reference counterpart — SURVEY §7
+# "hard parts (c)")
+# --------------------------------------------------------------------------
+
+def scorer_max_batch() -> int:
+    return _get_int("SCORER_MAX_BATCH", 1024)
+
+
+def scorer_max_wait_ms() -> float:
+    return _get_float("SCORER_MAX_WAIT_MS", 2.0)
+
+
+@dataclass
+class Settings:
+    """Snapshot of all settings, for logging/debugging."""
+
+    data_csv: str = field(default_factory=data_csv)
+    device: str = field(default_factory=device_backend)
+    tracking_uri: str = field(default_factory=tracking_uri)
+    experiment: str = field(default_factory=experiment_name)
+    model_name: str = field(default_factory=model_name)
+    auc_threshold: float = field(default_factory=auc_threshold)
+    model_stage: str = field(default_factory=model_stage)
+    model_path: str = field(default_factory=model_path)
+    feature_names_path: str = field(default_factory=feature_names_path)
+    broker_url: str = field(default_factory=broker_url)
+    database_url: str = field(default_factory=database_url)

@@ -1,0 +1,35 @@
+"""Pure-JAX numerics kernels.
+
+Replaces the reference's library-native cores (sklearn Cython, imblearn SMOTE,
+shap's C extension, XGBoost C++ — SURVEY.md §2 "Languages") with jittable,
+shardable XLA programs. Everything here is functional: pytree params in,
+pytree results out, explicit PRNG keys, static shapes.
+"""
+
+from fraud_detection_tpu.ops.scaler import (  # noqa: F401
+    ScalerParams,
+    scaler_fit,
+    scaler_fit_sharded,
+    scaler_transform,
+)
+from fraud_detection_tpu.ops.logistic import (  # noqa: F401
+    LogisticParams,
+    logistic_fit_lbfgs,
+    logistic_fit_sgd,
+    predict_logits,
+    predict_proba,
+)
+from fraud_detection_tpu.ops.metrics import (  # noqa: F401
+    auc_roc,
+    binary_classification_report,
+    confusion_matrix,
+)
+from fraud_detection_tpu.ops.linear_shap import (  # noqa: F401
+    linear_shap,
+    linear_shap_single,
+)
+from fraud_detection_tpu.ops.smote import smote  # noqa: F401
+from fraud_detection_tpu.ops.scorer import (  # noqa: F401
+    BatchScorer,
+    fold_scaler_into_linear,
+)

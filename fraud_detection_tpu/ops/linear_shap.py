@@ -1,0 +1,49 @@
+"""Closed-form interventional linear SHAP.
+
+For a linear model ``f(x) = wᵀx + b`` with an independent (interventional)
+background distribution, the exact SHAP values are ``φⱼ = wⱼ·(xⱼ − μⱼ)`` with
+base value ``E[f] = wᵀμ + b`` — the closed form that
+``shap.LinearExplainer(model, X, feature_perturbation="interventional")``
+computes (reference: explain_model.py:24-27 and api/worker.py:52-53,75).
+
+The reference's *deployed* worker shipped the wrong formula (raw ``coef·x``,
+xai_tasks.py:106-107 — SURVEY.md §2.3.3); this module implements the real one
+everywhere, vmapped so a whole batch of explanations is one device launch.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LinearShapExplainer(NamedTuple):
+    coef: jax.Array        # (d,)
+    background_mean: jax.Array  # (d,) — μ of the background set
+    expected_value: jax.Array   # () — wᵀμ + b (margin space)
+
+
+def make_explainer(coef, intercept, background_x=None, background_mean=None):
+    coef = jnp.asarray(coef).reshape(-1)
+    if background_mean is None:
+        if background_x is None:
+            background_mean = jnp.zeros_like(coef)
+        else:
+            background_mean = jnp.mean(jnp.asarray(background_x), axis=0)
+    background_mean = jnp.asarray(background_mean).reshape(-1)
+    ev = jnp.dot(coef, background_mean) + jnp.asarray(intercept).reshape(())
+    return LinearShapExplainer(coef, background_mean, ev)
+
+
+@jax.jit
+def linear_shap_single(explainer: LinearShapExplainer, x: jax.Array) -> jax.Array:
+    """SHAP values (d,) for one row; Σφ + E[f] = f(x) exactly."""
+    return explainer.coef * (x - explainer.background_mean)
+
+
+@jax.jit
+def linear_shap(explainer: LinearShapExplainer, x: jax.Array) -> jax.Array:
+    """SHAP values (n, d) for a batch — one fused elementwise kernel."""
+    return explainer.coef[None, :] * (x - explainer.background_mean[None, :])

@@ -1,0 +1,143 @@
+"""Classification metrics as XLA programs.
+
+Replaces sklearn.metrics (reference: roc_auc_score at train_model.py:82-109,
+confusion_matrix / classification_report at evaluate_model.py:30-47).
+
+AUC-ROC is computed exactly via the Mann–Whitney statistic with tie-averaged
+ranks — an O(n log n) sort, which XLA executes as a (sharded, all-to-all)
+global sort, the right shape for 10M-row datasets (SURVEY.md §7 hard part d).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _auc_weighted(scores: jax.Array, labels: jax.Array, weights: jax.Array) -> jax.Array:
+    """Weighted Mann–Whitney U: for each positive, the weight of negatives
+    strictly below it plus half the weight of tied negatives. Exact under
+    row weights (so zero-weight padding rows are truly inert), ties handled
+    like sklearn.roc_auc_score."""
+    pos = (labels > 0).astype(scores.dtype) * weights
+    neg = (1.0 - (labels > 0).astype(scores.dtype)) * weights
+    order = jnp.argsort(scores)
+    s_sorted = scores[order]
+    negw_sorted = neg[order]
+    cum_neg = jnp.concatenate(
+        [jnp.zeros((1,), scores.dtype), jnp.cumsum(negw_sorted)]
+    )
+    lo = jnp.searchsorted(s_sorted, scores, side="left")
+    hi = jnp.searchsorted(s_sorted, scores, side="right")
+    neg_below = cum_neg[lo]
+    neg_tied = cum_neg[hi] - cum_neg[lo]
+    u = jnp.sum(pos * (neg_below + 0.5 * neg_tied))
+    return u / (jnp.sum(pos) * jnp.sum(neg))
+
+
+def auc_roc(scores, labels, n_valid: int | None = None) -> jax.Array:
+    """Exact AUC-ROC (ties handled like sklearn.roc_auc_score).
+
+    ``n_valid`` masks out padded rows (they get weight 0, so padding never
+    affects the statistic even though it participates in the sort).
+    """
+    scores = jnp.asarray(scores, dtype=jnp.float32)
+    labels = jnp.asarray(labels)
+    n = scores.shape[0]
+    if n_valid is None:
+        weights = jnp.ones((n,), dtype=scores.dtype)
+    else:
+        weights = (jnp.arange(n) < n_valid).astype(scores.dtype)
+    # Host-side guard: a single-class slice would yield 0/0 → NaN that then
+    # poisons the registry gate with no diagnostic (sklearn raises too).
+    labels_np = np.asarray(labels)[: n_valid if n_valid is not None else n]
+    if (labels_np > 0).all() or (labels_np <= 0).all():
+        raise ValueError("auc_roc is undefined when only one class is present")
+    return _auc_weighted(scores, labels, weights)
+
+
+@jax.jit
+def _confusion(pred: jax.Array, labels: jax.Array, weights: jax.Array):
+    p = pred.astype(jnp.float32)
+    l = (labels > 0).astype(jnp.float32)
+    tp = jnp.sum(weights * p * l)
+    fp = jnp.sum(weights * p * (1.0 - l))
+    fn = jnp.sum(weights * (1.0 - p) * l)
+    tn = jnp.sum(weights * (1.0 - p) * (1.0 - l))
+    return jnp.array([[tn, fp], [fn, tp]])
+
+
+def confusion_matrix(labels, pred, n_valid: int | None = None) -> jax.Array:
+    """2x2 confusion matrix [[tn, fp], [fn, tp]] (sklearn layout)."""
+    pred = jnp.asarray(pred)
+    if pred.dtype != jnp.bool_:
+        pred = pred > 0
+    labels = jnp.asarray(labels)
+    n = pred.shape[0]
+    if n_valid is None:
+        weights = jnp.ones((n,), dtype=jnp.float32)
+    else:
+        weights = (jnp.arange(n) < n_valid).astype(jnp.float32)
+    return _confusion(pred, labels, weights)
+
+
+def binary_classification_report(labels, pred, n_valid: int | None = None) -> dict:
+    """Per-class precision/recall/F1/support + accuracy and averages, shaped
+    like ``sklearn.metrics.classification_report(output_dict=True)``
+    (reference consumes the printed form at evaluate_model.py:30-47)."""
+    cm = np.asarray(confusion_matrix(labels, pred, n_valid))
+    tn, fp = cm[0]
+    fn, tp = cm[1]
+
+    def prf(tp_, fp_, fn_):
+        prec = tp_ / (tp_ + fp_) if (tp_ + fp_) > 0 else 0.0
+        rec = tp_ / (tp_ + fn_) if (tp_ + fn_) > 0 else 0.0
+        f1 = 2 * prec * rec / (prec + rec) if (prec + rec) > 0 else 0.0
+        return prec, rec, f1
+
+    p1, r1, f1_1 = prf(tp, fp, fn)
+    p0, r0, f1_0 = prf(tn, fn, fp)
+    support0 = tn + fp
+    support1 = fn + tp
+    total = support0 + support1
+    acc = (tp + tn) / total if total > 0 else 0.0
+    report = {
+        "0": {"precision": float(p0), "recall": float(r0), "f1-score": float(f1_0), "support": float(support0)},
+        "1": {"precision": float(p1), "recall": float(r1), "f1-score": float(f1_1), "support": float(support1)},
+        "accuracy": float(acc),
+        "macro avg": {
+            "precision": float((p0 + p1) / 2),
+            "recall": float((r0 + r1) / 2),
+            "f1-score": float((f1_0 + f1_1) / 2),
+            "support": float(total),
+        },
+        "weighted avg": {
+            "precision": float((p0 * support0 + p1 * support1) / total) if total else 0.0,
+            "recall": float((r0 * support0 + r1 * support1) / total) if total else 0.0,
+            "f1-score": float((f1_0 * support0 + f1_1 * support1) / total) if total else 0.0,
+            "support": float(total),
+        },
+    }
+    return report
+
+
+def roc_curve_points(scores, labels, num_thresholds: int = 200):
+    """(fpr, tpr, thresholds) on an evenly spaced threshold grid — enough for
+    the ROC plot the reference renders (evaluate_model.py:48-61) without a
+    data-dependent output shape."""
+    scores = jnp.asarray(scores, dtype=jnp.float32)
+    labels = (jnp.asarray(labels) > 0).astype(jnp.float32)
+    thresholds = jnp.linspace(1.0, 0.0, num_thresholds)
+    pos = jnp.sum(labels)
+    neg = labels.shape[0] - pos
+
+    def at_threshold(t):
+        pred = (scores >= t).astype(jnp.float32)
+        tp = jnp.sum(pred * labels)
+        fp = jnp.sum(pred * (1.0 - labels))
+        return fp / neg, tp / pos
+
+    fpr, tpr = jax.vmap(at_threshold)(thresholds)
+    return fpr, tpr, thresholds

@@ -1,0 +1,72 @@
+"""StandardScaler as a sharded XLA reduction.
+
+Matches sklearn ``StandardScaler`` semantics (ddof=0 population variance,
+zero-variance columns scale by 1.0 — reference uses it at train_model.py:36-40
+and preprocess.py's scale-then-split variant). Fitting is a single pass of
+per-shard partial sums followed by an allreduce, so it scales to row counts
+that never fit one device (the 10M-row config in BASELINE.json).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fraud_detection_tpu.parallel.sharding import shard_batch
+
+
+class ScalerParams(NamedTuple):
+    mean: jax.Array   # (d,)
+    scale: jax.Array  # (d,) — std, with 0 → 1.0 like sklearn
+    var: jax.Array    # (d,)
+    n_samples: jax.Array  # () float — rows seen
+
+
+@partial(jax.jit, static_argnames=("n_valid",))
+def _fit(x: jax.Array, n_valid: int) -> ScalerParams:
+    # Two fused reductions: mean first, then E[(x-mean)²]. The one-pass
+    # E[x²]−E[x]² form catastrophically cancels in f32 for high-mean/low-std
+    # columns (e.g. the Kaggle `Time` column), silently collapsing their
+    # variance to 0 — so we pay the second (XLA-fused) pass for exactness.
+    # Padded rows are masked via the row-index weight, not assumed zero.
+    n = jnp.asarray(n_valid, dtype=x.dtype)
+    w = (jnp.arange(x.shape[0]) < n_valid).astype(x.dtype)[:, None]
+    mean = jnp.sum(w * x, axis=0) / n
+    centered = (x - mean) * w
+    var = jnp.sum(centered * centered, axis=0) / n
+    std = jnp.sqrt(var)
+    scale = jnp.where(std == 0.0, 1.0, std)
+    return ScalerParams(mean=mean, scale=scale, var=var, n_samples=n)
+
+
+def scaler_fit(x: jax.Array | np.ndarray, n_valid: int | None = None) -> ScalerParams:
+    """Fit on a (possibly padded) device array. ``n_valid`` defaults to all
+    rows."""
+    x = jnp.asarray(x)
+    if n_valid is None:
+        n_valid = x.shape[0]
+    return _fit(x, n_valid)
+
+
+def scaler_fit_sharded(x: np.ndarray, mesh=None) -> ScalerParams:
+    """Host rows → row-sharded device array → one-pass sharded fit.
+
+    The partial sums reduce over the data axis via the allreduce XLA inserts
+    for the row-sharded → replicated transition (rides ICI on a real pod).
+    """
+    arr, n_valid = shard_batch(x, mesh)
+    return _fit(arr, n_valid)
+
+
+@jax.jit
+def scaler_transform(params: ScalerParams, x: jax.Array) -> jax.Array:
+    return (x - params.mean) / params.scale
+
+
+@jax.jit
+def scaler_inverse_transform(params: ScalerParams, x: jax.Array) -> jax.Array:
+    return x * params.scale + params.mean

@@ -1,0 +1,94 @@
+"""Batched online scorer.
+
+Serving-path replacement for the reference's ``SCALER.transform`` +
+``MODEL.predict_proba`` sequence (api/app.py:194-240, predict_single.py:28-32).
+
+TPU-first design decisions (SURVEY.md §7 hard part c):
+
+- **Scaler folding.** Standardize-then-score for a linear model is itself
+  linear: ``σ((x−μ)/s·w + b) = σ(x·w′ + b′)`` with ``w′ = w/s`` and
+  ``b′ = b − μ·(w/s)``. We fold the scaler into the weights once at load
+  time, so the serving path never materializes a scaled copy of the input —
+  one GEMV + sigmoid per batch, zero preprocessing launches.
+- **Static shape buckets.** ``jit`` compiles one executable per shape; the
+  scorer pads request batches up to power-of-two buckets so a handful of
+  cached executables serve every batch size without recompilation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import ScalerParams
+
+
+def fold_scaler_into_linear(
+    params: LogisticParams, scaler: ScalerParams | None
+) -> LogisticParams:
+    """Return params ``(w′, b′)`` scoring *raw* inputs identically to scoring
+    scaled inputs with the original params."""
+    if scaler is None:
+        return params
+    w = params.coef / scaler.scale
+    b = params.intercept - jnp.dot(scaler.mean, w)
+    return LogisticParams(coef=w, intercept=b)
+
+
+@jax.jit
+def _score(coef: jax.Array, intercept: jax.Array, x: jax.Array) -> jax.Array:
+    return jax.nn.sigmoid(x @ coef + intercept)
+
+
+def _bucket(n: int, min_bucket: int = 8) -> int:
+    b = min_bucket
+    while b < n:
+        b *= 2
+    return b
+
+
+class BatchScorer:
+    """Pads to shape buckets and scores on device.
+
+    Thread-safe for concurrent callers (JAX dispatch is); the async
+    micro-batching queue in :mod:`fraud_detection_tpu.service.microbatch`
+    sits in front of this for the online path.
+    """
+
+    def __init__(
+        self,
+        params: LogisticParams,
+        scaler: ScalerParams | None = None,
+        min_bucket: int = 8,
+    ):
+        folded = fold_scaler_into_linear(params, scaler)
+        self.coef = jnp.asarray(folded.coef, dtype=jnp.float32)
+        self.intercept = jnp.asarray(folded.intercept, dtype=jnp.float32)
+        self.n_features = int(self.coef.shape[0])
+        self.min_bucket = min_bucket
+
+    def warmup(self, max_bucket: int = 4096) -> None:
+        """Pre-compile the bucket ladder so first requests don't pay XLA
+        compile latency."""
+        b = self.min_bucket
+        while b <= max_bucket:
+            self.predict_proba(np.zeros((b, self.n_features), np.float32))
+            b *= 2
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, dtype=np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        n = x.shape[0]
+        b = _bucket(n, self.min_bucket)
+        if b != n:
+            x = np.concatenate([x, np.zeros((b - n, x.shape[1]), np.float32)])
+        out = _score(self.coef, self.intercept, jnp.asarray(x))
+        return np.asarray(out)[:n]
+
+    def predict(self, x: np.ndarray, threshold: float = 0.5) -> np.ndarray:
+        return (self.predict_proba(x) >= threshold).astype(np.int64)

@@ -1,0 +1,122 @@
+"""SMOTE oversampling as an XLA program.
+
+Replaces ``imblearn.over_sampling.SMOTE`` (reference: train_model.py:65-66,91
+applies it inside each CV fold and on the full train set; preprocess.py:30).
+
+Design under XLA's static-shape regime (SURVEY.md §7 hard part a):
+
+- class counts are data-dependent, so the synthetic-sample budget
+  ``n_synthetic = n_majority − n_minority`` is computed **on host** before
+  tracing; the kernel then has a static output shape;
+- k-NN over the minority class is computed blockwise (`lax.scan` over query
+  blocks against the full minority set) so the distance matrix never
+  materializes at 100k×100k when the 10M-row synthetic config runs — memory
+  is O(block × m) per step;
+- interpolation draws a base row and one of its k neighbors per synthetic
+  sample with explicit PRNG keys (same statistical procedure as imblearn:
+  x_new = x + u·(x_nn − x), u ~ U[0,1)).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("k", "block"))
+def _knn_indices(x_min: jax.Array, k: int, block: int = 1024) -> jax.Array:
+    """Indices (m, k) of each minority row's k nearest minority neighbors
+    (self excluded), euclidean distance, blockwise over query rows."""
+    m, d = x_min.shape
+    # Center columns first: distances are translation-invariant and the
+    # |q|²−2q·x+|x|² expansion loses much less f32 precision near the origin.
+    x_min = x_min - jnp.mean(x_min, axis=0)
+    sq = jnp.sum(x_min * x_min, axis=1)  # (m,)
+    n_blocks = (m + block - 1) // block
+    pad = n_blocks * block - m
+    xq = jnp.pad(x_min, ((0, pad), (0, 0)))
+    sq_q = jnp.pad(sq, (0, pad))
+    q_ids = jnp.pad(jnp.arange(m), (0, pad), constant_values=-1)
+
+    def body(_, blk):
+        xb, sqb, idb = blk  # (block, d), (block,), (block,)
+        # dist² = |q|² − 2 q·x + |x|²  — the q·x term is an MXU matmul.
+        d2 = sqb[:, None] - 2.0 * (xb @ x_min.T) + sq[None, :]
+        # exclude self-matches
+        self_mask = idb[:, None] == jnp.arange(m)[None, :]
+        d2 = jnp.where(self_mask, jnp.inf, d2)
+        _, idx = jax.lax.top_k(-d2, k)
+        return None, idx
+
+    _, idx_blocks = jax.lax.scan(
+        body,
+        None,
+        (
+            xq.reshape(n_blocks, block, d),
+            sq_q.reshape(n_blocks, block),
+            q_ids.reshape(n_blocks, block),
+        ),
+    )
+    return idx_blocks.reshape(n_blocks * block, k)[:m]
+
+
+@partial(jax.jit, static_argnames=("n_synthetic",))
+def _interpolate(
+    x_min: jax.Array, nn_idx: jax.Array, key: jax.Array, n_synthetic: int
+) -> jax.Array:
+    m, _ = x_min.shape
+    k = nn_idx.shape[1]
+    k_base, k_nn, k_gap = jax.random.split(key, 3)
+    base = jax.random.randint(k_base, (n_synthetic,), 0, m)
+    slot = jax.random.randint(k_nn, (n_synthetic,), 0, k)
+    gap = jax.random.uniform(k_gap, (n_synthetic, 1), dtype=x_min.dtype)
+    xb = x_min[base]
+    xn = x_min[nn_idx[base, slot]]
+    return xb + gap * (xn - xb)
+
+
+def smote(
+    x,
+    y,
+    key: jax.Array,
+    k_neighbors: int = 5,
+    sampling_ratio: float = 1.0,
+    block: int = 1024,
+):
+    """Oversample the minority class to ``sampling_ratio × n_majority``.
+
+    Returns ``(x_resampled, y_resampled)`` as device arrays with the
+    synthetic rows appended (imblearn's layout). Host-side: class counts and
+    output shapes; device-side: k-NN + interpolation.
+    """
+    x_np = np.asarray(x, dtype=np.float32)
+    y_np = np.asarray(y).astype(np.int32)
+    classes, counts = np.unique(y_np, return_counts=True)
+    if len(classes) != 2:
+        raise ValueError("smote supports binary labels")
+    minority = classes[np.argmin(counts)]
+    n_min = int(counts.min())
+    n_maj = int(counts.max())
+    n_synth = int(round(sampling_ratio * n_maj)) - n_min
+    if n_synth <= 0:
+        return jnp.asarray(x_np), jnp.asarray(y_np)
+    if n_min < 2:
+        # One minority row has no neighbors to interpolate toward; emitting
+        # duplicates would silently poison training (imblearn raises here too).
+        raise ValueError(
+            f"SMOTE needs at least 2 minority samples, got {n_min}"
+        )
+    if n_min <= k_neighbors:
+        k_neighbors = n_min - 1
+
+    x_min = jnp.asarray(x_np[y_np == minority])
+    nn_idx = _knn_indices(x_min, k_neighbors, min(block, max(x_min.shape[0], 8)))
+    synth = _interpolate(x_min, nn_idx, key, n_synth)
+    x_out = jnp.concatenate([jnp.asarray(x_np), synth], axis=0)
+    y_out = jnp.concatenate(
+        [jnp.asarray(y_np), jnp.full((n_synth,), minority, dtype=jnp.int32)]
+    )
+    return x_out, y_out

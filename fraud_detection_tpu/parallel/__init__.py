@@ -1,0 +1,25 @@
+"""Mesh/topology management, sharding helpers, and collectives.
+
+The reference has no ML parallelism (SURVEY.md §2.4) — its only distributed
+axes are process-level (gunicorn workers, Celery pods, K8s replicas). This
+package is the TPU-native replacement for what the reference gets from
+library-internal threading (XGBoost ``n_jobs=-1``): data-parallel execution
+over a `jax.sharding.Mesh` with XLA collectives riding ICI, and
+``jax.distributed`` bring-up over DCN for multi-host pods.
+"""
+
+from fraud_detection_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    create_mesh,
+    default_mesh,
+    device_count,
+    initialize_distributed,
+    local_device_count,
+)
+from fraud_detection_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    host_to_device_sharded,
+    pad_to_multiple,
+    replicated,
+    shard_batch,
+)

@@ -1,0 +1,45 @@
+"""Thin wrappers over XLA collectives for use inside ``shard_map``.
+
+Most of the framework expresses parallelism declaratively (NamedSharding +
+``jit``, letting XLA insert collectives). ``shard_map`` + these wrappers are
+used where we want the collective explicit — the SGD training step's gradient
+allreduce, and tests that assert communication behavior.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from fraud_detection_tpu.parallel.mesh import DATA_AXIS, default_mesh
+
+
+def psum_data(x, axis_name: str = DATA_AXIS):
+    """Sum across the data axis (gradient allreduce over ICI)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean_data(x, axis_name: str = DATA_AXIS):
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather_data(x, axis_name: str = DATA_AXIS, axis: int = 0):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def data_parallel(fn, mesh: Mesh | None = None, out_replicated: bool = True):
+    """Wrap ``fn(x_shard, ...) -> pytree`` as a shard_map over the data axis.
+
+    Row-sharded inputs, replicated outputs (fn is expected to psum over
+    ``DATA_AXIS`` itself — check_vma verifies this at trace time).
+    """
+    mesh = mesh or default_mesh()
+    in_specs = P(DATA_AXIS)
+    out_specs = P() if out_replicated else P(DATA_AXIS)
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+    )

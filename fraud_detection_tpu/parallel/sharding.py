@@ -1,0 +1,77 @@
+"""Sharding helpers: NamedShardings, batch padding, host→device placement.
+
+XLA requires static shapes under ``jit`` and even row counts per shard; these
+helpers resolve both on host before tracing (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fraud_detection_tpu.parallel.mesh import DATA_AXIS, default_mesh
+
+
+def batch_sharding(mesh: Mesh | None = None) -> NamedSharding:
+    """Rows sharded over the data axis, features replicated."""
+    mesh = mesh or default_mesh()
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh | None = None) -> NamedSharding:
+    mesh = mesh or default_mesh()
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(
+    x: np.ndarray | jax.Array, multiple: int, axis: int = 0, value: float = 0.0
+) -> tuple[np.ndarray | jax.Array, int]:
+    """Pad ``x`` along ``axis`` so its length is a multiple of ``multiple``.
+
+    Returns ``(padded, n_valid)``. Padding value defaults to 0; callers mask
+    padded rows out of reductions with ``n_valid``.
+    """
+    n = x.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, rem)
+    if isinstance(x, np.ndarray):
+        padded = np.pad(x, widths, constant_values=value)
+    else:
+        padded = jnp.pad(x, widths, constant_values=value)
+    return padded, n
+
+
+def shard_batch(
+    x: np.ndarray, mesh: Mesh | None = None, value: float = 0.0
+) -> tuple[jax.Array, int]:
+    """Pad rows to the mesh's data-axis size and place sharded on device.
+
+    Returns ``(device_array, n_valid)``.
+    """
+    mesh = mesh or default_mesh()
+    ndev = mesh.shape[DATA_AXIS]
+    padded, n_valid = pad_to_multiple(np.asarray(x), ndev, axis=0, value=value)
+    arr = jax.device_put(padded, batch_sharding(mesh))
+    return arr, n_valid
+
+
+def host_to_device_sharded(
+    arrays: dict[str, np.ndarray], mesh: Mesh | None = None
+) -> tuple[dict[str, jax.Array], int]:
+    """Shard a dict of equal-length row arrays consistently; returns the
+    common valid row count."""
+    mesh = mesh or default_mesh()
+    n_valid = None
+    out = {}
+    for k, v in arrays.items():
+        arr, nv = shard_batch(v, mesh)
+        if n_valid is not None and nv != n_valid:
+            raise ValueError("inconsistent row counts across arrays")
+        n_valid = nv
+        out[k] = arr
+    return out, int(n_valid or 0)

@@ -1,0 +1,41 @@
+"""Test configuration: emulate an 8-device mesh on CPU.
+
+This is the JAX-idiomatic analogue of testing a multi-node system without a
+cluster (SURVEY.md §4): XLA's host platform is split into 8 virtual devices,
+so every sharding/collective path (psum allreduce, sharded scaler reduction,
+shard_map SGD) executes with real cross-device semantics.
+
+Must run before jax initializes its backend, hence env vars at import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("DEVICE", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def imbalanced_data(rng):
+    """Separable-ish imbalanced binary dataset (Kaggle-schema shaped: 30
+    features, ~2% positives)."""
+    n, d = 4000, 30
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w_true = rng.standard_normal(d).astype(np.float32)
+    logits = x @ w_true - 4.0
+    y = (rng.random(n) < 1 / (1 + np.exp(-logits))).astype(np.int32)
+    if y.sum() < 20:  # ensure enough positives
+        y[:20] = 1
+    return x, y

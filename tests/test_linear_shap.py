@@ -1,0 +1,45 @@
+"""Linear-SHAP parity: closed form must satisfy SHAP's exactness identities
+(shap lib not installed; for an interventional linear explainer the identities
+below uniquely determine the values — reference explain_model.py:24-27)."""
+
+import numpy as np
+
+from fraud_detection_tpu.ops.linear_shap import (
+    linear_shap,
+    linear_shap_single,
+    make_explainer,
+)
+
+
+def test_additivity(rng):
+    """sum(phi) + expected_value == f(x) for every row (SHAP efficiency)."""
+    d = 12
+    coef = rng.standard_normal(d).astype(np.float32)
+    intercept = np.float32(0.7)
+    bg = rng.standard_normal((200, d)).astype(np.float32)
+    ex = make_explainer(coef, intercept, background_x=bg)
+    x = rng.standard_normal((50, d)).astype(np.float32)
+    phi = np.asarray(linear_shap(ex, x))
+    f = x @ coef + intercept
+    np.testing.assert_allclose(
+        phi.sum(1) + float(ex.expected_value), f, rtol=1e-4, atol=1e-4
+    )
+
+
+def test_zero_for_background_mean(rng):
+    d = 6
+    coef = rng.standard_normal(d).astype(np.float32)
+    bg = rng.standard_normal((100, d)).astype(np.float32)
+    ex = make_explainer(coef, 0.0, background_x=bg)
+    phi = np.asarray(linear_shap_single(ex, np.asarray(bg.mean(0))))
+    np.testing.assert_allclose(phi, 0.0, atol=1e-5)
+
+
+def test_matches_manual_formula(rng):
+    d = 8
+    coef = rng.standard_normal(d).astype(np.float32)
+    mu = rng.standard_normal(d).astype(np.float32)
+    ex = make_explainer(coef, 1.0, background_mean=mu)
+    x = rng.standard_normal((10, d)).astype(np.float32)
+    phi = np.asarray(linear_shap(ex, x))
+    np.testing.assert_allclose(phi, coef * (x - mu), rtol=1e-5, atol=1e-6)

@@ -1,0 +1,64 @@
+"""Logistic-solver parity vs sklearn LogisticRegression(lbfgs, C=1.0)."""
+
+import numpy as np
+from sklearn.linear_model import LogisticRegression
+from sklearn.metrics import roc_auc_score
+
+from fraud_detection_tpu.ops.logistic import (
+    logistic_fit_lbfgs,
+    logistic_fit_sgd,
+    predict_proba,
+)
+
+
+def _sk_fit(x, y, **kw):
+    return LogisticRegression(solver="lbfgs", C=1.0, max_iter=1000, **kw).fit(x, y)
+
+
+def test_lbfgs_coef_parity(imbalanced_data):
+    x, y = imbalanced_data
+    x = (x - x.mean(0)) / x.std(0)
+    ref = _sk_fit(x, y)
+    params = logistic_fit_lbfgs(x, y, max_iter=200)
+    np.testing.assert_allclose(params.coef, ref.coef_[0], rtol=2e-2, atol=2e-3)
+    np.testing.assert_allclose(
+        params.intercept, ref.intercept_[0], rtol=2e-2, atol=2e-3
+    )
+
+
+def test_lbfgs_auc_parity(imbalanced_data):
+    x, y = imbalanced_data
+    x = (x - x.mean(0)) / x.std(0)
+    ref = _sk_fit(x, y)
+    params = logistic_fit_lbfgs(x, y, max_iter=200)
+    auc_ref = roc_auc_score(y, ref.predict_proba(x)[:, 1])
+    auc_got = roc_auc_score(y, np.asarray(predict_proba(params, x)))
+    assert abs(auc_got - auc_ref) < 1e-4
+
+
+def test_lbfgs_sharded_matches_single(imbalanced_data):
+    x, y = imbalanced_data
+    x = (x - x.mean(0)) / x.std(0)
+    p1 = logistic_fit_lbfgs(x, y, max_iter=200)
+    p2 = logistic_fit_lbfgs(x, y, max_iter=200, sharded=True)
+    np.testing.assert_allclose(p1.coef, p2.coef, rtol=5e-3, atol=5e-4)
+
+
+def test_class_weight_balanced(imbalanced_data):
+    x, y = imbalanced_data
+    x = (x - x.mean(0)) / x.std(0)
+    ref = _sk_fit(x, y, class_weight="balanced")
+    params = logistic_fit_lbfgs(x, y, class_weight="balanced", max_iter=300)
+    auc_ref = roc_auc_score(y, ref.predict_proba(x)[:, 1])
+    auc_got = roc_auc_score(y, np.asarray(predict_proba(params, x)))
+    assert abs(auc_got - auc_ref) < 1e-3
+
+
+def test_sgd_reaches_lbfgs_auc(imbalanced_data):
+    x, y = imbalanced_data
+    x = (x - x.mean(0)) / x.std(0)
+    p_lbfgs = logistic_fit_lbfgs(x, y, max_iter=200)
+    p_sgd = logistic_fit_sgd(x, y, epochs=30, batch_size=64, lr=0.5)
+    auc_l = roc_auc_score(y, np.asarray(predict_proba(p_lbfgs, x)))
+    auc_s = roc_auc_score(y, np.asarray(predict_proba(p_sgd, x)))
+    assert auc_s > auc_l - 5e-3

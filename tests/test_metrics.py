@@ -1,0 +1,71 @@
+"""Metric parity vs sklearn (reference train_model.py:108-110, evaluate_model.py)."""
+
+import numpy as np
+from sklearn.metrics import (
+    classification_report,
+    confusion_matrix as sk_confusion,
+    roc_auc_score,
+)
+
+from fraud_detection_tpu.ops.metrics import (
+    auc_roc,
+    binary_classification_report,
+    confusion_matrix,
+)
+
+
+def test_auc_exact(rng):
+    scores = rng.random(500).astype(np.float32)
+    labels = (rng.random(500) < 0.1).astype(np.int32)
+    labels[:5] = 1
+    got = float(auc_roc(scores, labels))
+    want = roc_auc_score(labels, scores)
+    assert abs(got - want) < 1e-5
+
+
+def test_auc_with_ties(rng):
+    # Quantized scores force heavy ties — exercises tie-averaged ranks.
+    scores = np.round(rng.random(1000) * 10) / 10
+    scores = scores.astype(np.float32)
+    labels = (rng.random(1000) < 0.3).astype(np.int32)
+    got = float(auc_roc(scores, labels))
+    want = roc_auc_score(labels, scores)
+    assert abs(got - want) < 1e-5
+
+
+def test_auc_padding_invariant(rng):
+    scores = rng.random(100).astype(np.float32)
+    labels = (rng.random(100) < 0.2).astype(np.int32)
+    labels[0] = 1
+    base = float(auc_roc(scores, labels))
+    padded_scores = np.concatenate([scores, np.full(28, 0.5, np.float32)])
+    padded_labels = np.concatenate([labels, np.ones(28, np.int32)])
+    got = float(auc_roc(padded_scores, padded_labels, n_valid=100))
+    assert abs(got - base) < 1e-5
+
+
+def test_auc_single_class_raises(rng):
+    import pytest
+
+    scores = rng.random(50).astype(np.float32)
+    with pytest.raises(ValueError, match="one class"):
+        auc_roc(scores, np.zeros(50, np.int32))
+
+
+def test_confusion_matrix(rng):
+    labels = (rng.random(300) < 0.3).astype(np.int32)
+    pred = (rng.random(300) < 0.4).astype(np.int32)
+    got = np.asarray(confusion_matrix(labels, pred))
+    want = sk_confusion(labels, pred)
+    np.testing.assert_array_equal(got.astype(np.int64), want)
+
+
+def test_classification_report(rng):
+    labels = (rng.random(300) < 0.3).astype(np.int32)
+    pred = (rng.random(300) < 0.4).astype(np.int32)
+    got = binary_classification_report(labels, pred)
+    want = classification_report(labels, pred, output_dict=True)
+    for cls in ("0", "1"):
+        for k in ("precision", "recall", "f1-score", "support"):
+            assert abs(got[cls][k] - want[cls][k]) < 1e-6, (cls, k)
+    assert abs(got["accuracy"] - want["accuracy"]) < 1e-6

@@ -1,0 +1,57 @@
+"""Scaler parity vs sklearn StandardScaler (reference train_model.py:36-40)."""
+
+import numpy as np
+from sklearn.preprocessing import StandardScaler
+
+from fraud_detection_tpu.ops.scaler import (
+    scaler_fit,
+    scaler_fit_sharded,
+    scaler_transform,
+)
+
+
+def test_fit_matches_sklearn(rng):
+    x = rng.standard_normal((1000, 30)).astype(np.float32) * 3 + 1.5
+    ref = StandardScaler().fit(x)
+    params = scaler_fit(x)
+    np.testing.assert_allclose(params.mean, ref.mean_, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(params.scale, ref.scale_, rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_fit_matches_unsharded(rng):
+    # 1003 rows: exercises padding (not divisible by 8 devices)
+    x = rng.standard_normal((1003, 30)).astype(np.float32) * 2 - 0.5
+    p1 = scaler_fit(x)
+    p2 = scaler_fit_sharded(x)
+    np.testing.assert_allclose(p1.mean, p2.mean, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(p1.scale, p2.scale, rtol=1e-4, atol=1e-5)
+
+
+def test_transform_matches_sklearn(rng):
+    x = rng.standard_normal((200, 30)).astype(np.float32)
+    ref = StandardScaler().fit(x)
+    params = scaler_fit(x)
+    np.testing.assert_allclose(
+        scaler_transform(params, x), ref.transform(x), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_high_mean_low_std_column(rng):
+    """f32 one-pass variance would catastrophically cancel here (mean 1e5,
+    std 5) — the two-pass fit must stay exact."""
+    x = rng.standard_normal((20000, 3)).astype(np.float32)
+    x[:, 1] = x[:, 1] * 5.0 + 1e5
+    ref = StandardScaler().fit(x)
+    params = scaler_fit(x)
+    np.testing.assert_allclose(params.scale, ref.scale_, rtol=1e-3)
+    assert abs(float(params.scale[1]) - 5.0) < 0.1
+
+
+def test_zero_variance_column(rng):
+    x = rng.standard_normal((100, 5)).astype(np.float32)
+    x[:, 2] = 7.0
+    ref = StandardScaler().fit(x)
+    params = scaler_fit(x)
+    np.testing.assert_allclose(params.scale, ref.scale_, rtol=1e-4, atol=1e-5)
+    out = scaler_transform(params, x)
+    assert np.all(np.isfinite(np.asarray(out)))

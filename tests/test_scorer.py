@@ -1,0 +1,48 @@
+"""Batch-scorer tests: scaler folding + bucket padding correctness."""
+
+import numpy as np
+from sklearn.linear_model import LogisticRegression
+from sklearn.preprocessing import StandardScaler
+
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import scaler_fit
+from fraud_detection_tpu.ops.scorer import BatchScorer, fold_scaler_into_linear
+
+
+def test_folding_matches_scale_then_score(rng, imbalanced_data):
+    x, y = imbalanced_data
+    scaler = StandardScaler().fit(x)
+    ref = LogisticRegression(max_iter=500).fit(scaler.transform(x), y)
+    params = LogisticParams(
+        coef=np.asarray(ref.coef_[0], np.float32),
+        intercept=np.asarray(ref.intercept_[0], np.float32),
+    )
+    sp = scaler_fit(x)
+    scorer = BatchScorer(params, sp)
+    got = scorer.predict_proba(x[:100])
+    want = ref.predict_proba(scaler.transform(x[:100]))[:, 1]
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_bucket_padding_invariant(rng):
+    d = 30
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32), intercept=np.float32(0.1)
+    )
+    scorer = BatchScorer(params)
+    x = rng.standard_normal((23, d)).astype(np.float32)
+    out_all = scorer.predict_proba(x)
+    assert out_all.shape == (23,)
+    for i in range(0, 23, 7):
+        row = scorer.predict_proba(x[i])
+        np.testing.assert_allclose(row[0], out_all[i], rtol=1e-5, atol=1e-6)
+
+
+def test_predict_threshold(rng):
+    d = 5
+    params = LogisticParams(
+        coef=np.zeros(d, np.float32), intercept=np.float32(10.0)
+    )
+    scorer = BatchScorer(params)
+    x = rng.standard_normal((4, d)).astype(np.float32)
+    assert scorer.predict(x).tolist() == [1, 1, 1, 1]

@@ -1,0 +1,87 @@
+"""SMOTE behavioral tests (imblearn not installed in this image; parity is
+asserted on the statistical contract: balanced counts, synthetic rows on
+minority-neighbor segments — reference behavior at train_model.py:65-66)."""
+
+import jax
+import numpy as np
+
+from fraud_detection_tpu.ops.smote import _knn_indices, smote
+
+
+def test_balances_classes(rng):
+    x = rng.standard_normal((500, 10)).astype(np.float32)
+    y = np.zeros(500, np.int32)
+    y[:40] = 1
+    xr, yr = smote(x, y, jax.random.key(0))
+    yr = np.asarray(yr)
+    assert (yr == 1).sum() == (yr == 0).sum() == 460
+    assert xr.shape == (920, 10)
+
+
+def test_original_rows_preserved(rng):
+    x = rng.standard_normal((200, 5)).astype(np.float32)
+    y = np.zeros(200, np.int32)
+    y[:30] = 1
+    xr, yr = smote(x, y, jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(xr)[:200], x)
+    np.testing.assert_array_equal(np.asarray(yr)[:200], y)
+
+
+def test_synthetic_on_segments(rng):
+    """Every synthetic row must lie on a segment between two minority rows."""
+    x = rng.standard_normal((100, 3)).astype(np.float32)
+    y = np.zeros(100, np.int32)
+    y[:10] = 1
+    x_min = x[:10]
+    xr, yr = smote(x, y, jax.random.key(2), k_neighbors=3)
+    synth = np.asarray(xr)[100:]
+    for row in synth[:25]:
+        # row = a + u(b-a): check collinearity with some minority pair
+        ok = False
+        for i in range(10):
+            for j in range(10):
+                if i == j:
+                    continue
+                a, b = x_min[i], x_min[j]
+                denom = b - a
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    u = (row - a) / denom
+                u = u[np.isfinite(u)]
+                if len(u) and np.allclose(u, u[0], atol=1e-4) and -1e-4 <= u[0] <= 1 + 1e-4:
+                    ok = True
+                    break
+            if ok:
+                break
+        assert ok, "synthetic row not on any minority segment"
+
+
+def test_knn_correct_blockwise(rng):
+    """Blockwise k-NN must match brute force (block < m path) up to f32
+    near-ties: every returned neighbor's true distance must be within 1% of
+    the true k-th smallest distance."""
+    x = rng.standard_normal((300, 8)).astype(np.float32)
+    idx = np.asarray(_knn_indices(x, 5, block=64))
+    d2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    np.fill_diagonal(d2, np.inf)
+    kth = np.sort(d2, axis=1)[:, 4]
+    got_d = np.take_along_axis(d2, idx, axis=1)
+    assert (got_d <= kth[:, None] * 1.01 + 1e-5).all()
+    # no duplicate neighbors per row
+    assert all(len(set(row)) == 5 for row in idx)
+
+
+def test_single_minority_row_raises(rng):
+    import pytest
+
+    x = rng.standard_normal((50, 4)).astype(np.float32)
+    y = np.zeros(50, np.int32)
+    y[0] = 1
+    with pytest.raises(ValueError, match="at least 2 minority"):
+        smote(x, y, jax.random.key(0))
+
+
+def test_no_synthesis_when_balanced(rng):
+    x = rng.standard_normal((100, 4)).astype(np.float32)
+    y = np.concatenate([np.zeros(50, np.int32), np.ones(50, np.int32)])
+    xr, yr = smote(x, y, jax.random.key(3))
+    assert xr.shape == (100, 4)
