@@ -1,0 +1,8 @@
+"""Checkpointing and artifact interchange."""
+
+from fraud_detection_tpu.ckpt.checkpoint import (  # noqa: F401
+    export_joblib_artifacts,
+    import_joblib_artifacts,
+    load_artifacts,
+    save_artifacts,
+)

@@ -1,0 +1,150 @@
+"""Model artifact persistence.
+
+Two formats:
+
+- **Native**: a single ``.npz`` of the flat numeric state + a JSON sidecar
+  for feature names — fast, dependency-free, the framework's source of truth
+  (TPU equivalent of the reference's joblib dumps, train_model.py:112-115).
+- **joblib interchange**: import of the reference's artifact layout
+  (``logistic_model.joblib`` — sklearn LogisticRegression with coef (1,30);
+  ``scaler.joblib`` — StandardScaler; ``columns.joblib``;
+  ``feature_names.json`` — SURVEY.md §1 L2→L6 interface) and export back to
+  it, so reference clients and the checked-in-artifact fallback behavior
+  (api/app.py:41-44) keep working against models trained here.
+
+joblib/sklearn are optional: import/export raise a clear error when absent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import ScalerParams
+
+NATIVE_FILE = "model.npz"
+FEATURES_FILE = "feature_names.json"
+
+
+def save_artifacts(
+    directory: str,
+    params: LogisticParams,
+    scaler: ScalerParams | None,
+    feature_names: list[str],
+) -> str:
+    os.makedirs(directory, exist_ok=True)
+    state = {
+        "coef": np.asarray(params.coef, np.float64),
+        "intercept": np.asarray(params.intercept, np.float64),
+    }
+    if scaler is not None:
+        state.update(
+            scaler_mean=np.asarray(scaler.mean, np.float64),
+            scaler_scale=np.asarray(scaler.scale, np.float64),
+            scaler_var=np.asarray(scaler.var, np.float64),
+            scaler_n=np.asarray(scaler.n_samples, np.float64),
+        )
+    np.savez(os.path.join(directory, NATIVE_FILE), **state)
+    with open(os.path.join(directory, FEATURES_FILE), "w") as f:
+        json.dump(list(feature_names), f)
+    return directory
+
+
+def load_artifacts(
+    directory: str,
+) -> tuple[LogisticParams, ScalerParams | None, list[str]]:
+    with np.load(os.path.join(directory, NATIVE_FILE)) as z:
+        params = LogisticParams(
+            coef=np.asarray(z["coef"], np.float32),
+            intercept=np.asarray(z["intercept"], np.float32),
+        )
+        scaler = None
+        if "scaler_mean" in z:
+            scaler = ScalerParams(
+                mean=np.asarray(z["scaler_mean"], np.float32),
+                scale=np.asarray(z["scaler_scale"], np.float32),
+                var=np.asarray(z["scaler_var"], np.float32),
+                n_samples=np.asarray(z["scaler_n"], np.float32),
+            )
+    with open(os.path.join(directory, FEATURES_FILE)) as f:
+        feature_names = json.load(f)
+    return params, scaler, feature_names
+
+
+def export_joblib_artifacts(
+    directory: str,
+    params: LogisticParams,
+    scaler: ScalerParams | None,
+    feature_names: list[str],
+    model_filename: str = "logistic_model.joblib",
+) -> None:
+    """Write the reference's artifact layout from native params (real sklearn
+    estimator objects, loadable by any sklearn client)."""
+    try:
+        import joblib
+        from sklearn.linear_model import LogisticRegression
+        from sklearn.preprocessing import StandardScaler
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "joblib/sklearn are required for joblib export; install the "
+            "'tools' extra"
+        ) from e
+
+    os.makedirs(directory, exist_ok=True)
+    model = LogisticRegression()
+    model.classes_ = np.array([0, 1])
+    model.coef_ = np.asarray(params.coef, np.float64)[None, :]
+    model.intercept_ = np.asarray([float(params.intercept)])
+    model.n_features_in_ = len(feature_names)
+    model.n_iter_ = np.array([1])
+    joblib.dump(model, os.path.join(directory, model_filename))
+
+    if scaler is not None:
+        sk = StandardScaler()
+        sk.mean_ = np.asarray(scaler.mean, np.float64)
+        sk.scale_ = np.asarray(scaler.scale, np.float64)
+        sk.var_ = np.asarray(scaler.var, np.float64)
+        sk.n_features_in_ = len(feature_names)
+        sk.n_samples_seen_ = int(np.asarray(scaler.n_samples))
+        sk.with_mean = sk.with_std = True
+        joblib.dump(sk, os.path.join(directory, "scaler.joblib"))
+
+    joblib.dump(list(feature_names), os.path.join(directory, "columns.joblib"))
+    with open(os.path.join(directory, FEATURES_FILE), "w") as f:
+        json.dump(list(feature_names), f)
+
+
+def import_joblib_artifacts(
+    model_path: str,
+    scaler_path: str | None = None,
+    feature_names_path: str | None = None,
+) -> tuple[LogisticParams, ScalerParams | None, list[str] | None]:
+    """Load reference-format joblib artifacts into native params (the
+    serving-side fallback path, api/app.py:41-48)."""
+    try:
+        import joblib
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("joblib is required to import joblib artifacts") from e
+
+    model = joblib.load(model_path)
+    params = LogisticParams(
+        coef=np.asarray(model.coef_, np.float32).reshape(-1),
+        intercept=np.asarray(model.intercept_, np.float32).reshape(()),
+    )
+    scaler = None
+    if scaler_path and os.path.exists(scaler_path):
+        sk = joblib.load(scaler_path)
+        scaler = ScalerParams(
+            mean=np.asarray(sk.mean_, np.float32),
+            scale=np.asarray(sk.scale_, np.float32),
+            var=np.asarray(sk.var_, np.float32),
+            n_samples=np.float32(getattr(sk, "n_samples_seen_", 0)),
+        )
+    feature_names = None
+    if feature_names_path and os.path.exists(feature_names_path):
+        with open(feature_names_path) as f:
+            feature_names = json.load(f)
+    return params, scaler, feature_names

@@ -1,0 +1,9 @@
+"""Data loading, splits, and synthetic generation."""
+
+from fraud_detection_tpu.data.loader import (  # noqa: F401
+    KAGGLE_FEATURES,
+    load_creditcard_csv,
+    stratified_kfold_indices,
+    stratified_split,
+)
+from fraud_detection_tpu.data.synthetic import generate_synthetic_data  # noqa: F401

@@ -1,0 +1,73 @@
+"""Dataset loading and index-level split utilities.
+
+The on-disk contract is the Kaggle credit-card schema the reference trains on
+(``Time, V1..V28, Amount, Class`` — reference train_model.py:22-29,
+preprocess.py:15-22; frozen feature order in models/feature_names.json).
+
+Split/fold index generation runs on host (tiny, data-dependent shapes); the
+heavy numerics downstream are device programs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+KAGGLE_FEATURES: list[str] = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+LABEL_COLUMN = "Class"
+
+
+def load_creditcard_csv(path: str) -> tuple[np.ndarray, np.ndarray, list[str]]:
+    """Load a Kaggle-schema CSV → (X float32 (n,30), y int32 (n,), names).
+
+    Column order follows the file header (the reference freezes whatever
+    order training saw — preprocess.py:54-57); ``Class`` is the label.
+    """
+    import pandas as pd
+
+    df = pd.read_csv(path)
+    if LABEL_COLUMN not in df.columns:
+        raise ValueError(f"{path} has no '{LABEL_COLUMN}' column")
+    feature_names = [c for c in df.columns if c != LABEL_COLUMN]
+    x = df[feature_names].to_numpy(dtype=np.float32)
+    y = df[LABEL_COLUMN].to_numpy(dtype=np.int32)
+    return x, y, feature_names
+
+
+def stratified_split(
+    y: np.ndarray, test_size: float = 0.2, seed: int = 42
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-class shuffled index split (sklearn train_test_split(stratify=y)
+    semantics — reference train_model.py:31-33). Returns (train_idx, test_idx)."""
+    rng = np.random.default_rng(seed)
+    train_parts, test_parts = [], []
+    for cls in np.unique(y):
+        idx = np.where(y == cls)[0]
+        rng.shuffle(idx)
+        n_test = int(round(len(idx) * test_size))
+        test_parts.append(idx[:n_test])
+        train_parts.append(idx[n_test:])
+    train_idx = np.concatenate(train_parts)
+    test_idx = np.concatenate(test_parts)
+    rng.shuffle(train_idx)
+    rng.shuffle(test_idx)
+    return train_idx, test_idx
+
+
+def stratified_kfold_indices(
+    y: np.ndarray, n_splits: int = 5, seed: int = 42, shuffle: bool = True
+):
+    """Yield (train_idx, val_idx) preserving class ratios per fold
+    (sklearn StratifiedKFold semantics — reference train_model.py:49-58)."""
+    rng = np.random.default_rng(seed)
+    per_class = {}
+    for cls in np.unique(y):
+        idx = np.where(y == cls)[0]
+        if shuffle:
+            rng.shuffle(idx)
+        per_class[cls] = np.array_split(idx, n_splits)
+    for fold in range(n_splits):
+        val = np.concatenate([per_class[c][fold] for c in per_class])
+        train = np.concatenate(
+            [per_class[c][f] for c in per_class for f in range(n_splits) if f != fold]
+        )
+        yield np.sort(train), np.sort(val)

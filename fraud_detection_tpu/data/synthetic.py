@@ -1,0 +1,76 @@
+"""Synthetic Kaggle-schema dataset generation.
+
+Same statistical recipe as the reference generator
+(scripts/generate_synthetic_data.py:6-27): seeded standard-normal V1..V28,
+``Time`` sorted uniform over 48h, log-normal ``Amount``, Bernoulli fraud
+labels at ``fraud_ratio`` — but device-accelerated and chunked so the
+10M-row benchmark config (BASELINE.json configs[3]) generates in seconds and
+streams to disk without materializing the whole frame.
+
+Unlike the reference (which overwrites one path for both CI and local sizes —
+its §2.2 quirk), the output path is always explicit.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from fraud_detection_tpu.data.loader import KAGGLE_FEATURES, LABEL_COLUMN
+
+
+def generate_synthetic_rows(
+    n_samples: int, fraud_ratio: float = 0.01, seed: int = 42
+) -> tuple[np.ndarray, np.ndarray]:
+    """In-memory generation → (X (n,30) float32, y (n,) int32)."""
+    rng = np.random.default_rng(seed)
+    n_features = len(KAGGLE_FEATURES)
+    x = np.empty((n_samples, n_features), dtype=np.float32)
+    x[:, 0] = np.sort(rng.uniform(0, 172800, n_samples)).astype(np.float32)  # Time, 48h
+    x[:, 1:29] = rng.standard_normal((n_samples, 28), dtype=np.float32)  # V1..V28
+    x[:, 29] = rng.lognormal(mean=3.0, sigma=1.0, size=n_samples).astype(np.float32)
+    y = (rng.random(n_samples) < fraud_ratio).astype(np.int32)
+    if y.sum() < 2:  # SMOTE/AUC need ≥2 positives
+        y[:2] = 1
+    # Give fraud rows signal (shifted V-features) so AUC gates are meaningful,
+    # like the separable set validate_auc self-generates (validate_auc.py:7-12).
+    shift = rng.standard_normal(28, dtype=np.float32) * 1.5
+    x[:, 1:29] += y[:, None] * shift[None, :]
+    return x, y
+
+
+def generate_synthetic_data(
+    output_path: str,
+    n_samples: int | None = None,
+    fraud_ratio: float = 0.01,
+    seed: int = 42,
+    chunk_rows: int = 1_000_000,
+) -> str:
+    """Write a synthetic Kaggle-schema CSV, chunked for 10M-row scale.
+
+    Env knobs honored like the reference: ``CI_SYNTHETIC_SAMPLES`` /
+    ``TEST_SYNTHETIC_SAMPLES`` (generate_synthetic_data.py:32-33).
+    """
+    if n_samples is None:
+        n_samples = int(
+            os.environ.get(
+                "CI_SYNTHETIC_SAMPLES", os.environ.get("TEST_SYNTHETIC_SAMPLES", 500)
+            )
+        )
+    os.makedirs(os.path.dirname(output_path) or ".", exist_ok=True)
+    header = ",".join(KAGGLE_FEATURES + [LABEL_COLUMN])
+    with open(output_path, "w") as f:
+        f.write(header + "\n")
+        written = 0
+        chunk_i = 0
+        while written < n_samples:
+            n = min(chunk_rows, n_samples - written)
+            x, y = generate_synthetic_rows(n, fraud_ratio, seed + chunk_i)
+            # Offset Time so chunks remain globally sorted.
+            x[:, 0] += chunk_i * 172800.0
+            block = np.concatenate([x, y[:, None].astype(np.float32)], axis=1)
+            np.savetxt(f, block, delimiter=",", fmt="%.6g")
+            written += n
+            chunk_i += 1
+    return output_path

@@ -1,0 +1,3 @@
+"""High-level model classes tying together params, scaler, and metadata."""
+
+from fraud_detection_tpu.models.logistic import FraudLogisticModel  # noqa: F401

@@ -1,0 +1,120 @@
+"""Flagship model: scaled logistic regression for fraud scoring.
+
+Bundles fitted :class:`LogisticParams` + :class:`ScalerParams` + the frozen
+feature order into one object with the estimator surface the reference's
+clients expect (``predict`` / ``predict_proba`` — predict_single.py:28-32,
+api/app.py:209-240), backed by the scaler-folded jitted scorer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fraud_detection_tpu.ckpt.checkpoint import (
+    export_joblib_artifacts,
+    import_joblib_artifacts,
+    load_artifacts,
+    save_artifacts,
+)
+from fraud_detection_tpu.ops.linear_shap import LinearShapExplainer, make_explainer
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import ScalerParams
+from fraud_detection_tpu.ops.scorer import BatchScorer
+
+
+class FraudLogisticModel:
+    def __init__(
+        self,
+        params: LogisticParams,
+        scaler: ScalerParams | None,
+        feature_names: list[str],
+    ):
+        self.params = params
+        self.scaler = scaler
+        self.feature_names = list(feature_names)
+        self._scorer = BatchScorer(params, scaler)
+
+    # -- scoring (raw, unscaled inputs) ------------------------------------
+    @property
+    def scorer(self) -> BatchScorer:
+        return self._scorer
+
+    def predict_proba(self, x: np.ndarray) -> np.ndarray:
+        """(n, 2) array [P(0), P(1)] like sklearn."""
+        p1 = self._scorer.predict_proba(x)
+        return np.stack([1.0 - p1, p1], axis=1)
+
+    def predict(self, x: np.ndarray, threshold: float = 0.5) -> np.ndarray:
+        return self._scorer.predict(x, threshold)
+
+    def score_one(self, features: dict | list) -> tuple[int, float]:
+        """Validate + order one row by feature name, return (label, P(1))."""
+        row = self.prepare_row(features)
+        p = float(self._scorer.predict_proba(row[None, :])[0])
+        return int(p >= 0.5), p
+
+    def prepare_row(self, features: dict | list) -> np.ndarray:
+        """Reorder dict input to training feature order; validate arity
+        (reference predict_single.py:22, api/app.py:185-192)."""
+        if isinstance(features, dict):
+            missing = [n for n in self.feature_names if n not in features]
+            if missing:
+                raise ValueError(f"missing features: {missing[:5]}")
+            vals = [float(features[n]) for n in self.feature_names]
+        else:
+            vals = [float(v) for v in features]
+            if len(vals) != len(self.feature_names):
+                raise ValueError(
+                    f"expected {len(self.feature_names)} features, got {len(vals)}"
+                )
+        return np.asarray(vals, dtype=np.float32)
+
+    # -- explainability ----------------------------------------------------
+    def explainer(self, background_mean=None) -> LinearShapExplainer:
+        """SHAP explainer in *scaled* space with the training-set background
+        (scaled background mean is 0 by construction when fitted with this
+        model's scaler — make_explainer's default)."""
+        return make_explainer(
+            self.params.coef, self.params.intercept, background_mean=background_mean
+        )
+
+    def raw_explainer(self) -> LinearShapExplainer:
+        """SHAP explainer taking *raw* inputs: scaler folded into the coef,
+        background mean = scaler mean (equivalent attributions)."""
+        from fraud_detection_tpu.ops.scorer import fold_scaler_into_linear
+
+        folded = fold_scaler_into_linear(self.params, self.scaler)
+        mu = (
+            np.asarray(self.scaler.mean)
+            if self.scaler is not None
+            else np.zeros_like(np.asarray(folded.coef))
+        )
+        return make_explainer(folded.coef, folded.intercept, background_mean=mu)
+
+    # -- persistence -------------------------------------------------------
+    def save(self, directory: str, joblib_too: bool = True) -> str:
+        save_artifacts(directory, self.params, self.scaler, self.feature_names)
+        if joblib_too:
+            try:
+                export_joblib_artifacts(
+                    directory, self.params, self.scaler, self.feature_names
+                )
+            except RuntimeError:
+                pass  # sklearn/joblib not installed — native format only
+        return directory
+
+    @classmethod
+    def load(cls, directory: str) -> "FraudLogisticModel":
+        params, scaler, feature_names = load_artifacts(directory)
+        return cls(params, scaler, feature_names)
+
+    @classmethod
+    def load_joblib(
+        cls, model_path: str, scaler_path: str | None, feature_names_path: str | None
+    ) -> "FraudLogisticModel":
+        params, scaler, names = import_joblib_artifacts(
+            model_path, scaler_path, feature_names_path
+        )
+        if names is None:
+            names = [f"f{i}" for i in range(params.coef.shape[0])]
+        return cls(params, scaler, names)

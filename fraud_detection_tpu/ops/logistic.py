@@ -143,16 +143,20 @@ def logistic_fit_lbfgs(
     train_model.py:52-54). With ``sharded=True`` rows are padded and sharded
     over the mesh's data axis (padded rows get weight 0).
     """
-    x_np = np.asarray(x, dtype=np.float32)
+    # Only y comes to host (tiny — needed for class counts); X stays on
+    # device when it already lives there (e.g. straight out of smote()).
     y_np = np.asarray(y)
     sw = _resolve_sample_weight(y_np, sample_weight, class_weight)
+    x_in = x.astype(jnp.float32) if isinstance(x, jax.Array) else np.asarray(
+        x, dtype=np.float32
+    )
 
     if sharded:
-        x_dev, _ = shard_batch(x_np, mesh)
+        x_dev, _ = shard_batch(x_in, mesh)
         y_dev, _ = shard_batch(y_np.astype(np.float32), mesh)
         sw_dev, _ = shard_batch(sw, mesh)  # pad weight 0 ⇒ padded rows inert
     else:
-        x_dev, y_dev, sw_dev = jnp.asarray(x_np), jnp.asarray(y_np), jnp.asarray(sw)
+        x_dev, y_dev, sw_dev = jnp.asarray(x_in), jnp.asarray(y_np), jnp.asarray(sw)
     return _fit_lbfgs(x_dev, y_dev, sw_dev, float(c), int(max_iter), float(tol))
 
 
@@ -171,19 +175,21 @@ def _sgd_epoch_fn(
     devices hold identical params throughout (synchronous DP).
 
     The per-step loss is an unbiased estimate of the 1/n-scaled sklearn
-    objective: ``(C/B_global)·Σ_batch sw·softplus + (0.5/n)·wᵀw`` (the reg
-    term is divided across devices so the psum reconstitutes it once).
+    objective: ``(C/B_valid)·Σ_batch sw·softplus + (0.5/n)·wᵀw``, where
+    B_valid is the *global count of non-padding rows in this batch* (padding
+    rows carry validity 0 — dividing by the nominal batch size instead would
+    silently shrink the data gradient by the padding fraction). The reg term
+    is divided across devices so the psum reconstitutes it once.
     """
-    batch_global = batch * n_devices
 
-    def epoch(params, velocity, x_local, y_pm_local, sw_local, perm, lr):
+    def epoch(params, velocity, x_local, y_pm_local, sw_local, valid_local, perm, lr):
         n_local = x_local.shape[0]
         n_batches = n_local // batch
 
-        def grad_fn(p, xb, yb, swb):
+        def grad_fn(p, xb, yb, swb, b_valid):
             def loss(p):
                 z = xb @ p.coef + p.intercept
-                data = jnp.sum(swb * jax.nn.softplus(-yb * z)) * (c / batch_global)
+                data = jnp.sum(swb * jax.nn.softplus(-yb * z)) * (c / b_valid)
                 reg = 0.5 * jnp.dot(p.coef, p.coef) / (n_total * n_devices)
                 return data + reg
 
@@ -195,7 +201,10 @@ def _sgd_epoch_fn(
             xb = x_local[idx]
             yb = y_pm_local[idx]
             swb = sw_local[idx]
-            g = grad_fn(p, xb, yb, swb)
+            b_valid = jnp.maximum(
+                jax.lax.psum(jnp.sum(valid_local[idx]), DATA_AXIS), 1.0
+            )
+            g = grad_fn(p, xb, yb, swb, b_valid)
             g = jax.tree.map(lambda t: jax.lax.psum(t, DATA_AXIS), g)
             v = jax.tree.map(lambda v_, g_: momentum * v_ - lr * g_, v, g)
             p = jax.tree.map(lambda p_, v_: p_ + v_, p, v)
@@ -207,6 +216,13 @@ def _sgd_epoch_fn(
         return params, velocity
 
     return epoch
+
+
+def _cap_batch_size(n: int, ndev: int, batch_size: int) -> int:
+    """Cap the minibatch at the per-device shard size so small datasets don't
+    pad up to a mostly-empty giant batch."""
+    per_dev = max((n + ndev - 1) // ndev, 1)
+    return max(min(batch_size, per_dev), 1)
 
 
 def logistic_fit_sgd(
@@ -234,18 +250,22 @@ def logistic_fit_sgd(
     y_np = np.asarray(y)
     n = x_np.shape[0]
     sw = _resolve_sample_weight(y_np, None, class_weight)
+    batch_size = _cap_batch_size(n, ndev, batch_size)
 
     # Pad rows so every device gets an equal, batch-divisible shard; padded
-    # rows carry weight 0 so they're inert in the loss.
+    # rows carry weight 0 and validity 0 so they're inert in the loss.
     mult = ndev * batch_size
     x_np, _ = pad_to_multiple(x_np, mult)
     y_np, _ = pad_to_multiple(y_np, mult)
     sw, _ = pad_to_multiple(sw, mult)
+    valid = np.zeros((x_np.shape[0],), np.float32)
+    valid[:n] = 1.0
     y_pm = np.where(y_np > 0, 1.0, -1.0).astype(np.float32)
 
     x_dev, _ = shard_batch(x_np, mesh)
     y_dev, _ = shard_batch(y_pm, mesh)
     sw_dev, _ = shard_batch(sw, mesh)
+    valid_dev, _ = shard_batch(valid, mesh)
 
     n_local = x_np.shape[0] // ndev
     epoch_fn = _sgd_epoch_fn(float(c), n, ndev, momentum, batch_size)
@@ -253,7 +273,7 @@ def logistic_fit_sgd(
     sharded_epoch = shard_map(
         epoch_fn,
         mesh=mesh,
-        in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -270,6 +290,7 @@ def logistic_fit_sgd(
         # the SGD noise floor (needed for AUC parity with the L-BFGS path).
         lr_e = jnp.float32(lr * 0.5 * (1.0 + np.cos(np.pi * e / max(epochs, 1))))
         params, velocity = sharded_epoch(
-            params, velocity, x_dev, y_dev, sw_dev, jnp.asarray(rng.permutation(n_local)), lr_e
+            params, velocity, x_dev, y_dev, sw_dev, valid_dev,
+            jnp.asarray(rng.permutation(n_local)), lr_e,
         )
     return params

@@ -47,15 +47,18 @@ def pad_to_multiple(
 
 
 def shard_batch(
-    x: np.ndarray, mesh: Mesh | None = None, value: float = 0.0
+    x: np.ndarray | jax.Array, mesh: Mesh | None = None, value: float = 0.0
 ) -> tuple[jax.Array, int]:
     """Pad rows to the mesh's data-axis size and place sharded on device.
 
-    Returns ``(device_array, n_valid)``.
+    Accepts host or device arrays; device arrays are padded and re-laid-out
+    without a host round-trip. Returns ``(device_array, n_valid)``.
     """
     mesh = mesh or default_mesh()
     ndev = mesh.shape[DATA_AXIS]
-    padded, n_valid = pad_to_multiple(np.asarray(x), ndev, axis=0, value=value)
+    if not isinstance(x, jax.Array):
+        x = np.asarray(x)
+    padded, n_valid = pad_to_multiple(x, ndev, axis=0, value=value)
     arr = jax.device_put(padded, batch_sharding(mesh))
     return arr, n_valid
 

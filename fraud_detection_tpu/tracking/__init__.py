@@ -1,0 +1,16 @@
+"""Experiment tracking and model registry.
+
+File-based, dependency-free implementation of the tracking/registry
+capabilities the reference gets from MLflow (SURVEY.md §2 L3: run logging
+with params/metrics/artifacts at train_model.py:117-150, alias-based registry
+serving ``models:/{name}@{stage}`` at api/app.py:34-44, and the AUC-gated
+registration at train_model.py:152-163).
+
+The store layout lives under the ``MLFLOW_TRACKING_URI`` path (``file:``
+URIs), so the env-var contract is unchanged. When the real mlflow package is
+installed, :func:`fraud_detection_tpu.tracking.mlflow_bridge.maybe_mirror`
+mirrors runs to it; the native store remains the source of truth.
+"""
+
+from fraud_detection_tpu.tracking.store import Run, TrackingClient  # noqa: F401
+from fraud_detection_tpu.tracking.registry import ModelRegistry  # noqa: F401

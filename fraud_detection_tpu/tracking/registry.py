@@ -1,0 +1,125 @@
+"""Model registry with versioned models and alias-based serving.
+
+Native implementation of the MLflow registry flow the reference uses:
+conditional registration when the AUC gate passes (train_model.py:152-163)
+and alias-based model resolution ``models:/{name}@{stage}`` on the serving
+side (api/app.py:30-44, default stage ``prod``).
+
+Layout: ``<root>/registry/<name>/versions/<N>/`` holding a copy of the model
+artifact directory plus ``meta.json``; ``aliases.json`` maps alias→version.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import time
+
+from fraud_detection_tpu.tracking.store import _atomic_write_json, _read_json
+
+_MODEL_URI = re.compile(r"^models:/(?P<name>[^@/]+)(@(?P<alias>[^/]+))?(/(?P<version>\d+))?$")
+
+
+class ModelRegistry:
+    def __init__(self, root: str):
+        self.root = os.path.join(root, "registry")
+        os.makedirs(self.root, exist_ok=True)
+
+    def _model_dir(self, name: str) -> str:
+        return os.path.join(self.root, name)
+
+    def _aliases_path(self, name: str) -> str:
+        return os.path.join(self._model_dir(name), "aliases.json")
+
+    # -- writes ------------------------------------------------------------
+    def register(
+        self,
+        name: str,
+        artifact_dir: str,
+        run_id: str | None = None,
+        metrics: dict | None = None,
+    ) -> int:
+        """Copy ``artifact_dir`` in as the next version; returns the version
+        number (MLflow register_model equivalent)."""
+        versions_dir = os.path.join(self._model_dir(name), "versions")
+        os.makedirs(versions_dir, exist_ok=True)
+        existing = [int(v) for v in os.listdir(versions_dir) if v.isdigit()]
+        version = max(existing, default=0) + 1
+        dest = os.path.join(versions_dir, str(version))
+        shutil.copytree(artifact_dir, dest)
+        _atomic_write_json(
+            os.path.join(dest, "meta.json"),
+            {
+                "name": name,
+                "version": version,
+                "run_id": run_id,
+                "metrics": metrics or {},
+                "created_at": time.time(),
+            },
+        )
+        return version
+
+    def set_alias(self, name: str, alias: str, version: int) -> None:
+        path = self._aliases_path(name)
+        aliases = _read_json(path, {})
+        aliases[alias] = int(version)
+        _atomic_write_json(path, aliases)
+
+    # -- reads -------------------------------------------------------------
+    def get_version_by_alias(self, name: str, alias: str) -> int | None:
+        v = _read_json(self._aliases_path(name), {}).get(alias)
+        return int(v) if v is not None else None
+
+    def latest_version(self, name: str) -> int | None:
+        versions_dir = os.path.join(self._model_dir(name), "versions")
+        try:
+            versions = [int(v) for v in os.listdir(versions_dir) if v.isdigit()]
+        except FileNotFoundError:
+            return None
+        return max(versions, default=None)
+
+    def artifact_dir(self, name: str, version: int) -> str:
+        return os.path.join(self._model_dir(name), "versions", str(version))
+
+    def resolve(self, model_uri: str) -> str:
+        """``models:/name@alias`` | ``models:/name/3`` | ``models:/name``
+        (latest) → artifact directory path. Raises FileNotFoundError when the
+        model/alias doesn't exist (callers implement the serving fallback,
+        api/app.py:41-44)."""
+        m = _MODEL_URI.match(model_uri)
+        if not m:
+            raise ValueError(f"not a models:/ URI: {model_uri}")
+        name = m.group("name")
+        if m.group("version"):
+            version: int | None = int(m.group("version"))
+        elif m.group("alias"):
+            version = self.get_version_by_alias(name, m.group("alias"))
+        else:
+            version = self.latest_version(name)
+        if version is None:
+            raise FileNotFoundError(f"no registered version for {model_uri}")
+        d = self.artifact_dir(name, version)
+        if not os.path.isdir(d):
+            raise FileNotFoundError(f"registry artifact missing: {d}")
+        return d
+
+    def register_if_gate(
+        self,
+        name: str,
+        artifact_dir: str,
+        auc: float,
+        threshold: float,
+        alias: str | None = None,
+        run_id: str | None = None,
+    ) -> int | None:
+        """The AUC promotion gate (train_model.py:152-163): register + alias
+        only when ``auc >= threshold``; returns the version or None. Written
+        so a NaN AUC (diverged training, poisoned eval) fails the gate
+        instead of sailing through a ``<`` comparison."""
+        if not (auc >= threshold):
+            return None
+        version = self.register(name, artifact_dir, run_id, {"auc": auc})
+        if alias:
+            self.set_alias(name, alias, version)
+        return version

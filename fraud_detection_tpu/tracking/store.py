@@ -1,0 +1,183 @@
+"""File-based experiment tracking store.
+
+Layout (rooted at the ``file:`` tracking URI):
+
+```
+<root>/
+  experiments/<experiment>/runs/<run_id>/
+    meta.json      {run_id, experiment, start_time, end_time, status}
+    params.json    {name: str}
+    metrics.json   {name: [{value, step, timestamp}, ...]}
+    tags.json      {name: str}
+    artifacts/     free-form files (model dirs, plots, ...)
+  registry/        (see registry.py)
+```
+
+Writes are atomic (tmp + rename) so concurrent runs/readers never observe a
+torn file. The native analogue of the MLflow calls at train_model.py:124-148.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any
+
+
+def _atomic_write_json(path: str, obj: Any) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:6]}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, default=str)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str, default: Any) -> Any:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return default
+
+
+def parse_file_uri(uri: str) -> str:
+    if uri.startswith("file://"):
+        return uri[len("file://") :]
+    if uri.startswith("file:"):
+        return uri[len("file:") :]
+    return uri
+
+
+class Run:
+    """An active (or reopened) tracking run."""
+
+    def __init__(self, root: str, experiment: str, run_id: str | None = None):
+        self.experiment = experiment
+        self.run_id = run_id or uuid.uuid4().hex
+        self.path = os.path.join(root, "experiments", experiment, "runs", self.run_id)
+        os.makedirs(os.path.join(self.path, "artifacts"), exist_ok=True)
+        meta_path = os.path.join(self.path, "meta.json")
+        if not os.path.exists(meta_path):
+            _atomic_write_json(
+                meta_path,
+                {
+                    "run_id": self.run_id,
+                    "experiment": experiment,
+                    "start_time": time.time(),
+                    "end_time": None,
+                    "status": "RUNNING",
+                },
+            )
+
+    # -- logging -----------------------------------------------------------
+    def log_param(self, key: str, value) -> None:
+        p = os.path.join(self.path, "params.json")
+        params = _read_json(p, {})
+        params[key] = str(value)
+        _atomic_write_json(p, params)
+
+    def log_params(self, params: dict) -> None:
+        p = os.path.join(self.path, "params.json")
+        cur = _read_json(p, {})
+        cur.update({k: str(v) for k, v in params.items()})
+        _atomic_write_json(p, cur)
+
+    def log_metric(self, key: str, value: float, step: int | None = None) -> None:
+        p = os.path.join(self.path, "metrics.json")
+        metrics = _read_json(p, {})
+        metrics.setdefault(key, []).append(
+            {"value": float(value), "step": step, "timestamp": time.time()}
+        )
+        _atomic_write_json(p, metrics)
+
+    def log_metrics(self, metrics: dict, step: int | None = None) -> None:
+        for k, v in metrics.items():
+            self.log_metric(k, v, step)
+
+    def set_tag(self, key: str, value) -> None:
+        p = os.path.join(self.path, "tags.json")
+        tags = _read_json(p, {})
+        tags[key] = str(value)
+        _atomic_write_json(p, tags)
+
+    # -- artifacts ---------------------------------------------------------
+    @property
+    def artifacts_dir(self) -> str:
+        return os.path.join(self.path, "artifacts")
+
+    def artifact_path(self, *parts: str) -> str:
+        p = os.path.join(self.artifacts_dir, *parts)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        return p
+
+    def log_artifact(self, local_path: str, artifact_subdir: str = "") -> str:
+        import shutil
+
+        dest_dir = os.path.join(self.artifacts_dir, artifact_subdir)
+        os.makedirs(dest_dir, exist_ok=True)
+        dest = os.path.join(dest_dir, os.path.basename(local_path))
+        shutil.copy2(local_path, dest)
+        return dest
+
+    # -- lifecycle ---------------------------------------------------------
+    def end(self, status: str = "FINISHED") -> None:
+        p = os.path.join(self.path, "meta.json")
+        meta = _read_json(p, {})
+        meta.update(end_time=time.time(), status=status)
+        _atomic_write_json(p, meta)
+
+    # -- reads -------------------------------------------------------------
+    @property
+    def params(self) -> dict:
+        return _read_json(os.path.join(self.path, "params.json"), {})
+
+    @property
+    def metrics(self) -> dict:
+        return _read_json(os.path.join(self.path, "metrics.json"), {})
+
+    @property
+    def tags(self) -> dict:
+        return _read_json(os.path.join(self.path, "tags.json"), {})
+
+    def latest_metric(self, key: str) -> float | None:
+        hist = self.metrics.get(key)
+        return hist[-1]["value"] if hist else None
+
+    def __enter__(self) -> "Run":
+        return self
+
+    def __exit__(self, exc_type, *_):
+        self.end("FAILED" if exc_type else "FINISHED")
+        return False
+
+
+class TrackingClient:
+    """Entry point: experiments, runs, and the registry handle."""
+
+    def __init__(self, uri: str | None = None):
+        from fraud_detection_tpu import config
+
+        self.root = parse_file_uri(uri or config.tracking_uri())
+        os.makedirs(self.root, exist_ok=True)
+
+    def start_run(self, experiment: str | None = None) -> Run:
+        from fraud_detection_tpu import config
+
+        return Run(self.root, experiment or config.experiment_name())
+
+    def get_run(self, experiment: str, run_id: str) -> Run:
+        return Run(self.root, experiment, run_id)
+
+    def list_runs(self, experiment: str) -> list[str]:
+        d = os.path.join(self.root, "experiments", experiment, "runs")
+        try:
+            return sorted(os.listdir(d))
+        except FileNotFoundError:
+            return []
+
+    @property
+    def registry(self):
+        from fraud_detection_tpu.tracking.registry import ModelRegistry
+
+        return ModelRegistry(self.root)

@@ -1,0 +1,87 @@
+"""Artifact round-trip tests: native format + joblib interchange with the
+reference's layout (SURVEY.md §1 L2→L6 interface)."""
+
+import numpy as np
+
+from fraud_detection_tpu.ckpt.checkpoint import (
+    export_joblib_artifacts,
+    import_joblib_artifacts,
+    load_artifacts,
+    save_artifacts,
+)
+from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import scaler_fit
+
+
+def _fixture(rng):
+    d = 30
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32), intercept=np.float32(-2.5)
+    )
+    x = rng.standard_normal((500, d)).astype(np.float32) * 2 + 1
+    scaler = scaler_fit(x)
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    return params, scaler, names
+
+
+def test_native_roundtrip(tmp_path, rng):
+    params, scaler, names = _fixture(rng)
+    d = str(tmp_path / "m")
+    save_artifacts(d, params, scaler, names)
+    p2, s2, n2 = load_artifacts(d)
+    np.testing.assert_allclose(p2.coef, params.coef, rtol=1e-6)
+    np.testing.assert_allclose(s2.mean, scaler.mean, rtol=1e-6)
+    assert n2 == names
+
+
+def test_joblib_export_loads_in_sklearn(tmp_path, rng):
+    import joblib
+
+    params, scaler, names = _fixture(rng)
+    d = str(tmp_path / "m")
+    export_joblib_artifacts(d, params, scaler, names)
+    model = joblib.load(f"{d}/logistic_model.joblib")
+    sk_scaler = joblib.load(f"{d}/scaler.joblib")
+    x = rng.standard_normal((20, 30)).astype(np.float64)
+    # sklearn predicts through its own C path on the exported estimator
+    probs = model.predict_proba(sk_scaler.transform(x))[:, 1]
+    native = FraudLogisticModel(params, scaler, names)
+    np.testing.assert_allclose(
+        probs, native.predict_proba(x.astype(np.float32))[:, 1], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_joblib_import_of_reference_style_artifacts(tmp_path, rng):
+    """Export → import must round-trip (the import path is what serving uses
+    for reference-format checked-in artifacts, api/app.py:41-48)."""
+    params, scaler, names = _fixture(rng)
+    d = str(tmp_path / "m")
+    export_joblib_artifacts(d, params, scaler, names)
+    p2, s2, n2 = import_joblib_artifacts(
+        f"{d}/logistic_model.joblib", f"{d}/scaler.joblib", f"{d}/feature_names.json"
+    )
+    np.testing.assert_allclose(p2.coef, params.coef, rtol=1e-6)
+    np.testing.assert_allclose(s2.scale, scaler.scale, rtol=1e-6)
+    assert n2 == names
+
+
+def test_model_score_one_dict_reorders(rng):
+    params, scaler, names = _fixture(rng)
+    m = FraudLogisticModel(params, scaler, names)
+    row = {n: float(i) for i, n in enumerate(names)}
+    label, p = m.score_one(row)
+    # same row as list in training order
+    label2, p2 = m.score_one([float(i) for i in range(30)])
+    assert (label, round(p, 6)) == (label2, round(p2, 6))
+
+
+def test_model_score_one_validates_arity(rng):
+    import pytest
+
+    params, scaler, names = _fixture(rng)
+    m = FraudLogisticModel(params, scaler, names)
+    with pytest.raises(ValueError, match="expected 30"):
+        m.score_one([1.0, 2.0])
+    with pytest.raises(ValueError, match="missing"):
+        m.score_one({"Time": 1.0})

@@ -1,0 +1,82 @@
+"""Tracking store + registry tests (MLflow-equivalent subsystem)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.ckpt.checkpoint import save_artifacts
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.tracking import TrackingClient
+
+
+def _client(tmp_path):
+    return TrackingClient(f"file:{tmp_path}/mlruns")
+
+
+def _artifact_dir(tmp_path, coef_val=1.0):
+    d = str(tmp_path / f"art_{coef_val}")
+    params = LogisticParams(
+        coef=np.full(4, coef_val, np.float32), intercept=np.float32(0)
+    )
+    save_artifacts(d, params, None, ["a", "b", "c", "d"])
+    return d
+
+
+def test_run_logging(tmp_path):
+    client = _client(tmp_path)
+    with client.start_run("exp1") as run:
+        run.log_param("solver", "lbfgs")
+        run.log_metric("auc", 0.97)
+        run.log_metric("auc", 0.98)
+        run.set_tag("k", "v")
+    reread = client.get_run("exp1", run.run_id)
+    assert reread.params["solver"] == "lbfgs"
+    assert reread.latest_metric("auc") == 0.98
+    assert len(reread.metrics["auc"]) == 2
+    assert reread.tags["k"] == "v"
+    assert client.list_runs("exp1") == [run.run_id]
+
+
+def test_run_failure_status(tmp_path):
+    client = _client(tmp_path)
+    with pytest.raises(RuntimeError):
+        with client.start_run("exp1") as run:
+            raise RuntimeError("boom")
+    import json
+
+    with open(os.path.join(run.path, "meta.json")) as f:
+        assert json.load(f)["status"] == "FAILED"
+
+
+def test_registry_versions_and_aliases(tmp_path):
+    client = _client(tmp_path)
+    reg = client.registry
+    v1 = reg.register("fraud", _artifact_dir(tmp_path, 1.0))
+    v2 = reg.register("fraud", _artifact_dir(tmp_path, 2.0))
+    assert (v1, v2) == (1, 2)
+    reg.set_alias("fraud", "prod", v1)
+    assert reg.resolve("models:/fraud@prod").endswith("versions/1")
+    assert reg.resolve("models:/fraud").endswith("versions/2")  # latest
+    assert reg.resolve("models:/fraud/1").endswith("versions/1")
+    reg.set_alias("fraud", "prod", v2)
+    assert reg.resolve("models:/fraud@prod").endswith("versions/2")
+
+
+def test_registry_gate(tmp_path):
+    client = _client(tmp_path)
+    reg = client.registry
+    art = _artifact_dir(tmp_path)
+    assert reg.register_if_gate("fraud", art, auc=0.90, threshold=0.95) is None
+    assert reg.latest_version("fraud") is None
+    v = reg.register_if_gate("fraud", art, auc=0.97, threshold=0.95, alias="prod")
+    assert v == 1
+    assert reg.get_version_by_alias("fraud", "prod") == 1
+
+
+def test_resolve_missing_raises(tmp_path):
+    client = _client(tmp_path)
+    with pytest.raises(FileNotFoundError):
+        client.registry.resolve("models:/nope@prod")
+    with pytest.raises(ValueError):
+        client.registry.resolve("runs:/whatever")

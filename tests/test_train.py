@@ -1,0 +1,47 @@
+"""End-to-end training pipeline test on synthetic data (the CI-style flow:
+ci-cd.yml:54-84 — generate synthetic, train, gate)."""
+
+import os
+
+import numpy as np
+
+from fraud_detection_tpu.data.synthetic import generate_synthetic_data
+from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.tracking import TrackingClient
+from fraud_detection_tpu.train import train
+
+
+def test_train_end_to_end(tmp_path, monkeypatch):
+    csv = str(tmp_path / "synth.csv")
+    generate_synthetic_data(csv, n_samples=3000, fraud_ratio=0.03, seed=0)
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    monkeypatch.setenv("MLFLOW_AUC_THRESHOLD", "0.70")
+    out = str(tmp_path / "models")
+    metrics = train(data_csv=csv, n_folds=3, out_dir=out)
+
+    assert metrics["test_auc"] > 0.85  # synthetic fraud signal is separable
+    assert metrics["cv_auc_mean"] > 0.85
+    assert metrics["registered_version"] == 1
+
+    # artifacts exist and reload
+    model = FraudLogisticModel.load(out)
+    assert len(model.feature_names) == 30
+    assert os.path.exists(os.path.join(out, "logistic_model.joblib"))
+
+    # registry serves the alias
+    client = TrackingClient(f"file:{tmp_path}/mlruns")
+    art = client.registry.resolve("models:/fraud@prod")
+    served = FraudLogisticModel.load(art)
+    x = np.zeros((2, 30), np.float32)
+    np.testing.assert_allclose(
+        served.predict_proba(x), model.predict_proba(x), rtol=1e-5
+    )
+
+
+def test_train_below_gate_not_registered(tmp_path, monkeypatch):
+    csv = str(tmp_path / "synth.csv")
+    generate_synthetic_data(csv, n_samples=2000, fraud_ratio=0.05, seed=1)
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    monkeypatch.setenv("MLFLOW_AUC_THRESHOLD", "1.01")  # unreachable: AUC ≤ 1
+    metrics = train(data_csv=csv, n_folds=2, out_dir=str(tmp_path / "m"))
+    assert metrics["registered_version"] is None
