@@ -135,7 +135,11 @@ def import_joblib_artifacts(
         intercept=np.asarray(model.intercept_, np.float32).reshape(()),
     )
     scaler = None
-    if scaler_path and os.path.exists(scaler_path):
+    if scaler_path:
+        if not os.path.exists(scaler_path):
+            # Scoring raw inputs with coefficients trained on scaled data
+            # yields silently wrong probabilities — fail loudly instead.
+            raise FileNotFoundError(f"scaler artifact not found: {scaler_path}")
         sk = joblib.load(scaler_path)
         scaler = ScalerParams(
             mean=np.asarray(sk.mean_, np.float32),

@@ -20,8 +20,19 @@ import numpy as np
 from fraud_detection_tpu.data.loader import KAGGLE_FEATURES, LABEL_COLUMN
 
 
+def fraud_shift(seed: int) -> np.ndarray:
+    """The direction fraud rows are shifted along in V-space. Derived from
+    the *base* seed only, so chunked generation keeps one consistent signal
+    direction (a per-chunk direction would destroy linear separability on
+    multi-chunk datasets)."""
+    return np.random.default_rng(seed).standard_normal(28).astype(np.float32) * 1.5
+
+
 def generate_synthetic_rows(
-    n_samples: int, fraud_ratio: float = 0.01, seed: int = 42
+    n_samples: int,
+    fraud_ratio: float = 0.01,
+    seed: int = 42,
+    shift: np.ndarray | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """In-memory generation → (X (n,30) float32, y (n,) int32)."""
     rng = np.random.default_rng(seed)
@@ -35,7 +46,8 @@ def generate_synthetic_rows(
         y[:2] = 1
     # Give fraud rows signal (shifted V-features) so AUC gates are meaningful,
     # like the separable set validate_auc self-generates (validate_auc.py:7-12).
-    shift = rng.standard_normal(28, dtype=np.float32) * 1.5
+    if shift is None:
+        shift = fraud_shift(seed)
     x[:, 1:29] += y[:, None] * shift[None, :]
     return x, y
 
@@ -64,9 +76,10 @@ def generate_synthetic_data(
         f.write(header + "\n")
         written = 0
         chunk_i = 0
+        shift = fraud_shift(seed)
         while written < n_samples:
             n = min(chunk_rows, n_samples - written)
-            x, y = generate_synthetic_rows(n, fraud_ratio, seed + chunk_i)
+            x, y = generate_synthetic_rows(n, fraud_ratio, seed + chunk_i, shift)
             # Offset Time so chunks remain globally sorted.
             x[:, 0] += chunk_i * 172800.0
             block = np.concatenate([x, y[:, None].astype(np.float32)], axis=1)

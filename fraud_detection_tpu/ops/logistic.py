@@ -246,28 +246,33 @@ def logistic_fit_sgd(
     """
     mesh = mesh or default_mesh()
     ndev = mesh.shape[DATA_AXIS]
-    x_np = np.asarray(x, dtype=np.float32)
+    # X stays on device when it already lives there (SGD is the >2M-row
+    # solver — a host round-trip of the SMOTE'd matrix is the expensive
+    # mistake); y comes to host (small) for class counts.
+    x_in = x.astype(jnp.float32) if isinstance(x, jax.Array) else np.asarray(
+        x, dtype=np.float32
+    )
     y_np = np.asarray(y)
-    n = x_np.shape[0]
+    n = x_in.shape[0]
     sw = _resolve_sample_weight(y_np, None, class_weight)
     batch_size = _cap_batch_size(n, ndev, batch_size)
 
     # Pad rows so every device gets an equal, batch-divisible shard; padded
     # rows carry weight 0 and validity 0 so they're inert in the loss.
     mult = ndev * batch_size
-    x_np, _ = pad_to_multiple(x_np, mult)
+    x_pad, _ = pad_to_multiple(x_in, mult)
     y_np, _ = pad_to_multiple(y_np, mult)
     sw, _ = pad_to_multiple(sw, mult)
-    valid = np.zeros((x_np.shape[0],), np.float32)
+    valid = np.zeros((x_pad.shape[0],), np.float32)
     valid[:n] = 1.0
     y_pm = np.where(y_np > 0, 1.0, -1.0).astype(np.float32)
 
-    x_dev, _ = shard_batch(x_np, mesh)
+    x_dev, _ = shard_batch(x_pad, mesh)
     y_dev, _ = shard_batch(y_pm, mesh)
     sw_dev, _ = shard_batch(sw, mesh)
     valid_dev, _ = shard_batch(valid, mesh)
 
-    n_local = x_np.shape[0] // ndev
+    n_local = x_pad.shape[0] // ndev
     epoch_fn = _sgd_epoch_fn(float(c), n, ndev, momentum, batch_size)
 
     sharded_epoch = shard_map(
@@ -279,7 +284,7 @@ def logistic_fit_sgd(
     )
     sharded_epoch = jax.jit(sharded_epoch)
 
-    d = x_np.shape[1]
+    d = x_pad.shape[1]
     params = LogisticParams(coef=jnp.zeros((d,), jnp.float32), intercept=jnp.zeros(()))
     velocity = LogisticParams(
         coef=jnp.zeros((d,), jnp.float32), intercept=jnp.zeros(())

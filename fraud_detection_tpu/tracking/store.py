@@ -52,10 +52,20 @@ def parse_file_uri(uri: str) -> str:
 class Run:
     """An active (or reopened) tracking run."""
 
-    def __init__(self, root: str, experiment: str, run_id: str | None = None):
+    def __init__(
+        self,
+        root: str,
+        experiment: str,
+        run_id: str | None = None,
+        create: bool = True,
+    ):
         self.experiment = experiment
         self.run_id = run_id or uuid.uuid4().hex
         self.path = os.path.join(root, "experiments", experiment, "runs", self.run_id)
+        if not create and not os.path.isdir(self.path):
+            raise FileNotFoundError(
+                f"run {self.run_id} not found in experiment {experiment}"
+            )
         os.makedirs(os.path.join(self.path, "artifacts"), exist_ok=True)
         meta_path = os.path.join(self.path, "meta.json")
         if not os.path.exists(meta_path):
@@ -167,7 +177,9 @@ class TrackingClient:
         return Run(self.root, experiment or config.experiment_name())
 
     def get_run(self, experiment: str, run_id: str) -> Run:
-        return Run(self.root, experiment, run_id)
+        """Reopen an existing run; raises FileNotFoundError on unknown ids
+        (a read API must not fabricate store entries)."""
+        return Run(self.root, experiment, run_id, create=False)
 
     def list_runs(self, experiment: str) -> list[str]:
         d = os.path.join(self.root, "experiments", experiment, "runs")

@@ -110,14 +110,22 @@ def train(
             stratified_kfold_indices(y_train, n_folds, seed)
         ):
             x_tr, y_tr = xs_train[tr], y_train[tr]
-            if use_smote:
-                x_tr, y_tr = smote(x_tr, y_tr, jax.random.key(seed + fold))
-            params = _fit(
-                x_tr, y_tr,
-                seed=seed + fold, solver=solver, class_weight=class_weight,
-            )
-            val_scores = np.asarray(predict_proba(params, xs_train[va]))
-            fold_auc = float(auc_roc(val_scores, y_train[va]))
+            try:
+                if use_smote:
+                    x_tr, y_tr = smote(x_tr, y_tr, jax.random.key(seed + fold))
+                params = _fit(
+                    x_tr, y_tr,
+                    seed=seed + fold, solver=solver, class_weight=class_weight,
+                )
+                val_scores = np.asarray(predict_proba(params, xs_train[va]))
+                fold_auc = float(auc_roc(val_scores, y_train[va]))
+            except ValueError as e:
+                # Degenerate fold (too few positives for SMOTE neighbors or a
+                # single-class validation slice): report and move on rather
+                # than failing the whole run.
+                log.warning("fold %d skipped: %s", fold, e)
+                run.set_tag(f"fold_{fold}_skipped", str(e))
+                continue
             cv_aucs.append(fold_auc)
             run.log_metric("cv_auc", fold_auc, step=fold)
             log.info("fold %d AUC %.4f", fold, fold_auc)

@@ -10,13 +10,23 @@ Must run before jax initializes its backend, hence env vars at import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("DEVICE", "cpu")
+
+import jax  # noqa: E402
+
+# Site plugins (e.g. a PJRT plugin registered in sitecustomize) may have
+# force-updated jax_platforms already — the env var alone is not enough.
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.device_count()} "
+    f"({jax.default_backend()}) — XLA_FLAGS must be set before backend init"
+)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -60,3 +60,16 @@ def test_synthetic_chunked(tmp_path):
     x, y, _ = load_creditcard_csv(path)
     assert x.shape == (2500, 30)
     assert np.all(np.diff(x[:, 0]) >= 0)  # chunk Time offsets keep order
+
+
+def test_synthetic_chunked_keeps_one_signal_direction(tmp_path):
+    """Chunked generation must shift fraud rows along ONE direction, or
+    multi-chunk datasets lose linear separability (10M benchmark config)."""
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.metrics import roc_auc_score
+
+    path = str(tmp_path / "chunks.csv")
+    generate_synthetic_data(path, n_samples=6000, chunk_rows=1000, fraud_ratio=0.05, seed=9)
+    x, y, _ = load_creditcard_csv(path)
+    m = LogisticRegression(max_iter=300).fit(x[:, 1:29], y)
+    assert roc_auc_score(y, m.predict_proba(x[:, 1:29])[:, 1]) > 0.95

@@ -49,6 +49,12 @@ def test_run_failure_status(tmp_path):
         assert json.load(f)["status"] == "FAILED"
 
 
+def test_get_run_unknown_id_raises(tmp_path):
+    client = _client(tmp_path)
+    with pytest.raises(FileNotFoundError):
+        client.get_run("exp1", "no-such-run")
+
+
 def test_registry_versions_and_aliases(tmp_path):
     client = _client(tmp_path)
     reg = client.registry
