@@ -1,0 +1,22 @@
+"""Service tier: HTTP API, async XAI worker, task queue, persistence,
+observability.
+
+Behavioral rebuild of the reference's service shell (SURVEY.md §1 layers
+L4-L7). The reference uses FastAPI + Celery/Redis + SQLAlchemy/Postgres +
+MLflow; none of those are hard dependencies here — the framework ships
+native, stdlib-based implementations with the same semantics:
+
+- :mod:`.http`       — asyncio HTTP framework + in-process TestClient
+  (replaces FastAPI/uvicorn/gunicorn)
+- :mod:`.app`        — the scoring API (same endpoints/middleware/metric
+  names as api/app.py)
+- :mod:`.microbatch` — async micro-batching in front of the jitted scorer
+- :mod:`.taskq`      — SQLite-backed task queue with Celery's delivery
+  semantics (acks_late, visibility timeout, retry backoff)
+- :mod:`.worker`     — the XAI worker (replaces xai_tasks.py/api/worker.py,
+  unified: ONE results table that /explain reads — fixes SURVEY §2.3.2)
+- :mod:`.db`         — persistence layer + migrations (replaces
+  SQLAlchemy/alembic; sqlite default, DATABASE_URL-selectable)
+- :mod:`.metrics`    — Prometheus metrics with the reference's names
+- :mod:`.tracing`    — OTEL tracing, gated on availability
+"""

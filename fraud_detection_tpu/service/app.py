@@ -1,0 +1,230 @@
+"""The fraud-scoring API.
+
+Endpoint-for-endpoint rebuild of the reference's FastAPI app (api/app.py):
+
+- ``GET /status``  — liveness (api/app.py:130-133)
+- ``GET /health``  — readiness with per-dependency status, 503 when degraded
+  (api/app.py:135-175)
+- ``POST /predict`` — validate → score (micro-batched jitted scorer) →
+  enqueue async SHAP task → respond with prediction/score/correlation id
+  (api/app.py:178-260)
+- ``GET /explain/{transaction_id}`` — explanation readback, 404 while
+  pending (api/app.py:262-278); reads the SAME table the worker writes
+  (fixing the reference's two-table split-brain, SURVEY.md §2.3.2)
+- ``GET /metrics`` — Prometheus exposition (api/app.py:281)
+
+Middleware: per-request correlation ID propagated to the response header,
+logs, and the task args (api/app.py:121-128, 244-245).
+
+Differences from the reference, by design:
+- the scorer is the scaler-folded jitted XLA program behind an async
+  micro-batcher — no per-request sklearn call, no string-parsing of model
+  outputs (the §2.3.5 quirk);
+- the Celery send_task becomes Broker.send_task with identical failure
+  tolerance (queue down → ``explanation_status="Queue failed"``,
+  api/app.py:248-250).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.service.db import ResultsDB
+from fraud_detection_tpu.service.http import App, HTTPError, Request, Response
+from fraud_detection_tpu.service.loading import load_production_model
+from fraud_detection_tpu.service.microbatch import MicroBatcher
+from fraud_detection_tpu.service.schemas import (
+    ExplanationFailedOut,
+    ExplanationOut,
+    HealthOut,
+    PredictionOut,
+    parse_transaction,
+)
+from fraud_detection_tpu.service.taskq import Broker
+from fraud_detection_tpu.service.tracing import setup_tracing, span
+
+log = logging.getLogger("fraud_detection_tpu.api")
+
+TASK_NAME = "xai_tasks.compute_shap"  # reference task name (api/worker.py:65)
+
+
+def create_app(
+    database_url: str | None = None, broker_url: str | None = None
+) -> App:
+    app = App(title="fraud-detection-tpu API")
+    state: dict = {
+        "model": None,
+        "model_source": None,
+        "batcher": None,
+        "db": None,
+        "broker": None,
+        "started_at": None,
+    }
+    app.state = state  # exposed for tests/embedding
+
+    # -- middleware: correlation ID + HTTP metrics -------------------------
+    async def correlation_and_metrics(req: Request, nxt):
+        corr_id = req.headers.get("x-correlation-id") or str(uuid.uuid4())
+        req.state["correlation_id"] = corr_id
+        t0 = time.perf_counter()
+        resp = await nxt(req)
+        dt = time.perf_counter() - t0
+        # Label by route template (bounded cardinality — scanner noise all
+        # lands on "<unmatched>"), not the raw path.
+        handler = app.route_template(req.path)
+        metrics.http_requests.labels(req.method, handler, str(resp.status_code)).inc()
+        metrics.http_request_duration.labels(req.method, handler).observe(dt)
+        resp.headers["x-correlation-id"] = corr_id
+        return resp
+
+    app.add_middleware(correlation_and_metrics)
+
+    # -- lifecycle ---------------------------------------------------------
+    async def startup():
+        state["started_at"] = time.time()
+        setup_tracing()
+        state["db"] = ResultsDB(database_url)
+        state["broker"] = Broker(broker_url)
+        try:
+            model, source = load_production_model()
+            state["model"], state["model_source"] = model, source
+            batcher = MicroBatcher(model.scorer)
+            await batcher.start()
+            state["batcher"] = batcher
+        except RuntimeError as e:
+            log.error("model load failed at startup: %s", e)
+
+    async def shutdown():
+        if state["batcher"]:
+            await state["batcher"].stop()
+        if state["db"]:
+            state["db"].close()
+        if state["broker"]:
+            state["broker"].close()
+
+    app.on_startup.append(startup)
+    app.on_shutdown.append(shutdown)
+
+    # -- endpoints ---------------------------------------------------------
+    @app.get("/status")
+    async def status(req: Request) -> Response:
+        return Response({"status": "UP"})
+
+    @app.get("/health")
+    async def health(req: Request) -> Response:
+        checks = {
+            "model": "ok" if state["model"] is not None else "unavailable",
+            "database": "ok" if state["db"] and state["db"].ping() else "unavailable",
+            "broker": "ok" if state["broker"] and state["broker"].ping() else "unavailable",
+        }
+        healthy = all(v == "ok" for v in checks.values())
+        body = HealthOut(
+            status="healthy" if healthy else "degraded",
+            checks=checks,
+            model_source=state["model_source"],
+            uptime_seconds=time.time() - (state["started_at"] or time.time()),
+        )
+        return Response(body.model_dump(), status_code=200 if healthy else 503)
+
+    @app.post("/predict")
+    async def predict(req: Request) -> Response:
+        metrics.predictions_submitted.inc()
+        corr_id = req.state["correlation_id"]
+        model = state["model"]
+        if model is None:
+            raise HTTPError(503, "model not loaded")
+        try:
+            features = parse_transaction(req.json())
+            row = model.prepare_row(features)
+        except ValueError as e:
+            raise HTTPError(422, str(e)) from e
+
+        with span("predict", correlation_id=corr_id):
+            with metrics.timed(metrics.inference_duration):
+                score = await state["batcher"].score(row)
+        prediction = int(score >= 0.5)
+
+        # Persist the PENDING row and enqueue the async explanation.
+        feature_dict = dict(zip(model.feature_names, row.tolist()))
+        tx_id = str(uuid.uuid4())
+        explanation_status = "queued"
+        try:
+            with metrics.timed(metrics.db_latency):
+                state["db"].create_pending(tx_id, feature_dict, corr_id)
+            state["broker"].send_task(
+                TASK_NAME, [tx_id, feature_dict, corr_id], correlation_id=corr_id
+            )
+        except Exception as e:
+            # Queue down must not fail scoring (api/app.py:248-250).
+            log.error("[%s] enqueue failed: %s", corr_id, e)
+            explanation_status = "Queue failed"
+
+        return Response(
+            PredictionOut(
+                prediction=prediction,
+                score=score,
+                transaction_id=tx_id,
+                correlation_id=corr_id,
+                explanation_status=explanation_status,
+            ).model_dump()
+        )
+
+    @app.get("/explain/{transaction_id}")
+    async def explain(req: Request) -> Response:
+        tx_id = req.path_params["transaction_id"]
+        with metrics.timed(metrics.db_latency):
+            row = state["db"].get(tx_id)
+        if row is None or row["status"] == "PENDING":
+            raise HTTPError(
+                404,
+                "Explanation not found. The transaction may still be pending.",
+            )
+        if row["status"] == "FAILED":
+            return Response(
+                ExplanationFailedOut(
+                    transaction_id=tx_id,
+                    status="FAILED",
+                    error=(row.get("shap_values") or {}).get("error"),
+                ).model_dump()
+            )
+        return Response(
+            ExplanationOut(
+                transaction_id=tx_id,
+                status=row["status"],
+                shap_values=row["shap_values"],
+                expected_value=row["expected_value"],
+                prediction_score=row["prediction_score"],
+                created_at=row["created_at"],
+            ).model_dump()
+        )
+
+    @app.get("/metrics")
+    async def prom(req: Request) -> Response:
+        return Response(
+            metrics.render(), media_type=metrics.CONTENT_TYPE_LATEST
+        )
+
+    return app
+
+
+def main():
+    import argparse
+
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    args = ap.parse_args()
+    from fraud_detection_tpu.service.http import run
+
+    run(create_app(), args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

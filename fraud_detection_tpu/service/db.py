@@ -1,0 +1,213 @@
+"""Persistence layer: results DB + versioned migrations.
+
+Replaces the reference's SQLAlchemy/Postgres + alembic stack (db/db.py,
+db/models.py, alembic/) with a dependency-free layer. ``DATABASE_URL``
+selects the backend: ``sqlite:///path`` (default, stdlib) or
+``postgresql://...`` when psycopg2 is installed.
+
+One table, ``transaction_results`` (db/models.py:16-24), used by BOTH the
+worker writes and the ``/explain`` readback — unifying the reference's
+two-table split-brain where the deployed worker wrote ``transaction_results``
+but the API read ``shap_explanations``, making /explain a permanent 404
+(SURVEY.md §2.3.2).
+
+Migrations are ordered SQL scripts applied under a ``schema_migrations``
+version table (the alembic-equivalent; reference migration 0001 is mirrored
+by our 0001). The reference's empty stub revisions are intentionally not
+reproduced.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Any
+
+from fraud_detection_tpu import config
+
+# Status enum (db/models.py:11-14)
+PENDING = "PENDING"
+COMPLETED = "COMPLETED"
+FAILED = "FAILED"
+
+MIGRATIONS: list[tuple[str, str]] = [
+    (
+        "0001_transaction_results",
+        """
+        CREATE TABLE IF NOT EXISTS transaction_results (
+            transaction_id TEXT PRIMARY KEY,
+            input_data TEXT NOT NULL,
+            shap_values TEXT,
+            expected_value REAL,
+            prediction_score REAL,
+            status TEXT NOT NULL DEFAULT 'PENDING',
+            correlation_id TEXT,
+            created_at REAL NOT NULL,
+            updated_at REAL NOT NULL
+        )
+        """,
+    ),
+    (
+        "0002_status_index",
+        "CREATE INDEX IF NOT EXISTS idx_results_status ON transaction_results(status)",
+    ),
+]
+
+
+def _sqlite_path(url: str) -> str:
+    # sqlite:///relative.db | sqlite:////abs/path.db | sqlite:///:memory:
+    path = url[len("sqlite:///") :] if url.startswith("sqlite:///") else url
+    return path or ":memory:"
+
+
+class ResultsDB:
+    """Thread-safe store for transaction scoring/explanation results."""
+
+    def __init__(self, url: str | None = None):
+        self.url = url or config.database_url()
+        if not self.url.startswith("sqlite"):
+            raise NotImplementedError(
+                f"backend for {self.url.split(':', 1)[0]} not available in this "
+                "build; set DATABASE_URL=sqlite:///..."
+            )
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(
+            _sqlite_path(self.url), check_same_thread=False, timeout=30.0
+        )
+        self._conn.row_factory = sqlite3.Row
+        # Worker writes while the API reads the same file: WAL lets readers
+        # proceed during commits (same cross-process pattern as taskq.py).
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self.migrate()
+
+    # -- migrations --------------------------------------------------------
+    def migrate(self) -> list[str]:
+        """Apply pending migrations; returns the ids applied."""
+        applied = []
+        with self._lock, self._conn:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS schema_migrations ("
+                "id TEXT PRIMARY KEY, applied_at REAL NOT NULL)"
+            )
+            done = {
+                r["id"]
+                for r in self._conn.execute("SELECT id FROM schema_migrations")
+            }
+            for mig_id, sql in MIGRATIONS:
+                if mig_id in done:
+                    continue
+                self._conn.executescript(sql)
+                self._conn.execute(
+                    "INSERT INTO schema_migrations (id, applied_at) VALUES (?, ?)",
+                    (mig_id, time.time()),
+                )
+                applied.append(mig_id)
+        return applied
+
+    # -- writes ------------------------------------------------------------
+    def create_pending(
+        self,
+        transaction_id: str | None,
+        input_data: dict,
+        correlation_id: str | None = None,
+    ) -> str:
+        tx_id = transaction_id or str(uuid.uuid4())
+        now = time.time()
+        with self._lock, self._conn:
+            self._conn.execute(
+                "INSERT INTO transaction_results "
+                "(transaction_id, input_data, status, correlation_id, created_at, updated_at) "
+                "VALUES (?, ?, ?, ?, ?, ?) "
+                "ON CONFLICT(transaction_id) DO UPDATE SET "
+                "input_data=excluded.input_data, updated_at=excluded.updated_at",
+                (tx_id, json.dumps(input_data), PENDING, correlation_id, now, now),
+            )
+        return tx_id
+
+    def complete(
+        self,
+        transaction_id: str,
+        shap_values: dict[str, float],
+        expected_value: float,
+        prediction_score: float,
+    ) -> None:
+        """Idempotent upsert (the reference's ON CONFLICT DO UPDATE,
+        api/worker.py:90-99) marking COMPLETED."""
+        now = time.time()
+        with self._lock, self._conn:
+            self._conn.execute(
+                "INSERT INTO transaction_results "
+                "(transaction_id, input_data, shap_values, expected_value, "
+                " prediction_score, status, created_at, updated_at) "
+                "VALUES (?, '{}', ?, ?, ?, ?, ?, ?) "
+                "ON CONFLICT(transaction_id) DO UPDATE SET "
+                "shap_values=excluded.shap_values, "
+                "expected_value=excluded.expected_value, "
+                "prediction_score=excluded.prediction_score, "
+                "status=excluded.status, updated_at=excluded.updated_at",
+                (
+                    transaction_id,
+                    json.dumps(shap_values),
+                    expected_value,
+                    prediction_score,
+                    COMPLETED,
+                    now,
+                    now,
+                ),
+            )
+
+    def fail(self, transaction_id: str, error: str) -> None:
+        now = time.time()
+        with self._lock, self._conn:
+            self._conn.execute(
+                "INSERT INTO transaction_results "
+                "(transaction_id, input_data, shap_values, status, created_at, updated_at) "
+                "VALUES (?, '{}', ?, ?, ?, ?) "
+                "ON CONFLICT(transaction_id) DO UPDATE SET "
+                "shap_values=excluded.shap_values, status=excluded.status, "
+                "updated_at=excluded.updated_at",
+                (transaction_id, json.dumps({"error": error}), FAILED, now, now),
+            )
+
+    # -- reads -------------------------------------------------------------
+    def get(self, transaction_id: str) -> dict[str, Any] | None:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM transaction_results WHERE transaction_id = ?",
+                (transaction_id,),
+            ).fetchone()
+        if row is None:
+            return None
+        out = dict(row)
+        for k in ("input_data", "shap_values"):
+            if out.get(k):
+                out[k] = json.loads(out[k])
+        return out
+
+    def count(self, status: str | None = None) -> int:
+        with self._lock:
+            if status:
+                (n,) = self._conn.execute(
+                    "SELECT COUNT(*) FROM transaction_results WHERE status = ?",
+                    (status,),
+                ).fetchone()
+            else:
+                (n,) = self._conn.execute(
+                    "SELECT COUNT(*) FROM transaction_results"
+                ).fetchone()
+        return n
+
+    def ping(self) -> bool:
+        try:
+            with self._lock:
+                self._conn.execute("SELECT 1").fetchone()
+            return True
+        except Exception:
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

@@ -1,0 +1,62 @@
+"""Serving-side model resolution with fallback.
+
+The reference's load order (api/app.py:30-48 + api/utils.py:10-25):
+registry alias ``models:/{MLFLOW_MODEL_NAME}@{MLFLOW_MODEL_STAGE}`` first,
+then local artifacts. Same here, across three sources:
+
+1. native registry (``models:/fraud@prod`` under the tracking root);
+2. native artifact dir containing ``model.npz`` (``MODEL_PATH``'s directory);
+3. reference-format joblib artifacts (``MODEL_PATH``/``SCALER_PATH``/
+   ``FEATURE_NAMES_PATH``) — the checked-in-artifact fallback behavior.
+
+Raises RuntimeError when nothing is loadable (the API then reports degraded
+health instead of serving garbage).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.tracking import TrackingClient
+
+log = logging.getLogger("fraud_detection_tpu.loading")
+
+
+def load_production_model() -> tuple[FraudLogisticModel, str]:
+    """Returns (model, source_description)."""
+    # 1. registry alias
+    uri = f"models:/{config.model_name()}@{config.model_stage()}"
+    try:
+        art = TrackingClient().registry.resolve(uri)
+        model = FraudLogisticModel.load(art)
+        log.info("loaded model from registry %s (%s)", uri, art)
+        return model, f"registry:{uri}"
+    except (FileNotFoundError, ValueError) as e:
+        log.warning("registry load failed (%s); falling back to local artifacts", e)
+
+    # 2. native artifact directory
+    model_dir = os.path.dirname(config.model_path()) or "."
+    native = os.path.join(model_dir, "model.npz")
+    if os.path.exists(native):
+        model = FraudLogisticModel.load(model_dir)
+        log.info("loaded native artifacts from %s", model_dir)
+        return model, f"native:{model_dir}"
+
+    # 3. reference-format joblib artifacts
+    if os.path.exists(config.model_path()):
+        scaler_path = config.scaler_path()
+        model = FraudLogisticModel.load_joblib(
+            config.model_path(),
+            scaler_path if os.path.exists(scaler_path) else None,
+            config.feature_names_path(),
+        )
+        log.info("loaded joblib artifacts from %s", config.model_path())
+        return model, f"joblib:{config.model_path()}"
+
+    raise RuntimeError(
+        f"no model available: registry {uri} empty and no artifacts at "
+        f"{config.model_path()}"
+    )

@@ -1,0 +1,97 @@
+"""Prometheus metrics.
+
+The metric names are part of the behavior contract (SURVEY.md §5: dashboards
+and alert rules reference them): ``predictions_submitted_total``,
+``api_inference_duration_seconds``, ``api_db_latency_seconds``
+(api/app.py:66-68); ``xai_task_duration_seconds``, ``xai_task_success_total``,
+``xai_task_failures_total`` (xai_tasks.py:48-50); plus the HTTP request
+metrics the reference gets from prometheus_fastapi_instrumentator
+(``http_requests_total``, ``http_request_duration_seconds``).
+"""
+
+from __future__ import annotations
+
+import time
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+from prometheus_client import CONTENT_TYPE_LATEST  # noqa: F401
+
+registry = CollectorRegistry()
+
+# API-side (api/app.py:66-68)
+predictions_submitted = Counter(
+    "predictions_submitted",
+    "Transactions submitted for prediction",
+    registry=registry,
+)
+inference_duration = Histogram(
+    "api_inference_duration_seconds",
+    "Model inference latency",
+    registry=registry,
+)
+db_latency = Histogram(
+    "api_db_latency_seconds", "Database call latency", registry=registry
+)
+
+# HTTP auto-metrics (prometheus_fastapi_instrumentator equivalents)
+http_requests = Counter(
+    "http_requests",
+    "HTTP requests",
+    ["method", "handler", "status"],
+    registry=registry,
+)
+http_request_duration = Histogram(
+    "http_request_duration_seconds",
+    "HTTP request latency",
+    ["method", "handler"],
+    registry=registry,
+)
+
+# Worker-side (xai_tasks.py:48-50)
+xai_task_duration = Histogram(
+    "xai_task_duration_seconds", "XAI task latency", registry=registry
+)
+xai_task_success = Counter(
+    "xai_task_success", "Successful XAI tasks", registry=registry
+)
+xai_task_failures = Counter(
+    "xai_task_failures", "Failed XAI tasks", registry=registry
+)
+queue_depth = Gauge(
+    "xai_queue_depth", "Queued XAI tasks (KEDA scaling signal)", registry=registry
+)
+
+# Micro-batcher telemetry (no reference counterpart)
+microbatch_size = Histogram(
+    "scorer_microbatch_size",
+    "Rows per device dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+    registry=registry,
+)
+
+
+def render() -> bytes:
+    return generate_latest(registry)
+
+
+class _Timer:
+    def __init__(self, hist: Histogram):
+        self.hist = hist
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0)
+        return False
+
+
+def timed(hist: Histogram) -> _Timer:
+    return _Timer(hist)

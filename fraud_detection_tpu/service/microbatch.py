@@ -1,0 +1,113 @@
+"""Async micro-batching in front of the jitted scorer.
+
+The reference scores one row per request through sklearn (api/app.py:209) —
+fine on CPU, but a single 30-float row per device dispatch would be pure
+overhead on TPU (SURVEY.md §7 hard part c: dispatch latency dominates).
+Concurrent requests instead land in an asyncio queue; a collector drains up
+to ``max_batch`` rows or waits at most ``max_wait_ms``, launches ONE device
+call for the batch (shape-bucketed, so a handful of cached executables serve
+all sizes), and resolves each request's future.
+
+p50 for a lone request = max_wait_ms + one dispatch; throughput under load =
+device batch rate. Both knobs come from config (``SCORER_MAX_BATCH``,
+``SCORER_MAX_WAIT_MS``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.ops.scorer import BatchScorer
+from fraud_detection_tpu.service import metrics
+
+log = logging.getLogger("fraud_detection_tpu.microbatch")
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        scorer: BatchScorer,
+        max_batch: int | None = None,
+        max_wait_ms: float | None = None,
+    ):
+        self.scorer = scorer
+        self.max_batch = max_batch or config.scorer_max_batch()
+        self.max_wait = (
+            max_wait_ms if max_wait_ms is not None else config.scorer_max_wait_ms()
+        ) / 1000.0
+        self._queue: asyncio.Queue[tuple[np.ndarray, asyncio.Future]] = asyncio.Queue()
+        self._collector: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        if self._collector is None or self._collector.done():
+            self._collector = asyncio.create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._collector is not None:
+            self._collector.cancel()
+            try:
+                await self._collector
+            except asyncio.CancelledError:
+                pass
+            self._collector = None
+        # Fail anything still enqueued so no request awaits forever.
+        while not self._queue.empty():
+            _, fut = self._queue.get_nowait()
+            if not fut.done():
+                fut.set_exception(RuntimeError("scorer shutting down"))
+
+    async def score(self, row: np.ndarray) -> float:
+        """Submit one feature row; returns P(fraud)."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((row, fut))
+        return await fut
+
+    async def _run(self) -> None:
+        batch: list[tuple[np.ndarray, asyncio.Future]] = []
+        try:
+            while True:
+                batch = [await self._queue.get()]
+                # Collect more rows until the window closes or the batch fills.
+                deadline = asyncio.get_running_loop().time() + self.max_wait
+                while len(batch) < self.max_batch:
+                    timeout = deadline - asyncio.get_running_loop().time()
+                    if timeout <= 0:
+                        break
+                    try:
+                        batch.append(
+                            await asyncio.wait_for(self._queue.get(), timeout)
+                        )
+                    except asyncio.TimeoutError:
+                        break
+                await self._flush(batch)
+                batch = []
+        except asyncio.CancelledError:
+            # Cancellation mid-collection: fail the partial batch so its
+            # waiters don't hang, then propagate.
+            for _, f in batch:
+                if not f.done():
+                    f.set_exception(RuntimeError("scorer shutting down"))
+            raise
+
+    async def _flush(self, batch: list[tuple[np.ndarray, asyncio.Future]]) -> None:
+        rows = np.stack([r for r, _ in batch])
+        metrics.microbatch_size.observe(len(batch))
+        try:
+            # The device call is synchronous-but-fast; run it in the default
+            # executor so the event loop keeps accepting requests while XLA
+            # executes.
+            probs = await asyncio.get_running_loop().run_in_executor(
+                None, self.scorer.predict_proba, rows
+            )
+        except Exception as e:  # resolve all waiters with the failure
+            for _, f in batch:
+                if not f.done():
+                    f.set_exception(e)
+            return
+        for (_, f), p in zip(batch, probs):
+            if not f.done():
+                f.set_result(float(p))

@@ -1,0 +1,75 @@
+"""Request/response schemas — the typed API contract.
+
+Mirrors the reference's inline models (api/app.py:110-119:
+``TransactionIn{features}`` / ``PredictionOut``) plus the 202-pattern models
+from api/schemas.py. The pydantic models are wired into the handlers (app.py
+builds every response through them), so they cannot drift from the actual
+wire format the way the reference's unused api/schemas.py did (SURVEY.md §2
+component 7).
+"""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, Field
+
+
+class TransactionIn(BaseModel):
+    features: list[float] | dict[str, float] = Field(
+        description="Feature vector in training order, or name→value map"
+    )
+
+
+class PredictionOut(BaseModel):
+    prediction: int
+    score: float
+    transaction_id: str
+    correlation_id: str
+    explanation_status: str
+
+
+class ExplanationOut(BaseModel):
+    transaction_id: str
+    status: str
+    shap_values: dict[str, float]
+    expected_value: float
+    prediction_score: float | None = None
+    created_at: float | None = None
+
+
+class ExplanationFailedOut(BaseModel):
+    transaction_id: str
+    status: str
+    error: str | None = None
+
+
+class HealthOut(BaseModel):
+    status: str
+    checks: dict[str, str]
+    model_source: str | None = None
+    uptime_seconds: float
+
+
+def parse_transaction(payload) -> list[float] | dict[str, float]:
+    """Validate the /predict body → features (list or dict).
+
+    Raises ValueError with a client-facing message (→ 422, matching the
+    reference's arity validation at api/app.py:185-192).
+    """
+    if not isinstance(payload, dict) or "features" not in payload:
+        raise ValueError("body must be an object with a 'features' field")
+    features = payload["features"]
+    if isinstance(features, dict):
+        if not features:
+            raise ValueError("'features' must not be empty")
+        try:
+            return {str(k): float(v) for k, v in features.items()}
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"non-numeric feature value: {e}") from e
+    if isinstance(features, list):
+        if not features:
+            raise ValueError("'features' must not be empty")
+        try:
+            return [float(v) for v in features]
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"non-numeric feature value: {e}") from e
+    raise ValueError("'features' must be a list or an object")

@@ -1,0 +1,222 @@
+"""Task queue with Celery's delivery semantics, SQLite-backed.
+
+Replaces the reference's Celery + Redis(Sentinel) broker (xai_tasks.py:59-64,
+docker-compose.yml:4-36) with a native queue that preserves the semantics the
+reference's reliability story depends on (docs/WorkerRecoveryTestPlan.md):
+
+- **acks_late**: a task is acknowledged only after successful execution; a
+  worker dying mid-task leaves the claim to expire (visibility timeout) and
+  the task is redelivered — at-least-once, zero loss on pod kill;
+- **bounded retries with backoff**: ``max_retries`` (default 5, matching
+  xai_tasks.py:63) with per-retry countdown, FAILED terminal state after
+  exhaustion (xai_tasks.py:143-163);
+- **queue depth** observable for autoscaling (the KEDA listLength trigger,
+  k8s/xai-worker-scaledobject.yaml).
+
+SQLite in WAL mode is safe across processes on one host; the broker URL is
+``CELERY_BROKER_URL`` for env compatibility (``sqlite:///taskq.db``). A
+Redis-backed broker can be slotted in behind the same interface when the
+client library exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any
+
+from fraud_detection_tpu import config
+
+QUEUED = "QUEUED"
+CLAIMED = "CLAIMED"
+DONE = "DONE"
+FAILED = "FAILED"
+
+DEFAULT_MAX_RETRIES = 5  # xai_tasks.py:63
+DEFAULT_VISIBILITY_TIMEOUT = 60.0
+
+
+@dataclass
+class Task:
+    id: str
+    name: str
+    args: list[Any]
+    correlation_id: str | None
+    attempts: int
+    max_retries: int
+
+
+def _path(url: str) -> str:
+    return url[len("sqlite:///") :] if url.startswith("sqlite:///") else url
+
+
+class Broker:
+    def __init__(self, url: str | None = None):
+        self.url = url or config.broker_url()
+        if not self.url.startswith("sqlite"):
+            raise NotImplementedError(
+                f"broker backend for {self.url.split(':', 1)[0]} not available; "
+                "set CELERY_BROKER_URL=sqlite:///..."
+            )
+        path = _path(self.url)
+        if path != ":memory:" and os.path.dirname(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(path, check_same_thread=False, timeout=30.0)
+        self._conn.row_factory = sqlite3.Row
+        with self._lock, self._conn:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute(
+                """
+                CREATE TABLE IF NOT EXISTS tasks (
+                    id TEXT PRIMARY KEY,
+                    name TEXT NOT NULL,
+                    args TEXT NOT NULL,
+                    correlation_id TEXT,
+                    status TEXT NOT NULL DEFAULT 'QUEUED',
+                    attempts INTEGER NOT NULL DEFAULT 0,
+                    max_retries INTEGER NOT NULL DEFAULT 5,
+                    visible_at REAL NOT NULL,
+                    claimed_by TEXT,
+                    created_at REAL NOT NULL,
+                    updated_at REAL NOT NULL,
+                    error TEXT
+                )
+                """
+            )
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_tasks_claim "
+                "ON tasks(status, visible_at)"
+            )
+
+    # -- producer ----------------------------------------------------------
+    def send_task(
+        self,
+        name: str,
+        args: list[Any],
+        correlation_id: str | None = None,
+        max_retries: int = DEFAULT_MAX_RETRIES,
+        countdown: float = 0.0,
+    ) -> str:
+        """Celery ``send_task`` equivalent (api/app.py:244-245)."""
+        task_id = uuid.uuid4().hex
+        now = time.time()
+        with self._lock, self._conn:
+            self._conn.execute(
+                "INSERT INTO tasks (id, name, args, correlation_id, status, "
+                "max_retries, visible_at, created_at, updated_at) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                (
+                    task_id, name, json.dumps(args), correlation_id,
+                    QUEUED, max_retries, now + countdown, now, now,
+                ),
+            )
+        return task_id
+
+    # -- consumer ----------------------------------------------------------
+    def claim(
+        self, worker_id: str, visibility_timeout: float = DEFAULT_VISIBILITY_TIMEOUT
+    ) -> Task | None:
+        """Atomically claim the oldest deliverable task.
+
+        Deliverable = QUEUED and visible, or CLAIMED whose visibility window
+        lapsed (the acks_late redelivery path after a worker death).
+        """
+        now = time.time()
+        with self._lock, self._conn:
+            row = self._conn.execute(
+                "SELECT * FROM tasks WHERE status IN (?, ?) AND visible_at <= ? "
+                "ORDER BY created_at LIMIT 1",
+                (QUEUED, CLAIMED, now),
+            ).fetchone()
+            if row is None:
+                return None
+            cur = self._conn.execute(
+                "UPDATE tasks SET status = ?, claimed_by = ?, visible_at = ?, "
+                "updated_at = ? WHERE id = ? AND status = ? AND visible_at <= ?",
+                (
+                    CLAIMED, worker_id, now + visibility_timeout, now,
+                    row["id"], row["status"], now,
+                ),
+            )
+            if cur.rowcount != 1:  # lost the race to another worker
+                return None
+        return Task(
+            id=row["id"],
+            name=row["name"],
+            args=json.loads(row["args"]),
+            correlation_id=row["correlation_id"],
+            attempts=row["attempts"],
+            max_retries=row["max_retries"],
+        )
+
+    def ack(self, task_id: str) -> None:
+        """Acknowledge success — only called AFTER execution (acks_late)."""
+        with self._lock, self._conn:
+            self._conn.execute(
+                "UPDATE tasks SET status = ?, updated_at = ? WHERE id = ?",
+                (DONE, time.time(), task_id),
+            )
+
+    def nack(self, task_id: str, countdown: float, error: str = "") -> bool:
+        """Failed attempt: requeue with backoff, or FAILED past max_retries.
+
+        Returns True when the task will be retried.
+        """
+        now = time.time()
+        with self._lock, self._conn:
+            row = self._conn.execute(
+                "SELECT attempts, max_retries FROM tasks WHERE id = ?", (task_id,)
+            ).fetchone()
+            if row is None:
+                return False
+            attempts = row["attempts"] + 1
+            if attempts > row["max_retries"]:
+                self._conn.execute(
+                    "UPDATE tasks SET status = ?, attempts = ?, error = ?, "
+                    "updated_at = ? WHERE id = ?",
+                    (FAILED, attempts, error, now, task_id),
+                )
+                return False
+            self._conn.execute(
+                "UPDATE tasks SET status = ?, attempts = ?, error = ?, "
+                "visible_at = ?, updated_at = ? WHERE id = ?",
+                (QUEUED, attempts, error, now + countdown, now, task_id),
+            )
+            return True
+
+    # -- observability -----------------------------------------------------
+    def depth(self) -> int:
+        """Deliverable backlog (the KEDA scaling signal)."""
+        now = time.time()
+        with self._lock:
+            (n,) = self._conn.execute(
+                "SELECT COUNT(*) FROM tasks WHERE status IN (?, ?) "
+                "AND visible_at <= ?",
+                (QUEUED, CLAIMED, now),
+            ).fetchone()
+        return n
+
+    def get_status(self, task_id: str) -> str | None:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT status FROM tasks WHERE id = ?", (task_id,)
+            ).fetchone()
+        return row["status"] if row else None
+
+    def ping(self) -> bool:
+        try:
+            with self._lock:
+                self._conn.execute("SELECT 1").fetchone()
+            return True
+        except Exception:
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

@@ -1,0 +1,69 @@
+"""OpenTelemetry tracing, gated on availability and configuration.
+
+Mirror of the reference's OTEL wiring (api/app.py:88-104, xai_tasks.py:33-45):
+a TracerProvider with an OTLP HTTP exporter + BatchSpanProcessor when
+``OTEL_EXPORTER_OTLP_ENDPOINT`` is set and the SDK is importable; a no-op
+tracer otherwise, so the service never hard-depends on the otel packages.
+
+Correlation IDs are carried separately (middleware + task args, matching
+api/app.py:121-128, 244-245) — they work with or without OTEL.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+
+from fraud_detection_tpu import config
+
+log = logging.getLogger("fraud_detection_tpu.tracing")
+
+_tracer = None
+_initialized = False
+
+
+def setup_tracing(service_name: str | None = None) -> bool:
+    """Initialize the tracer provider; returns True when real tracing is on."""
+    global _tracer, _initialized
+    if _initialized:
+        return _tracer is not None
+    _initialized = True
+    endpoint = config.otel_endpoint()
+    if not endpoint:
+        return False
+    try:
+        from opentelemetry import trace
+        from opentelemetry.exporter.otlp.proto.http.trace_exporter import (
+            OTLPSpanExporter,
+        )
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+
+        provider = TracerProvider(
+            resource=Resource.create(
+                {"service.name": service_name or config.otel_service_name()}
+            )
+        )
+        provider.add_span_processor(
+            BatchSpanProcessor(OTLPSpanExporter(endpoint=f"{endpoint}/v1/traces"))
+        )
+        trace.set_tracer_provider(provider)
+        _tracer = trace.get_tracer("fraud_detection_tpu")
+        log.info("OTEL tracing enabled → %s", endpoint)
+        return True
+    except Exception as e:  # pragma: no cover - depends on env
+        log.warning("OTEL setup failed (%s); tracing disabled", e)
+        return False
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes):
+    """Start a span when tracing is configured; no-op otherwise."""
+    if _tracer is None:
+        yield None
+        return
+    with _tracer.start_as_current_span(name) as s:
+        for k, v in attributes.items():
+            s.set_attribute(k, v)
+        yield s

@@ -1,0 +1,156 @@
+"""The async XAI worker.
+
+Unified rebuild of the reference's two parallel workers (xai_tasks.py —
+deployed, wrong attribution formula, wrote ``transaction_results``;
+api/worker.py — legacy, real SHAP, wrote ``shap_explanations``; SURVEY.md
+§2.3.2-3). One worker, one table, the *correct* closed-form interventional
+linear SHAP (coef·(x−μ)) computed as a vmapped XLA call.
+
+Semantics preserved from the reference:
+
+- task name ``xai_tasks.compute_shap(transaction_id, input_data, corr_id)``
+  (xai_tasks.py:63, api/worker.py:65);
+- acks_late + max_retries=5, retry countdown 5s on DB errors / 10s on other
+  errors, FAILED status after exhaustion (xai_tasks.py:63,137-163);
+- worker-side Prometheus HTTP server on :8001 (xai_tasks.py:52-56);
+- model loaded once at startup, not per task (fixing the per-task reload
+  inefficiency noted at xai_tasks.py:80-82).
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import socket
+import sqlite3
+import threading
+import uuid
+
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.ops.linear_shap import linear_shap_single
+from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.service.db import ResultsDB
+from fraud_detection_tpu.service.loading import load_production_model
+from fraud_detection_tpu.service.taskq import Broker, Task
+from fraud_detection_tpu.service.tracing import setup_tracing, span
+
+log = logging.getLogger("fraud_detection_tpu.worker")
+
+DB_RETRY_COUNTDOWN = 5.0   # xai_tasks.py:137-141
+OTHER_RETRY_COUNTDOWN = 10.0
+
+
+class XaiWorker:
+    def __init__(
+        self,
+        broker_url: str | None = None,
+        database_url: str | None = None,
+        worker_id: str | None = None,
+        poll_interval: float = 0.2,
+    ):
+        self.worker_id = worker_id or f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
+        self.broker = Broker(broker_url)
+        self.db = ResultsDB(database_url)
+        self.poll_interval = poll_interval
+        self._stop = threading.Event()
+        self.model, source = load_production_model()
+        self.explainer = self.model.raw_explainer()
+        log.info("worker %s up; model from %s", self.worker_id, source)
+
+    # -- task bodies -------------------------------------------------------
+    def compute_shap(
+        self, transaction_id: str, input_data: dict, correlation_id: str | None
+    ) -> None:
+        with span("compute_shap", correlation_id=correlation_id or ""):
+            row = self.model.prepare_row(input_data)
+            score = float(self.model.scorer.predict_proba(row[None, :])[0])
+            phi = np.asarray(linear_shap_single(self.explainer, row))
+            shap_values = dict(zip(self.model.feature_names, phi.astype(float)))
+            self.db.complete(
+                transaction_id,
+                shap_values,
+                float(self.explainer.expected_value),
+                score,
+            )
+        log.info(
+            "[%s] explained %s (score %.4f)",
+            correlation_id, transaction_id, score,
+        )
+
+    def _execute(self, task: Task) -> None:
+        handlers = {"xai_tasks.compute_shap": self.compute_shap}
+        fn = handlers.get(task.name)
+        if fn is None:
+            raise ValueError(f"unknown task {task.name}")
+        fn(*task.args)
+
+    # -- delivery loop -----------------------------------------------------
+    def run_once(self) -> bool:
+        """Claim and process one task; returns True when one was handled."""
+        task = self.broker.claim(self.worker_id)
+        if task is None:
+            return False
+        try:
+            with metrics.timed(metrics.xai_task_duration):
+                self._execute(task)
+            self.broker.ack(task.id)  # acks_late: only after success
+            metrics.xai_task_success.inc()
+        except Exception as e:
+            is_db = isinstance(e, sqlite3.Error)
+            countdown = DB_RETRY_COUNTDOWN if is_db else OTHER_RETRY_COUNTDOWN
+            will_retry = self.broker.nack(task.id, countdown, str(e))
+            metrics.xai_task_failures.inc()
+            if will_retry:
+                log.warning(
+                    "task %s failed (%s); retry in %.0fs (attempt %d/%d)",
+                    task.id, e, countdown, task.attempts + 1, task.max_retries,
+                )
+            else:
+                log.error("task %s FAILED permanently: %s", task.id, e)
+                tx_id = task.args[0] if task.args else None
+                if tx_id:
+                    try:
+                        self.db.fail(tx_id, str(e))
+                    except Exception:
+                        log.exception("could not mark %s FAILED", tx_id)
+        return True
+
+    def run_forever(self) -> None:
+        log.info("worker %s consuming (broker %s)", self.worker_id, self.broker.url)
+        while not self._stop.is_set():
+            metrics.queue_depth.set(self.broker.depth())
+            if not self.run_once():
+                self._stop.wait(self.poll_interval)
+
+    def stop(self) -> None:
+        """Graceful drain (the preStop `celery control shutdown` analogue,
+        charts/.../worker-deployment.yaml)."""
+        self._stop.set()
+
+
+def main():
+    import argparse
+
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--metrics-port", type=int, default=config.worker_metrics_port())
+    ap.add_argument("--poll-interval", type=float, default=0.2)
+    args = ap.parse_args()
+
+    setup_tracing(service_name="fraud-xai-worker")
+    if args.metrics_port:
+        from prometheus_client import start_http_server
+
+        start_http_server(args.metrics_port, registry=metrics.registry)
+        log.info("worker metrics on :%d", args.metrics_port)
+
+    worker = XaiWorker(poll_interval=args.poll_interval)
+    signal.signal(signal.SIGTERM, lambda *_: worker.stop())
+    signal.signal(signal.SIGINT, lambda *_: worker.stop())
+    worker.run_forever()
+
+
+if __name__ == "__main__":
+    main()

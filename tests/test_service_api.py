@@ -1,0 +1,193 @@
+"""API tests through the in-process TestClient — superset of the reference's
+tests/test_api.py (test_status, test_predict_minimal) plus the async
+explanation round trip."""
+
+import os
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import scaler_fit
+from fraud_detection_tpu.service.app import create_app
+from fraud_detection_tpu.service.http import TestClient
+from fraud_detection_tpu.service.worker import XaiWorker
+
+
+@pytest.fixture()
+def served(tmp_path, rng, monkeypatch):
+    """A trained model on disk + app wired to temp DB/broker/tracking."""
+    d = 30
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32), intercept=np.float32(-1.0)
+    )
+    x = rng.standard_normal((200, d)).astype(np.float32)
+    scaler = scaler_fit(x)
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    model_dir = str(tmp_path / "models")
+    FraudLogisticModel(params, scaler, names).save(model_dir, joblib_too=False)
+
+    monkeypatch.setenv("MODEL_PATH", os.path.join(model_dir, "logistic_model.joblib"))
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    db_url = f"sqlite:///{tmp_path}/fraud.db"
+    broker_url = f"sqlite:///{tmp_path}/taskq.db"
+    app = create_app(database_url=db_url, broker_url=broker_url)
+    client = TestClient(app)
+    yield client, db_url, broker_url
+    client.close()
+
+
+def test_status(served):
+    client, *_ = served
+    r = client.get("/status")
+    assert r.status_code == 200
+    assert r.json()["status"] == "UP"
+
+
+def test_health(served):
+    client, *_ = served
+    r = client.get("/health")
+    assert r.status_code == 200
+    body = r.json()
+    assert body["status"] == "healthy"
+    assert body["checks"] == {"model": "ok", "database": "ok", "broker": "ok"}
+
+
+def test_predict_minimal(served):
+    client, *_ = served
+    r = client.post("/predict", json={"features": [0.1] * 30})
+    assert r.status_code in (200, 201, 202)
+    body = r.json()
+    assert body["prediction"] in (0, 1)
+    assert 0.0 <= body["score"] <= 1.0
+    assert body["explanation_status"] == "queued"
+    assert "x-correlation-id" in {k.lower() for k in r.headers}
+
+
+def test_predict_dict_features(served):
+    client, *_ = served
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    r = client.post("/predict", json={"features": {n: 0.5 for n in names}})
+    assert r.status_code == 200
+
+
+def test_predict_wrong_arity_422(served):
+    client, *_ = served
+    r = client.post("/predict", json={"features": [0.1] * 7})
+    assert r.status_code == 422
+    assert "expected 30" in r.json()["detail"]
+
+
+def test_predict_bad_body_422(served):
+    client, *_ = served
+    assert client.post("/predict", json={"nope": 1}).status_code == 422
+    assert client.post("/predict", json={"features": "x"}).status_code == 422
+    assert client.post("/predict", json={"features": ["a"] * 30}).status_code == 422
+
+
+def test_unknown_route_404_and_method_405(served):
+    client, *_ = served
+    assert client.get("/nope").status_code == 404
+    assert client.get("/predict").status_code == 405
+
+
+def test_metrics_exposition(served):
+    client, *_ = served
+    client.post("/predict", json={"features": [0.0] * 30})
+    r = client.get("/metrics")
+    assert r.status_code == 200
+    text = r.text
+    assert "predictions_submitted_total" in text
+    assert "api_inference_duration_seconds" in text
+    assert "http_requests_total" in text
+
+
+def test_correlation_id_propagates(served):
+    client, *_ = served
+    r = client.post(
+        "/predict",
+        json={"features": [0.0] * 30},
+        headers={"X-Correlation-ID": "abc-123"},
+    )
+    assert r.headers["x-correlation-id"] == "abc-123"
+    assert r.json()["correlation_id"] == "abc-123"
+
+
+def test_explain_pending_then_completed(served):
+    """The full async loop: /predict → worker processes → /explain."""
+    client, db_url, broker_url = served
+    r = client.post("/predict", json={"features": [0.2] * 30})
+    tx_id = r.json()["transaction_id"]
+
+    r404 = client.get(f"/explain/{tx_id}")
+    assert r404.status_code == 404  # still pending
+
+    worker = XaiWorker(broker_url=broker_url, database_url=db_url)
+    assert worker.run_once() is True
+
+    r2 = client.get(f"/explain/{tx_id}")
+    assert r2.status_code == 200
+    body = r2.json()
+    assert body["status"] == "COMPLETED"
+    assert len(body["shap_values"]) == 30
+    # SHAP additivity in margin space: sum(phi) + E[f] == logit(score)
+    logit = float(np.log(body["prediction_score"] / (1 - body["prediction_score"])))
+    total = sum(body["shap_values"].values()) + body["expected_value"]
+    assert abs(total - logit) < 1e-3
+
+
+def test_explain_unknown_404(served):
+    client, *_ = served
+    assert client.get("/explain/no-such-tx").status_code == 404
+
+
+def test_error_responses_carry_correlation_id_and_metrics(served):
+    """Error responses must still flow through middleware (correlation ID +
+    http_requests metrics on 4xx — FastAPI-equivalent behavior)."""
+    client, *_ = served
+    r = client.post(
+        "/predict",
+        json={"features": [0.1] * 7},
+        headers={"X-Correlation-ID": "err-1"},
+    )
+    assert r.status_code == 422
+    assert r.headers["x-correlation-id"] == "err-1"
+    text = client.get("/metrics").text
+    assert 'http_requests_total{handler="/predict",method="POST",status="422"}' in text
+
+
+def test_unmatched_paths_use_bounded_metric_label(served):
+    client, *_ = served
+    client.get("/admin.php")
+    client.get("/some/random/probe")
+    text = client.get("/metrics").text
+    assert 'handler="<unmatched>"' in text
+    assert "admin.php" not in text
+
+
+def test_microbatcher_stop_fails_pending(served):
+    """Shutdown must not leave enqueued scoring futures hanging."""
+    import asyncio
+
+    import numpy as np
+
+    client, *_ = served
+    client.get("/status")  # trigger startup so the batcher exists
+    batcher = client.app.state["batcher"]
+
+    async def go():
+        fut = asyncio.ensure_future(batcher.score(np.zeros(30, np.float32)))
+        # don't let the collector pick it up: stop immediately
+        await batcher.stop()
+        try:
+            await asyncio.wait_for(fut, timeout=2.0)
+            return "resolved"
+        except RuntimeError:
+            return "failed-cleanly"
+        except asyncio.TimeoutError:
+            return "hung"
+
+    result = client.loop.run_until_complete(go())
+    assert result in ("resolved", "failed-cleanly")
+    client.loop.run_until_complete(batcher.start())  # restore for teardown

@@ -1,0 +1,83 @@
+"""Task-queue semantics: acks_late redelivery, retry ladder, FAILED terminal
+state — the delivery guarantees the reference gets from Celery
+(xai_tasks.py:63,137-163; docs/WorkerRecoveryTestPlan.md)."""
+
+import time
+
+from fraud_detection_tpu.service.taskq import (
+    CLAIMED,
+    DONE,
+    FAILED,
+    QUEUED,
+    Broker,
+)
+
+
+def _broker(tmp_path):
+    return Broker(f"sqlite:///{tmp_path}/q.db")
+
+
+def test_send_claim_ack(tmp_path):
+    b = _broker(tmp_path)
+    tid = b.send_task("t", [1, "x"], correlation_id="c1")
+    assert b.depth() == 1
+    task = b.claim("w1")
+    assert task.id == tid
+    assert task.args == [1, "x"]
+    assert task.correlation_id == "c1"
+    assert b.depth() == 0  # claimed within visibility window
+    b.ack(task.id)
+    assert b.get_status(tid) == DONE
+    assert b.claim("w1") is None
+
+
+def test_acks_late_redelivery_after_worker_death(tmp_path):
+    """A claimed-but-never-acked task (dead worker) becomes deliverable again
+    once the visibility timeout lapses — at-least-once, zero loss."""
+    b = _broker(tmp_path)
+    tid = b.send_task("t", [])
+    t1 = b.claim("w1", visibility_timeout=0.05)
+    assert t1 is not None
+    assert b.claim("w2") is None  # invisible while claimed
+    time.sleep(0.06)
+    t2 = b.claim("w2")
+    assert t2 is not None and t2.id == tid
+
+
+def test_retry_backoff_and_terminal_failure(tmp_path):
+    b = _broker(tmp_path)
+    tid = b.send_task("t", [], max_retries=2)
+    for attempt in range(2):
+        task = b.claim("w")
+        assert task is not None
+        retried = b.nack(task.id, countdown=0.0, error=f"boom {attempt}")
+        assert retried is True
+    task = b.claim("w")
+    assert b.nack(task.id, countdown=0.0, error="final") is False
+    assert b.get_status(tid) == FAILED
+    assert b.claim("w") is None
+
+
+def test_countdown_delays_redelivery(tmp_path):
+    b = _broker(tmp_path)
+    b.send_task("t", [])
+    task = b.claim("w")
+    b.nack(task.id, countdown=0.08, error="later")
+    assert b.claim("w") is None  # not yet visible
+    time.sleep(0.09)
+    assert b.claim("w") is not None
+
+
+def test_fifo_order(tmp_path):
+    b = _broker(tmp_path)
+    ids = [b.send_task("t", [i]) for i in range(3)]
+    got = [b.claim("w").id for _ in range(3)]
+    assert got == ids
+
+
+def test_depth_counts_expired_claims(tmp_path):
+    b = _broker(tmp_path)
+    b.send_task("t", [])
+    b.claim("w", visibility_timeout=0.01)
+    time.sleep(0.02)
+    assert b.depth() == 1

@@ -1,0 +1,108 @@
+"""Worker behavior: retry ladder wiring, FAILED marking, recovery — the
+automated version of the reference's manual chaos plan
+(docs/WorkerRecoveryTestPlan.md: pod-kill reprocessing, no task loss)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import scaler_fit
+from fraud_detection_tpu.service.db import COMPLETED, FAILED, PENDING, ResultsDB
+from fraud_detection_tpu.service.taskq import Broker
+from fraud_detection_tpu.service.worker import XaiWorker
+
+
+@pytest.fixture()
+def env(tmp_path, rng, monkeypatch):
+    d = 30
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32), intercept=np.float32(0.0)
+    )
+    x = rng.standard_normal((100, d)).astype(np.float32)
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    model_dir = str(tmp_path / "models")
+    FraudLogisticModel(params, scaler_fit(x), names).save(model_dir, joblib_too=False)
+    monkeypatch.setenv("MODEL_PATH", os.path.join(model_dir, "logistic_model.joblib"))
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    db_url = f"sqlite:///{tmp_path}/fraud.db"
+    broker_url = f"sqlite:///{tmp_path}/q.db"
+    return db_url, broker_url, names
+
+
+def test_worker_processes_task(env):
+    db_url, broker_url, names = env
+    broker = Broker(broker_url)
+    db = ResultsDB(db_url)
+    feats = {n: 0.1 for n in names}
+    db.create_pending("tx1", feats, "c1")
+    broker.send_task("xai_tasks.compute_shap", ["tx1", feats, "c1"])
+
+    w = XaiWorker(broker_url=broker_url, database_url=db_url)
+    assert w.run_once() is True
+    row = db.get("tx1")
+    assert row["status"] == COMPLETED
+    assert len(row["shap_values"]) == 30
+    assert w.run_once() is False  # queue drained
+
+
+def test_unknown_task_retries_then_fails(env):
+    db_url, broker_url, _ = env
+    broker = Broker(broker_url)
+    broker.send_task("no.such.task", ["txX", {}, None], max_retries=1)
+    w = XaiWorker(broker_url=broker_url, database_url=db_url)
+    # attempt 1 fails -> nack (countdown 10s, not yet visible)
+    assert w.run_once() is True
+    assert broker.depth() == 0  # backing off
+    # force visibility for the test instead of sleeping 10s
+    with broker._lock, broker._conn:
+        broker._conn.execute("UPDATE tasks SET visible_at = 0")
+    assert w.run_once() is True  # attempt 2 -> exceeds max_retries -> FAILED
+    db = ResultsDB(db_url)
+    assert db.get("txX")["status"] == FAILED
+
+
+def test_bad_input_marks_failed_after_retries(env):
+    db_url, broker_url, _ = env
+    broker = Broker(broker_url)
+    db = ResultsDB(db_url)
+    db.create_pending("tx2", {"bad": 1}, None)
+    broker.send_task("xai_tasks.compute_shap", ["tx2", {"bad": 1.0}, None], max_retries=0)
+    w = XaiWorker(broker_url=broker_url, database_url=db_url)
+    assert w.run_once() is True
+    assert db.get("tx2")["status"] == FAILED
+
+
+def test_worker_death_reprocessing(env):
+    """acks_late end-to-end: kill worker A mid-task (simulated by claiming
+    without acking), then worker B reprocesses the same task."""
+    db_url, broker_url, names = env
+    broker = Broker(broker_url)
+    db = ResultsDB(db_url)
+    feats = {n: 0.5 for n in names}
+    db.create_pending("tx3", feats, None)
+    broker.send_task("xai_tasks.compute_shap", ["tx3", feats, None])
+
+    # worker A claims and "dies" (no ack)
+    dead = broker.claim("workerA", visibility_timeout=0.05)
+    assert dead is not None
+    import time
+
+    time.sleep(0.06)
+
+    w = XaiWorker(broker_url=broker_url, database_url=db_url, worker_id="workerB")
+    assert w.run_once() is True
+    assert db.get("tx3")["status"] == COMPLETED
+
+
+def test_results_db_upsert_idempotent(env):
+    db_url, *_ = env
+    db = ResultsDB(db_url)
+    db.create_pending("t", {"a": 1}, None)
+    db.complete("t", {"a": 0.5}, 0.1, 0.9)
+    db.complete("t", {"a": 0.6}, 0.1, 0.9)  # duplicate delivery
+    row = db.get("t")
+    assert row["status"] == COMPLETED
+    assert row["shap_values"] == {"a": 0.6}
