@@ -1,0 +1,83 @@
+"""Data sanity / EDA script.
+
+Rebuild of load_data.py + eda.py (SURVEY.md §2 component 17): shape and
+class-distribution printout, class-imbalance + amount-histogram plots, and a
+``processed_data.csv`` variant with scaled Amount/Time columns — reading the
+configured ``DATA_CSV`` (the reference read ``creditcard.csv`` from CWD).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.data.loader import load_creditcard_csv
+from fraud_detection_tpu.ops.scaler import scaler_fit, scaler_transform
+
+
+def eda(data_csv: str | None = None, plots_dir: str = "plots",
+        out_csv: str | None = "data/processed_data.csv") -> dict:
+    data_csv = data_csv or config.data_csv()
+    x, y, names = load_creditcard_csv(data_csv)
+    n_fraud = int(y.sum())
+    print(f"shape: {x.shape}; classes: legit {len(y) - n_fraud:,} / fraud {n_fraud:,} "
+          f"({100 * y.mean():.3f}%)")
+    print(f"features: {names[:3]} ... {names[-2:]}")
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    os.makedirs(plots_dir, exist_ok=True)
+    fig, ax = plt.subplots(figsize=(4, 4))
+    ax.bar(["legit", "fraud"], [len(y) - n_fraud, n_fraud])
+    ax.set_yscale("log")
+    ax.set_title("Class distribution")
+    fig.tight_layout()
+    fig.savefig(os.path.join(plots_dir, "class_distribution.png"), dpi=120)
+    plt.close(fig)
+
+    amount = x[:, names.index("Amount")] if "Amount" in names else x[:, -1]
+    fig, ax = plt.subplots(figsize=(5, 4))
+    ax.hist(amount, bins=80)
+    ax.set_yscale("log")
+    ax.set_xlabel("Amount")
+    ax.set_title("Transaction amounts")
+    fig.tight_layout()
+    fig.savefig(os.path.join(plots_dir, "amount_histogram.png"), dpi=120)
+    plt.close(fig)
+
+    if out_csv:
+        # Scaled Amount/Time variant (eda.py:36-46).
+        import pandas as pd
+
+        df = pd.DataFrame(x, columns=names)
+        for col in ("Amount", "Time"):
+            if col in df.columns:
+                sp = scaler_fit(df[[col]].to_numpy(np.float32))
+                df[f"scaled_{col.lower()}"] = np.asarray(
+                    scaler_transform(sp, df[[col]].to_numpy(np.float32))
+                )[:, 0]
+                del df[col]
+        df["Class"] = y
+        os.makedirs(os.path.dirname(out_csv) or ".", exist_ok=True)
+        df.to_csv(out_csv, index=False)
+        print(f"wrote {out_csv}")
+    return {"n_rows": len(y), "n_fraud": n_fraud}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--plots-dir", default="plots")
+    ap.add_argument("--no-csv", action="store_true")
+    a = ap.parse_args(argv)
+    eda(a.data, a.plots_dir, None if a.no_csv else "data/processed_data.csv")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,125 @@
+"""Offline evaluation: confusion matrix, classification report, plots.
+
+Rebuild of evaluate_model.py:1-63 — prints the report and renders
+``plots/confusion_matrix.png`` + ``plots/roc_curve.png`` (AUC in the
+legend) — with the metrics computed on device. The test split is
+recomputed deterministically from the data CSV (same seed as train.py)
+instead of the reference's preprocessed-npz handoff.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.data.loader import load_creditcard_csv, stratified_split
+from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.ops.metrics import (
+    auc_roc,
+    binary_classification_report,
+    confusion_matrix,
+    roc_curve_points,
+)
+
+log = logging.getLogger("fraud_detection_tpu.evaluate")
+
+
+def _load_model(model_dir: str) -> FraudLogisticModel:
+    if os.path.exists(os.path.join(model_dir, "model.npz")):
+        return FraudLogisticModel.load(model_dir)
+    return FraudLogisticModel.load_joblib(
+        os.path.join(model_dir, "logistic_model.joblib"),
+        os.path.join(model_dir, "scaler.joblib"),
+        os.path.join(model_dir, "feature_names.json"),
+    )
+
+
+def evaluate(
+    data_csv: str | None = None,
+    model_dir: str = "models",
+    plots_dir: str = "plots",
+    seed: int = 42,
+    threshold: float = 0.5,
+) -> dict:
+    data_csv = data_csv or config.data_csv()
+    x, y, _ = load_creditcard_csv(data_csv)
+    _, test_idx = stratified_split(y, 0.2, seed)
+    x_test, y_test = x[test_idx], y[test_idx]
+
+    model = _load_model(model_dir)
+    scores = model.scorer.predict_proba(x_test)
+    pred = (scores >= threshold).astype(np.int32)
+
+    cm = np.asarray(confusion_matrix(y_test, pred)).astype(int)
+    report = binary_classification_report(y_test, pred)
+    auc = float(auc_roc(scores, y_test))
+
+    print("Confusion matrix [[tn fp] [fn tp]]:")
+    print(cm)
+    print("\nClassification report:")
+    for cls in ("0", "1"):
+        r = report[cls]
+        print(
+            f"  class {cls}: precision {r['precision']:.3f} recall {r['recall']:.3f} "
+            f"f1 {r['f1-score']:.3f} support {int(r['support'])}"
+        )
+    print(f"  accuracy {report['accuracy']:.4f}")
+    print(f"\nAUC-ROC: {auc:.4f}")
+
+    os.makedirs(plots_dir, exist_ok=True)
+    _render_plots(cm, scores, y_test, auc, plots_dir)
+    return {"auc": auc, "confusion_matrix": cm.tolist(), "report": report}
+
+
+def _render_plots(cm, scores, y_test, auc, plots_dir: str) -> None:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(5, 4))
+    im = ax.imshow(cm, cmap="Blues")
+    for (i, j), v in np.ndenumerate(cm):
+        ax.text(j, i, f"{v:,}", ha="center", va="center",
+                color="white" if v > cm.max() / 2 else "black")
+    ax.set_xlabel("Predicted")
+    ax.set_ylabel("Actual")
+    ax.set_xticks([0, 1])
+    ax.set_yticks([0, 1])
+    ax.set_title("Confusion Matrix")
+    fig.colorbar(im)
+    fig.tight_layout()
+    fig.savefig(os.path.join(plots_dir, "confusion_matrix.png"), dpi=120)
+    plt.close(fig)
+
+    fpr, tpr, _ = roc_curve_points(scores, y_test, num_thresholds=400)
+    fig, ax = plt.subplots(figsize=(5, 4))
+    ax.plot(np.asarray(fpr), np.asarray(tpr), label=f"ROC (AUC = {auc:.4f})")
+    ax.plot([0, 1], [0, 1], "k--", lw=0.8)
+    ax.set_xlabel("False positive rate")
+    ax.set_ylabel("True positive rate")
+    ax.set_title("ROC curve")
+    ax.legend(loc="lower right")
+    fig.tight_layout()
+    fig.savefig(os.path.join(plots_dir, "roc_curve.png"), dpi=120)
+    plt.close(fig)
+    log.info("plots written to %s/", plots_dir)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--model-dir", default="models")
+    ap.add_argument("--plots-dir", default="plots")
+    ap.add_argument("--seed", type=int, default=42)
+    a = ap.parse_args(argv)
+    evaluate(a.data, a.model_dir, a.plots_dir, a.seed)
+
+
+if __name__ == "__main__":
+    main()

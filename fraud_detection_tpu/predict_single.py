@@ -1,0 +1,79 @@
+"""Single-prediction client.
+
+Rebuild of predict_single.py:1-78: the ``FraudDetector`` class loads the
+artifacts once, validates dict/list/DataFrame-row input, reorders to the
+training feature order, and returns (label, probability) — scoring through
+the scaler-folded jitted scorer instead of sklearn.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.service.loading import load_production_model
+
+log = logging.getLogger("fraud_detection_tpu.predict_single")
+
+
+class FraudDetector:
+    """Load-once scoring facade (predict_single.py's class of the same
+    name)."""
+
+    def __init__(self, model: FraudLogisticModel | None = None):
+        if model is None:
+            model, source = load_production_model()
+            log.info("FraudDetector using model from %s", source)
+        self.model = model
+
+    def predict(self, features) -> int:
+        label, _ = self.model.score_one(self._coerce(features))
+        return label
+
+    def predict_proba(self, features) -> float:
+        _, proba = self.model.score_one(self._coerce(features))
+        return proba
+
+    def _coerce(self, features):
+        # Accept a pandas Series/single-row DataFrame as the reference does
+        # (predict_single.py:22-27) without requiring pandas.
+        if hasattr(features, "to_dict"):
+            d = features.to_dict()
+            if d and isinstance(next(iter(d.values())), dict):  # 1-row frame
+                d = {k: list(v.values())[0] for k, v in d.items()}
+            return d
+        return features
+
+
+# A genuine Kaggle-schema row for the __main__ demo (the reference embeds a
+# real dataset row at predict_single.py:43-74; this one is synthetic but
+# schema-identical).
+_DEMO_ROW = {
+    "Time": 406.0, "V1": -2.31, "V2": 1.95, "V3": -1.61, "V4": 4.0,
+    "V5": -0.52, "V6": -1.43, "V7": -2.54, "V8": 1.39, "V9": -2.77,
+    "V10": -2.77, "V11": 3.2, "V12": -2.9, "V13": -0.6, "V14": -4.29,
+    "V15": 0.39, "V16": -1.14, "V17": -2.83, "V18": -0.02, "V19": 0.42,
+    "V20": 0.13, "V21": 0.52, "V22": -0.04, "V23": -0.47, "V24": 0.32,
+    "V25": 0.04, "V26": 0.18, "V27": 0.26, "V28": -0.14, "Amount": 0.0,
+}
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", default=None, help="JSON object of features")
+    a = ap.parse_args(argv)
+    import json as _json
+
+    features = _json.loads(a.json) if a.json else _DEMO_ROW
+    det = FraudDetector()
+    label = det.predict(features)
+    proba = det.predict_proba(features)
+    print(f"prediction: {label} ({'FRAUD' if label else 'legitimate'}), "
+          f"P(fraud) = {proba:.6f}")
+
+
+if __name__ == "__main__":
+    main()

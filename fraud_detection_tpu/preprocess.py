@@ -1,0 +1,92 @@
+"""Standalone preprocessing pipeline (the reference's legacy/linear path).
+
+Mirrors preprocess.py:1-59's offline contract — produce
+``data/preprocessed_data.npz`` (X_res, y_res, X_test, y_test) plus scaler and
+feature-name artifacts — but with the train-only scaler fit (the reference's
+scale-before-split here was a leakage bug its own train_model.py fixed;
+SURVEY.md §2 component 2 note) and the numerics on device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+
+import jax
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.ckpt.checkpoint import export_joblib_artifacts, save_artifacts
+from fraud_detection_tpu.data.loader import load_creditcard_csv, stratified_split
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import scaler_fit, scaler_transform
+from fraud_detection_tpu.ops.smote import smote
+
+log = logging.getLogger("fraud_detection_tpu.preprocess")
+
+
+def preprocess(
+    data_csv: str | None = None,
+    out_npz: str = "data/preprocessed_data.npz",
+    models_dir: str = "models",
+    seed: int = 42,
+) -> dict:
+    data_csv = data_csv or config.data_csv()
+    x, y, feature_names = load_creditcard_csv(data_csv)
+    train_idx, test_idx = stratified_split(y, 0.2, seed)
+
+    scaler = scaler_fit(x[train_idx])
+    xs_train = scaler_transform(scaler, x[train_idx])
+    xs_test = np.asarray(scaler_transform(scaler, x[test_idx]))
+
+    x_res, y_res = smote(xs_train, y[train_idx], jax.random.key(seed))
+
+    os.makedirs(os.path.dirname(out_npz) or ".", exist_ok=True)
+    np.savez(
+        out_npz,
+        X_res=np.asarray(x_res),
+        y_res=np.asarray(y_res),
+        X_test=xs_test,
+        y_test=y[test_idx],
+    )
+
+    # Scaler + feature-name artifacts (preprocess.py:51-57's layout).
+    os.makedirs(models_dir, exist_ok=True)
+    placeholder = LogisticParams(
+        coef=np.zeros(len(feature_names), np.float32), intercept=np.float32(0)
+    )
+    try:
+        export_joblib_artifacts(models_dir, placeholder, scaler, feature_names,
+                                model_filename="_preprocess_placeholder.joblib")
+        os.remove(os.path.join(models_dir, "_preprocess_placeholder.joblib"))
+    except RuntimeError:
+        pass
+    with open(os.path.join(models_dir, "feature_names.json"), "w") as f:
+        json.dump(feature_names, f)
+
+    log.info(
+        "preprocessed: resampled %d rows (from %d), test %d rows → %s",
+        len(y_res), len(train_idx), len(test_idx), out_npz,
+    )
+    return {
+        "n_resampled": int(len(y_res)),
+        "n_test": int(len(test_idx)),
+        "out": out_npz,
+    }
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--out", default="data/preprocessed_data.npz")
+    ap.add_argument("--models-dir", default="models")
+    ap.add_argument("--seed", type=int, default=42)
+    a = ap.parse_args(argv)
+    print(preprocess(a.data, a.out, a.models_dir, a.seed))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Registry validation gate (CD promotion check).
+
+Rebuild of scripts/validate_auc.py:1-39: load the registered model by URI
+(default ``models:/fraud@prod``), score a self-generated synthetic set, log
+``auc_score`` + ``validation_pass`` to the tracking store, and exit nonzero
+below the threshold — the deploy-blocking check in the CD pipeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.data.synthetic import generate_synthetic_rows
+from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.ops.metrics import auc_roc
+from fraud_detection_tpu.tracking import TrackingClient
+
+log = logging.getLogger("fraud_detection_tpu.validate_auc")
+
+
+def validate_auc(
+    model_uri: str | None = None,
+    threshold: float | None = None,
+    n_samples: int = 5000,
+    seed: int = 7,
+) -> tuple[float, bool]:
+    model_uri = model_uri or f"models:/{config.model_name()}@{config.model_stage()}"
+    threshold = threshold if threshold is not None else config.auc_threshold()
+
+    client = TrackingClient()
+    art = client.registry.resolve(model_uri)
+    model = FraudLogisticModel.load(art)
+
+    x, y = generate_synthetic_rows(n_samples, fraud_ratio=0.05, seed=seed)
+    scores = model.scorer.predict_proba(x)
+    auc = float(auc_roc(scores, y))
+    passed = auc >= threshold
+
+    with client.start_run("model-validation") as run:
+        run.log_param("model_uri", model_uri)
+        run.log_metric("auc_score", auc)
+        run.set_tag("validation_pass", passed)
+
+    log.info("validation AUC %.4f (threshold %.2f) → %s",
+             auc, threshold, "PASS" if passed else "FAIL")
+    return auc, passed
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model-uri", default=None)
+    ap.add_argument("--threshold", type=float, default=None)
+    ap.add_argument("--samples", type=int, default=5000)
+    a = ap.parse_args(argv)
+    auc, passed = validate_auc(a.model_uri, a.threshold, a.samples)
+    print(f"auc={auc:.4f} pass={passed}")
+    if not passed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

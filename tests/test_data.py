@@ -62,6 +62,18 @@ def test_synthetic_chunked(tmp_path):
     assert np.all(np.diff(x[:, 0]) >= 0)  # chunk Time offsets keep order
 
 
+def test_fraud_signal_consistent_across_seeds():
+    """A model trained on one synthetic seed must separate another seed's
+    data (the validate_auc registry gate self-generates with its own seed)."""
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.metrics import roc_auc_score
+
+    x1, y1 = generate_synthetic_rows(4000, fraud_ratio=0.05, seed=5)
+    x2, y2 = generate_synthetic_rows(4000, fraud_ratio=0.05, seed=77)
+    m = LogisticRegression(max_iter=300).fit(x1[:, 1:29], y1)
+    assert roc_auc_score(y2, m.predict_proba(x2[:, 1:29])[:, 1]) > 0.95
+
+
 def test_synthetic_chunked_keeps_one_signal_direction(tmp_path):
     """Chunked generation must shift fraud rows along ONE direction, or
     multi-chunk datasets lose linear separability (10M benchmark config)."""

@@ -1,0 +1,113 @@
+"""Offline tool tests: preprocess, evaluate, explain, predict_single,
+validate_auc, eda — the reference's L2 scripts (SURVEY.md §2 components
+2-5, 16-17) driven end-to-end on synthetic data."""
+
+import os
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.data.synthetic import generate_synthetic_data
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """One synthetic dataset + trained model shared by the tool tests."""
+    tmp = tmp_path_factory.mktemp("tools")
+    csv = str(tmp / "data.csv")
+    generate_synthetic_data(csv, n_samples=3000, fraud_ratio=0.04, seed=3)
+    os.environ["MLFLOW_TRACKING_URI"] = f"file:{tmp}/mlruns"
+    os.environ["MLFLOW_AUC_THRESHOLD"] = "0.70"
+    from fraud_detection_tpu.train import train
+
+    out = str(tmp / "models")
+    metrics = train(data_csv=csv, n_folds=2, out_dir=out)
+    return tmp, csv, out, metrics
+
+
+def test_preprocess(trained, tmp_path):
+    from fraud_detection_tpu.preprocess import preprocess
+
+    _, csv, *_ = trained
+    out = str(tmp_path / "pre.npz")
+    res = preprocess(csv, out, str(tmp_path / "models"))
+    z = np.load(out)
+    assert set(z.files) == {"X_res", "y_res", "X_test", "y_test"}
+    # SMOTE balanced the resampled train set
+    assert (z["y_res"] == 1).sum() == (z["y_res"] == 0).sum()
+    assert z["X_test"].shape[0] == res["n_test"]
+
+
+def test_evaluate_writes_plots(trained, tmp_path):
+    from fraud_detection_tpu.evaluate import evaluate
+
+    _, csv, model_dir, _ = trained
+    plots = str(tmp_path / "plots")
+    res = evaluate(csv, model_dir, plots)
+    assert res["auc"] > 0.9
+    assert os.path.exists(os.path.join(plots, "confusion_matrix.png"))
+    assert os.path.exists(os.path.join(plots, "roc_curve.png"))
+
+
+def test_explain_writes_plots(trained, tmp_path):
+    from fraud_detection_tpu.explain import explain
+
+    _, csv, model_dir, _ = trained
+    plots = str(tmp_path / "plots")
+    res = explain(csv, model_dir, plots)
+    assert len(res["mean_abs_shap"]) == 10
+    assert os.path.exists(os.path.join(plots, "shap_summary.png"))
+    deps = [f for f in os.listdir(plots) if f.startswith("shap_dependence_")]
+    assert len(deps) == 3
+
+
+def test_predict_single(trained):
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+    from fraud_detection_tpu.predict_single import _DEMO_ROW, FraudDetector
+
+    _, _, model_dir, _ = trained
+    det = FraudDetector(FraudLogisticModel.load(model_dir))
+    label = det.predict(_DEMO_ROW)
+    proba = det.predict_proba(_DEMO_ROW)
+    assert label in (0, 1)
+    assert 0.0 <= proba <= 1.0
+    assert label == int(proba >= 0.5)
+
+
+def test_predict_single_accepts_series(trained):
+    import pandas as pd
+
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+    from fraud_detection_tpu.predict_single import _DEMO_ROW, FraudDetector
+
+    _, _, model_dir, _ = trained
+    det = FraudDetector(FraudLogisticModel.load(model_dir))
+    series = pd.Series(_DEMO_ROW)
+    assert det.predict(series) == det.predict(_DEMO_ROW)
+
+
+def test_validate_auc_gate(trained):
+    from fraud_detection_tpu.validate_auc import validate_auc
+
+    auc, passed = validate_auc(threshold=0.5, n_samples=2000)
+    assert 0.0 <= auc <= 1.0
+    # threshold above any possible AUC must fail
+    _, failed = validate_auc(threshold=1.01, n_samples=2000)
+    assert failed is False
+    assert passed is (auc >= 0.5)
+
+
+def test_eda(trained, tmp_path):
+    from fraud_detection_tpu.eda import eda
+
+    _, csv, *_ = trained
+    plots = str(tmp_path / "plots")
+    out_csv = str(tmp_path / "processed.csv")
+    res = eda(csv, plots, out_csv)
+    assert res["n_fraud"] > 0
+    assert os.path.exists(os.path.join(plots, "class_distribution.png"))
+    assert os.path.exists(os.path.join(plots, "amount_histogram.png"))
+    import pandas as pd
+
+    df = pd.read_csv(out_csv)
+    assert "scaled_amount" in df.columns and "Amount" not in df.columns
