@@ -1,0 +1,47 @@
+# Container image for the fraud-detection-tpu service tier.
+# One image, multiple roles (api / xai-worker / tools), selected by command —
+# same pattern as the reference deployment (its Dockerfile + compose roles).
+#
+# CPU serving works out of the box (JAX CPU wheel). For TPU nodes, swap the
+# base/wheel via the JAX_VARIANT build arg: `--build-arg JAX_VARIANT=tpu`
+# pulls the libtpu-enabled wheel; the code is identical either way
+# (DEVICE=tpu|cpu is runtime config).
+
+FROM python:3.12-slim
+
+ARG JAX_VARIANT=cpu
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    build-essential curl \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+
+COPY pyproject.toml ./
+COPY fraud_detection_tpu ./fraud_detection_tpu
+COPY bench.py __graft_entry__.py ./
+
+RUN pip install --no-cache-dir -U pip \
+    && if [ "$JAX_VARIANT" = "tpu" ]; then \
+         pip install --no-cache-dir "jax[tpu]>=0.8" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html; \
+       else \
+         pip install --no-cache-dir "jax>=0.8"; \
+       fi \
+    && pip install --no-cache-dir .[service,tools]
+
+# Non-root runtime user (reference Dockerfile:13-16 pattern).
+RUN useradd --create-home appuser && chown -R appuser /app
+USER appuser
+
+ENV PYTHONUNBUFFERED=1 \
+    DATABASE_URL=sqlite:////data/fraud.db \
+    CELERY_BROKER_URL=sqlite:////data/taskq.db \
+    MLFLOW_TRACKING_URI=file:/data/mlruns
+
+VOLUME /data
+EXPOSE 8000 8001
+
+# Migrations run at container start, then the role command (the reference's
+# run_migrations.sh entrypoint contract).
+ENTRYPOINT ["python", "-m", "fraud_detection_tpu.service.migrate"]
+CMD ["python", "-m", "fraud_detection_tpu.service.app", "--port", "8000"]

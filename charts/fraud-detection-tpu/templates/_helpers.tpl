@@ -1,0 +1,14 @@
+{{- define "fraud.name" -}}
+{{- .Chart.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "fraud.fullname" -}}
+{{- printf "%s-%s" .Release.Name (include "fraud.name" .) | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "fraud.labels" -}}
+app.kubernetes.io/name: {{ include "fraud.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}

@@ -81,7 +81,7 @@ class ResultsDB:
         # Worker writes while the API reads the same file: WAL lets readers
         # proceed during commits (same cross-process pattern as taskq.py).
         self._conn.execute("PRAGMA journal_mode=WAL")
-        self.migrate()
+        self.applied_at_init = self.migrate()
 
     # -- migrations --------------------------------------------------------
     def migrate(self) -> list[str]:
