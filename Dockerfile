@@ -29,8 +29,11 @@ RUN pip install --no-cache-dir -U pip \
        fi \
     && pip install --no-cache-dir .[service,tools]
 
-# Non-root runtime user (reference Dockerfile:13-16 pattern).
-RUN useradd --create-home appuser && chown -R appuser /app
+# Non-root runtime user (reference Dockerfile:13-16 pattern). /data must be
+# created and owned here: fresh volumes inherit the image mountpoint's
+# ownership, and the sqlite DBs live there.
+RUN useradd --create-home appuser && chown -R appuser /app \
+    && mkdir -p /data && chown appuser /data
 USER appuser
 
 ENV PYTHONUNBUFFERED=1 \

@@ -29,6 +29,16 @@ NATIVE_FILE = "model.npz"
 FEATURES_FILE = "feature_names.json"
 
 
+def artifact_kind(directory: str) -> str:
+    """``'logistic'`` | ``'gbt'`` | ``'absent'`` — dispatch key for loaders
+    (the serving path accepts either family from the registry)."""
+    path = os.path.join(directory, NATIVE_FILE)
+    if not os.path.exists(path):
+        return "absent"
+    with np.load(path) as z:
+        return "gbt" if "gbt_leaf_value" in z else "logistic"
+
+
 def save_artifacts(
     directory: str,
     params: LogisticParams,
@@ -57,6 +67,11 @@ def load_artifacts(
     directory: str,
 ) -> tuple[LogisticParams, ScalerParams | None, list[str]]:
     with np.load(os.path.join(directory, NATIVE_FILE)) as z:
+        if "coef" not in z:
+            raise ValueError(
+                f"{directory} holds {artifact_kind(directory)} artifacts, "
+                "not logistic"
+            )
         params = LogisticParams(
             coef=np.asarray(z["coef"], np.float32),
             intercept=np.asarray(z["intercept"], np.float32),
@@ -72,6 +87,59 @@ def load_artifacts(
     with open(os.path.join(directory, FEATURES_FILE)) as f:
         feature_names = json.load(f)
     return params, scaler, feature_names
+
+
+def save_gbt_artifacts(
+    directory: str,
+    model,
+    feature_names: list[str],
+    background: np.ndarray | None = None,
+) -> str:
+    """Persist a :class:`~fraud_detection_tpu.ops.gbt.GBTModel` forest (the
+    TPU-native analogue of the reference's ``xgb_model.joblib`` dump,
+    train_model.py:112-113). Same ``model.npz`` + ``feature_names.json``
+    layout as the logistic artifacts, keys prefixed ``gbt_``. ``background``
+    is an optional (m, d) raw-space sample for interventional TreeSHAP."""
+    os.makedirs(directory, exist_ok=True)
+    state = {
+        "gbt_split_feature": np.asarray(model.split_feature, np.int32),
+        "gbt_split_bin": np.asarray(model.split_bin, np.int32),
+        "gbt_leaf_value": np.asarray(model.leaf_value, np.float32),
+        "gbt_bin_edges": np.asarray(model.bin_edges, np.float32),
+        "gbt_base_logit": np.asarray(model.base_logit, np.float32),
+    }
+    if background is not None:
+        state["gbt_background"] = np.asarray(background, np.float32)
+    np.savez(os.path.join(directory, NATIVE_FILE), **state)
+    with open(os.path.join(directory, FEATURES_FILE), "w") as f:
+        json.dump(list(feature_names), f)
+    return directory
+
+
+def load_gbt_artifacts(directory: str):
+    """Inverse of :func:`save_gbt_artifacts`; returns (GBTModel, names,
+    background-or-None)."""
+    from fraud_detection_tpu.ops.gbt import GBTModel
+
+    with np.load(os.path.join(directory, NATIVE_FILE)) as z:
+        if "gbt_leaf_value" not in z:
+            raise ValueError(
+                f"{directory} holds {artifact_kind(directory)} artifacts, "
+                "not gbt"
+            )
+        model = GBTModel(
+            split_feature=np.asarray(z["gbt_split_feature"]),
+            split_bin=np.asarray(z["gbt_split_bin"]),
+            leaf_value=np.asarray(z["gbt_leaf_value"]),
+            bin_edges=np.asarray(z["gbt_bin_edges"]),
+            base_logit=np.asarray(z["gbt_base_logit"]),
+        )
+        background = (
+            np.asarray(z["gbt_background"]) if "gbt_background" in z else None
+        )
+    with open(os.path.join(directory, FEATURES_FILE)) as f:
+        feature_names = json.load(f)
+    return model, feature_names, background
 
 
 def export_joblib_artifacts(

@@ -17,6 +17,7 @@ import numpy as np
 
 from fraud_detection_tpu import config
 from fraud_detection_tpu.data.loader import load_creditcard_csv, stratified_split
+from fraud_detection_tpu.models import load_any_model
 from fraud_detection_tpu.models.logistic import FraudLogisticModel
 from fraud_detection_tpu.ops.metrics import (
     auc_roc,
@@ -28,9 +29,11 @@ from fraud_detection_tpu.ops.metrics import (
 log = logging.getLogger("fraud_detection_tpu.evaluate")
 
 
-def _load_model(model_dir: str) -> FraudLogisticModel:
+def _load_model(model_dir: str):
+    """Family-agnostic: native artifacts of either model family, else the
+    reference's joblib layout (logistic only)."""
     if os.path.exists(os.path.join(model_dir, "model.npz")):
-        return FraudLogisticModel.load(model_dir)
+        return load_any_model(model_dir)
     return FraudLogisticModel.load_joblib(
         os.path.join(model_dir, "logistic_model.joblib"),
         os.path.join(model_dir, "scaler.joblib"),

@@ -1,7 +1,8 @@
 """Offline SHAP explainability: summary + dependence plots.
 
-Rebuild of explain_model.py:1-49 — interventional linear SHAP over the test
-set, a summary (beeswarm-style) plot, and dependence plots for the top-3
+Rebuild of explain_model.py:1-49 — interventional SHAP over the test set
+(closed-form linear SHAP for the flagship, exact TreeSHAP for the GBT
+family), a summary (beeswarm-style) plot, and dependence plots for the top-3
 features by mean |SHAP| — with the attribution computed as one vmapped XLA
 call instead of the shap library's per-row loop.
 """
@@ -17,7 +18,6 @@ import numpy as np
 from fraud_detection_tpu import config
 from fraud_detection_tpu.data.loader import load_creditcard_csv, stratified_split
 from fraud_detection_tpu.evaluate import _load_model
-from fraud_detection_tpu.ops.linear_shap import linear_shap
 
 log = logging.getLogger("fraud_detection_tpu.explain")
 
@@ -35,8 +35,8 @@ def explain(
     x_test = x[test_idx][:max_rows]
 
     model = _load_model(model_dir)
-    explainer = model.raw_explainer()
-    phi = np.asarray(linear_shap(explainer, x_test))  # (n, d), one device call
+    # Family-agnostic: closed-form linear SHAP or TreeSHAP, one device call.
+    phi, _ = model.explain_batch(x_test)
 
     mean_abs = np.abs(phi).mean(axis=0)
     order = np.argsort(mean_abs)[::-1]

@@ -1,3 +1,15 @@
 """High-level model classes tying together params, scaler, and metadata."""
 
+from fraud_detection_tpu.models.gbt import FraudGBTModel  # noqa: F401
 from fraud_detection_tpu.models.logistic import FraudLogisticModel  # noqa: F401
+
+
+def load_any_model(directory: str):
+    """Load whichever model family the artifact directory holds (the serving
+    path is family-agnostic — SURVEY.md §2.3.1's model drift, resolved)."""
+    from fraud_detection_tpu.ckpt.checkpoint import artifact_kind
+
+    kind = artifact_kind(directory)
+    if kind == "gbt":
+        return FraudGBTModel.load(directory)
+    return FraudLogisticModel.load(directory)

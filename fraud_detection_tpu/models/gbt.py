@@ -1,0 +1,75 @@
+"""Gradient-boosted-trees fraud model.
+
+The TPU-native stand-in for the reference's ``XGBClassifier`` artifact
+(train_model.py:95-113): a fitted :class:`~fraud_detection_tpu.ops.gbt.
+GBTModel` forest + the frozen feature order, sharing the family-agnostic
+estimator surface (:class:`~fraud_detection_tpu.models.base.FraudModelBase`)
+so the serving app, worker, and offline tools treat both families alike.
+
+The scaler is folded into the bin edges at construction
+(:func:`~fraud_detection_tpu.ops.gbt.fold_scaler_into_gbt`), so like the
+linear model this one scores *raw* inputs with zero preprocessing launches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fraud_detection_tpu.ckpt.checkpoint import (
+    load_gbt_artifacts,
+    save_gbt_artifacts,
+)
+from fraud_detection_tpu.models.base import FraudModelBase
+from fraud_detection_tpu.ops.gbt import GBTModel, fold_scaler_into_gbt
+from fraud_detection_tpu.ops.scorer import GBTBatchScorer
+
+
+class FraudGBTModel(FraudModelBase):
+    def __init__(
+        self,
+        model: GBTModel,
+        feature_names: list[str],
+        scaler=None,
+        background: np.ndarray | None = None,
+    ):
+        if scaler is not None:
+            model = fold_scaler_into_gbt(model, scaler)
+        self.model = model
+        self.feature_names = list(feature_names)
+        self.background = background  # raw-space sample for TreeSHAP
+        self._scorer = GBTBatchScorer(model)
+        self._raw_explainer = None
+
+    # -- explainability ----------------------------------------------------
+    def raw_explainer(self):
+        """Exact interventional TreeSHAP over the forest (ops/tree_shap),
+        taking raw inputs — same role as the linear model's closed-form SHAP
+        explainer. Background: the stored training sample, or a single
+        all-zeros row when absent (the legacy reference worker's zero
+        background, api/worker.py:52-53). Built once and cached."""
+        if self._raw_explainer is None:
+            from fraud_detection_tpu.ops.tree_shap import build_tree_explainer
+
+            bg = self.background
+            if bg is None:
+                bg = np.zeros((1, len(self.feature_names)), np.float32)
+            self._raw_explainer = build_tree_explainer(self.model, bg)
+        return self._raw_explainer
+
+    def explain_batch(self, x: np.ndarray) -> tuple[np.ndarray, float]:
+        from fraud_detection_tpu.ops.tree_shap import tree_shap
+
+        explainer = self.raw_explainer()
+        phi = np.asarray(tree_shap(explainer, np.asarray(x, np.float32)))
+        return phi, float(explainer.expected_value)
+
+    # -- persistence -------------------------------------------------------
+    def save(self, directory: str) -> str:
+        return save_gbt_artifacts(
+            directory, self.model, self.feature_names, self.background
+        )
+
+    @classmethod
+    def load(cls, directory: str) -> "FraudGBTModel":
+        model, feature_names, background = load_gbt_artifacts(directory)
+        return cls(model, feature_names, background=background)

@@ -16,13 +16,18 @@ from fraud_detection_tpu.ckpt.checkpoint import (
     load_artifacts,
     save_artifacts,
 )
-from fraud_detection_tpu.ops.linear_shap import LinearShapExplainer, make_explainer
+from fraud_detection_tpu.models.base import FraudModelBase
+from fraud_detection_tpu.ops.linear_shap import (
+    LinearShapExplainer,
+    linear_shap,
+    make_explainer,
+)
 from fraud_detection_tpu.ops.logistic import LogisticParams
 from fraud_detection_tpu.ops.scaler import ScalerParams
 from fraud_detection_tpu.ops.scorer import BatchScorer
 
 
-class FraudLogisticModel:
+class FraudLogisticModel(FraudModelBase):
     def __init__(
         self,
         params: LogisticParams,
@@ -33,41 +38,7 @@ class FraudLogisticModel:
         self.scaler = scaler
         self.feature_names = list(feature_names)
         self._scorer = BatchScorer(params, scaler)
-
-    # -- scoring (raw, unscaled inputs) ------------------------------------
-    @property
-    def scorer(self) -> BatchScorer:
-        return self._scorer
-
-    def predict_proba(self, x: np.ndarray) -> np.ndarray:
-        """(n, 2) array [P(0), P(1)] like sklearn."""
-        p1 = self._scorer.predict_proba(x)
-        return np.stack([1.0 - p1, p1], axis=1)
-
-    def predict(self, x: np.ndarray, threshold: float = 0.5) -> np.ndarray:
-        return self._scorer.predict(x, threshold)
-
-    def score_one(self, features: dict | list) -> tuple[int, float]:
-        """Validate + order one row by feature name, return (label, P(1))."""
-        row = self.prepare_row(features)
-        p = float(self._scorer.predict_proba(row[None, :])[0])
-        return int(p >= 0.5), p
-
-    def prepare_row(self, features: dict | list) -> np.ndarray:
-        """Reorder dict input to training feature order; validate arity
-        (reference predict_single.py:22, api/app.py:185-192)."""
-        if isinstance(features, dict):
-            missing = [n for n in self.feature_names if n not in features]
-            if missing:
-                raise ValueError(f"missing features: {missing[:5]}")
-            vals = [float(features[n]) for n in self.feature_names]
-        else:
-            vals = [float(v) for v in features]
-            if len(vals) != len(self.feature_names):
-                raise ValueError(
-                    f"expected {len(self.feature_names)} features, got {len(vals)}"
-                )
-        return np.asarray(vals, dtype=np.float32)
+        self._raw_explainer = None
 
     # -- explainability ----------------------------------------------------
     def explainer(self, background_mean=None) -> LinearShapExplainer:
@@ -80,16 +51,26 @@ class FraudLogisticModel:
 
     def raw_explainer(self) -> LinearShapExplainer:
         """SHAP explainer taking *raw* inputs: scaler folded into the coef,
-        background mean = scaler mean (equivalent attributions)."""
-        from fraud_detection_tpu.ops.scorer import fold_scaler_into_linear
+        background mean = scaler mean (equivalent attributions). Built once
+        and cached — the worker explains per task with no rebuild."""
+        if self._raw_explainer is None:
+            from fraud_detection_tpu.ops.scorer import fold_scaler_into_linear
 
-        folded = fold_scaler_into_linear(self.params, self.scaler)
-        mu = (
-            np.asarray(self.scaler.mean)
-            if self.scaler is not None
-            else np.zeros_like(np.asarray(folded.coef))
-        )
-        return make_explainer(folded.coef, folded.intercept, background_mean=mu)
+            folded = fold_scaler_into_linear(self.params, self.scaler)
+            mu = (
+                np.asarray(self.scaler.mean)
+                if self.scaler is not None
+                else np.zeros_like(np.asarray(folded.coef))
+            )
+            self._raw_explainer = make_explainer(
+                folded.coef, folded.intercept, background_mean=mu
+            )
+        return self._raw_explainer
+
+    def explain_batch(self, x: np.ndarray) -> tuple[np.ndarray, float]:
+        explainer = self.raw_explainer()
+        phi = np.asarray(linear_shap(explainer, np.asarray(x, np.float32)))
+        return phi, float(explainer.expected_value)
 
     # -- persistence -------------------------------------------------------
     def save(self, directory: str, joblib_too: bool = True) -> str:

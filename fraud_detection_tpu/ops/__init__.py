@@ -29,6 +29,19 @@ from fraud_detection_tpu.ops.linear_shap import (  # noqa: F401
     linear_shap_single,
 )
 from fraud_detection_tpu.ops.smote import smote  # noqa: F401
+from fraud_detection_tpu.ops.gbt import (  # noqa: F401
+    GBTConfig,
+    GBTModel,
+    gbt_fit,
+    gbt_predict_logits,
+    gbt_predict_proba,
+)
+from fraud_detection_tpu.ops.tree_shap import (  # noqa: F401
+    TreeShapExplainer,
+    build_tree_explainer,
+    tree_shap,
+    tree_shap_single,
+)
 from fraud_detection_tpu.ops.scorer import (  # noqa: F401
     BatchScorer,
     fold_scaler_into_linear,

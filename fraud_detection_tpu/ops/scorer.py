@@ -51,25 +51,21 @@ def _bucket(n: int, min_bucket: int = 8) -> int:
     return b
 
 
-class BatchScorer:
-    """Pads to shape buckets and scores on device.
+class _BucketedScorer:
+    """Shared serving mechanics: pad request batches up to power-of-two shape
+    buckets (one cached XLA executable per bucket) and score on device.
 
     Thread-safe for concurrent callers (JAX dispatch is); the async
     micro-batching queue in :mod:`fraud_detection_tpu.service.microbatch`
-    sits in front of this for the online path.
+    sits in front of this for the online path. Subclasses provide
+    ``n_features`` and ``_score_padded``.
     """
 
-    def __init__(
-        self,
-        params: LogisticParams,
-        scaler: ScalerParams | None = None,
-        min_bucket: int = 8,
-    ):
-        folded = fold_scaler_into_linear(params, scaler)
-        self.coef = jnp.asarray(folded.coef, dtype=jnp.float32)
-        self.intercept = jnp.asarray(folded.intercept, dtype=jnp.float32)
-        self.n_features = int(self.coef.shape[0])
-        self.min_bucket = min_bucket
+    min_bucket: int
+    n_features: int
+
+    def _score_padded(self, x: jax.Array) -> jax.Array:
+        raise NotImplementedError
 
     def warmup(self, max_bucket: int = 4096) -> None:
         """Pre-compile the bucket ladder so first requests don't pay XLA
@@ -87,8 +83,45 @@ class BatchScorer:
         b = _bucket(n, self.min_bucket)
         if b != n:
             x = np.concatenate([x, np.zeros((b - n, x.shape[1]), np.float32)])
-        out = _score(self.coef, self.intercept, jnp.asarray(x))
-        return np.asarray(out)[:n]
+        return np.asarray(self._score_padded(jnp.asarray(x)))[:n]
 
     def predict(self, x: np.ndarray, threshold: float = 0.5) -> np.ndarray:
         return (self.predict_proba(x) >= threshold).astype(np.int64)
+
+
+class BatchScorer(_BucketedScorer):
+    """Scaler-folded linear scorer: one GEMV + sigmoid per bucket."""
+
+    def __init__(
+        self,
+        params: LogisticParams,
+        scaler: ScalerParams | None = None,
+        min_bucket: int = 8,
+    ):
+        folded = fold_scaler_into_linear(params, scaler)
+        self.coef = jnp.asarray(folded.coef, dtype=jnp.float32)
+        self.intercept = jnp.asarray(folded.intercept, dtype=jnp.float32)
+        self.n_features = int(self.coef.shape[0])
+        self.min_bucket = min_bucket
+
+    def _score_padded(self, x: jax.Array) -> jax.Array:
+        return _score(self.coef, self.intercept, x)
+
+
+class GBTBatchScorer(_BucketedScorer):
+    """Forest scorer over a :class:`~fraud_detection_tpu.ops.gbt.GBTModel` —
+    same protocol as :class:`BatchScorer` so the micro-batcher and serving
+    path are model-family agnostic. Expects a model whose bin edges are
+    already in raw input space (``fold_scaler_into_gbt``), mirroring the
+    linear scaler fold."""
+
+    def __init__(self, model, min_bucket: int = 8):
+        from fraud_detection_tpu.ops.gbt import gbt_predict_proba
+
+        self._model = model
+        self._predict = gbt_predict_proba
+        self.n_features = int(model.bin_edges.shape[0])
+        self.min_bucket = min_bucket
+
+    def _score_padded(self, x: jax.Array) -> jax.Array:
+        return self._predict(self._model, x)

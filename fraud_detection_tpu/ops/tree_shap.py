@@ -1,0 +1,227 @@
+"""Exact interventional TreeSHAP for the static-depth GBT forest.
+
+The GBT analogue of :mod:`fraud_detection_tpu.ops.linear_shap` — the role
+``shap.TreeExplainer`` would play for the reference's XGBoost model (the
+reference never explains its tree model in serving; its SHAP paths are
+linear-only: explain_model.py:24, api/worker.py:52-53. This closes that gap
+for the TPU framework's GBT family).
+
+Algorithm — designed around the forest's *perfect static-depth* layout
+(ops/gbt.py) rather than translated from shap's C recursion:
+
+The forest is a sum of leaf indicators, ``f(x) = base + Σ_t Σ_l v_{tl} ·
+1[x reaches leaf l of tree t]``, and Shapley values are linear in the game,
+so it suffices to explain each leaf indicator. A leaf's indicator is a
+conjunction of ``depth`` threshold conditions (one per ancestor level), so
+its interventional value function for feature subset S,
+
+    v(S) = E_b[ 1{path}(x_S ∪ b_{S̄}) ]  over the background set b,
+
+depends only on the ≤depth distinct features on the path. We enumerate the
+``2^depth`` subsets of *levels* as static bitmasks; levels sharing a feature
+are slaved to the first occurrence (``dup``), which makes every enumerated
+subset feature-consistent by construction. Two factorizations make this
+cheap:
+
+- the background factor ``E_b ∏_{k∉σ} c_k(b)`` is independent of the
+  explained row → precomputed once per explainer as ``bg_table[t, l, mask]``;
+- the foreground factor ``∏_{k∈σ} c_k(x)`` is a static masked product.
+
+Shapley values then follow from the subset-marginal formula with weights
+``|S|!(u−|S|−1)!/u!`` over the ``u ≤ depth`` distinct path features. Exact
+(verified against brute-force subset enumeration in tests), no sampling, and
+every step is a static-shape XLA program: ``scan`` over trees, ``vmap`` over
+rows, gathers/products over (leaf, mask, level) axes.
+
+Complexity per explained row: O(trees · 2^depth · 2^depth · depth), ~1.6M
+flops for the reference recipe (100 trees, depth 5) — microseconds on MXU;
+the background table build is O(trees · 2^depth · 2^depth · depth · |bg|)
+once.
+"""
+
+from __future__ import annotations
+
+from math import factorial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fraud_detection_tpu.ops.gbt import GBTModel, bin_features
+
+
+class TreeShapExplainer(NamedTuple):
+    model: GBTModel
+    bg_table: jax.Array        # (n_trees, n_leaves, n_masks) — E_b factors
+    expected_value: jax.Array  # () — E_b[f(b)], margin space
+
+
+def _tree_static(depth: int):
+    """Static path structure of a perfect binary tree: ancestor internal-node
+    index and go-right direction per (leaf, level), plus the level-subset
+    bitmask table."""
+    n_leaves = 2**depth
+    anc = np.zeros((n_leaves, depth), np.int32)
+    direc = np.zeros((n_leaves, depth), np.int32)
+    for leaf in range(n_leaves):
+        node = 0
+        for j in range(depth):
+            d = (leaf >> (depth - 1 - j)) & 1
+            anc[leaf, j] = node
+            direc[leaf, j] = d
+            node = 2 * node + 1 + d
+    masks = 2**depth
+    bits = ((np.arange(masks)[:, None] >> np.arange(depth)[None, :]) & 1).astype(
+        bool
+    )
+    pair = np.arange(masks)[:, None] | (1 << np.arange(depth))[None, :]
+    return anc, direc, bits, pair.astype(np.int32)
+
+
+def _shapley_weights(depth: int) -> np.ndarray:
+    """W[u, s] = s!(u−1−s)!/u! — marginal-contribution weight when adding a
+    player to an s-subset of a u-player game."""
+    w = np.zeros((depth + 1, depth), np.float64)
+    for u in range(1, depth + 1):
+        for s in range(u):
+            w[u, s] = factorial(s) * factorial(u - 1 - s) / factorial(u)
+    return w
+
+
+def _path_conditions(binned, feat, thr, direc):
+    """Per-(row, leaf, level) truth of the path condition.
+
+    ``binned``: (..., d) ints; ``feat``/``thr``: (leaves, depth);
+    right child means ``bin > thr``, left means ``bin <= thr``.
+    """
+    gathered = binned[..., feat]  # (..., leaves, depth)
+    return (gathered > thr) == (direc == 1)
+
+
+def _dup_structure(feat):
+    """For each (leaf, level k): index of the first level with the same
+    feature (``dup``), whether k is that first occurrence (``canonical``),
+    and the distinct-feature count u per leaf."""
+    depth = feat.shape[1]
+    eq = feat[:, :, None] == feat[:, None, :]       # (leaves, k, j)
+    dup = jnp.argmax(eq, axis=2).astype(jnp.int32)  # first j with equal feat
+    canonical = dup == jnp.arange(depth)[None, :]
+    u = canonical.sum(axis=1)                       # (leaves,)
+    return dup, canonical, u
+
+
+def build_tree_explainer(
+    model: GBTModel, background_x, max_background: int = 128, seed: int = 0
+) -> TreeShapExplainer:
+    """Precompute the background expectation table over a (subsampled)
+    background set, in the model's input space (raw if the model's edges are
+    scaler-folded)."""
+    bg = np.asarray(background_x, np.float32)
+    if bg.ndim == 1:
+        bg = bg[None, :]
+    if bg.shape[0] > max_background:
+        idx = np.random.default_rng(seed).choice(
+            bg.shape[0], max_background, replace=False
+        )
+        bg = bg[idx]
+
+    depth = int(np.log2(model.split_feature.shape[1] + 1))
+    anc, direc, bits, _ = _tree_static(depth)
+    binned_bg = bin_features(jnp.asarray(bg), model.bin_edges)  # (bg, d)
+
+    def per_tree(carry, tree):
+        feat_nodes, thr_nodes, leaf_value = tree
+        feat = feat_nodes[anc]  # (leaves, depth)
+        thr = thr_nodes[anc]
+        dup, _, _ = _dup_structure(feat)
+        cb = _path_conditions(binned_bg, feat, thr, direc)
+        # (bg, leaves, depth) — condition truth per background row
+        bitdup = jnp.asarray(bits)[:, dup]  # (masks, leaves, depth)
+        selb = jnp.where(bitdup[None], True, cb[:, None])
+        bg_t = jnp.mean(
+            jnp.all(selb, axis=3).astype(jnp.float32), axis=0
+        )  # (masks, leaves)
+        bg_t = bg_t.T  # (leaves, masks)
+        ev_t = jnp.sum(leaf_value * bg_t[:, 0])  # mask 0 ⇒ all-background
+        return carry + ev_t, bg_t
+
+    ev, bg_table = jax.lax.scan(
+        per_tree,
+        model.base_logit.astype(jnp.float32),
+        (model.split_feature, model.split_bin, model.leaf_value),
+    )
+    return TreeShapExplainer(model=model, bg_table=bg_table, expected_value=ev)
+
+
+@jax.jit
+def tree_shap(explainer: TreeShapExplainer, x: jax.Array) -> jax.Array:
+    """SHAP values (n, d) in margin (logit) space; exact:
+    ``Σ_j φ_j + expected_value == gbt_predict_logits(model, x)``."""
+    model = explainer.model
+    d_features = model.bin_edges.shape[0]
+    depth = int(np.log2(model.split_feature.shape[1] + 1))
+    anc, direc, bits_np, pair_np = _tree_static(depth)
+    bits = jnp.asarray(bits_np)                      # (masks, depth)
+    pair = jnp.asarray(pair_np)                      # (masks, depth)
+    size = jnp.sum(bits, axis=1)                     # (masks,)
+    wtab = jnp.asarray(_shapley_weights(depth), jnp.float32)
+
+    binned = bin_features(x.astype(jnp.float32), model.bin_edges)  # (n, d)
+
+    def per_row(bx):
+        def per_tree(phi, tree):
+            feat_nodes, thr_nodes, leaf_value, bg_t = tree
+            feat = feat_nodes[anc]                   # (leaves, depth)
+            thr = thr_nodes[anc]
+            dup, canonical, u = _dup_structure(feat)
+            cx = _path_conditions(bx, feat, thr, direc)  # (leaves, depth)
+            bitdup = bits[:, dup]                    # (masks, leaves, depth)
+            cxsel = jnp.all(
+                jnp.where(bitdup, cx[None], True), axis=2
+            )                                        # (masks, leaves)
+            v = cxsel.astype(jnp.float32) * bg_t.T   # (masks, leaves)
+
+            # A mask is a feature subset iff every non-canonical bit is 0.
+            valid = jnp.all(
+                canonical[None, :, :] | ~bits[:, None, :], axis=2
+            )                                        # (masks, leaves)
+            # Marginal contribution of canonical level k on leaf l:
+            # Σ_m W[u, |m|] · (V[m ∪ {k}] − V[m]) over valid m with k ∉ m.
+            v_pair = v[pair]                         # (masks, depth, leaves)
+            delta = v_pair - v[:, None, :]           # (masks, depth, leaves)
+            w = wtab[u[None, None, :], size[:, None, None]]
+            include = (
+                valid[:, None, :]
+                & ~bits[:, :, None]
+                & canonical.T[None, :, :]
+            )                                        # (masks, depth, leaves)
+            contrib = jnp.sum(
+                jnp.where(include, w * delta, 0.0), axis=0
+            )                                        # (depth, leaves)
+            scaled = contrib.T * leaf_value[:, None]  # (leaves, depth)
+            phi_t = jax.ops.segment_sum(
+                scaled.reshape(-1), feat.reshape(-1), num_segments=d_features
+            )
+            return phi + phi_t, None
+
+        phi0 = jnp.zeros((d_features,), jnp.float32)
+        phi, _ = jax.lax.scan(
+            per_tree,
+            phi0,
+            (
+                model.split_feature,
+                model.split_bin,
+                model.leaf_value,
+                explainer.bg_table,
+            ),
+        )
+        return phi
+
+    return jax.vmap(per_row)(binned)
+
+
+@jax.jit
+def tree_shap_single(explainer: TreeShapExplainer, x: jax.Array) -> jax.Array:
+    """SHAP values (d,) for one row."""
+    return tree_shap(explainer, x[None, :])[0]

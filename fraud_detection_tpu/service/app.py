@@ -97,7 +97,9 @@ def create_app(
             batcher = MicroBatcher(model.scorer)
             await batcher.start()
             state["batcher"] = batcher
+            metrics.model_loaded.set(1)
         except RuntimeError as e:
+            metrics.model_loaded.set(0)
             log.error("model load failed at startup: %s", e)
 
     async def shutdown():
@@ -206,6 +208,14 @@ def create_app(
 
     @app.get("/metrics")
     async def prom(req: Request) -> Response:
+        # The API refreshes the queue-depth gauge at scrape time so the KEDA
+        # scaling signal survives worker scale-to-zero (workers can't export
+        # a gauge while there are zero workers).
+        if state["broker"]:
+            try:
+                metrics.queue_depth.set(state["broker"].depth())
+            except Exception:  # scrape must not fail on a down broker
+                log.debug("queue depth refresh failed", exc_info=True)
         return Response(
             metrics.render(), media_type=metrics.CONTENT_TYPE_LATEST
         )

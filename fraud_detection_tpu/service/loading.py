@@ -19,6 +19,7 @@ import logging
 import os
 
 from fraud_detection_tpu import config
+from fraud_detection_tpu.models import load_any_model
 from fraud_detection_tpu.models.logistic import FraudLogisticModel
 from fraud_detection_tpu.tracking import TrackingClient
 
@@ -31,7 +32,7 @@ def load_production_model() -> tuple[FraudLogisticModel, str]:
     uri = f"models:/{config.model_name()}@{config.model_stage()}"
     try:
         art = TrackingClient().registry.resolve(uri)
-        model = FraudLogisticModel.load(art)
+        model = load_any_model(art)
         log.info("loaded model from registry %s (%s)", uri, art)
         return model, f"registry:{uri}"
     except (FileNotFoundError, ValueError) as e:
@@ -41,7 +42,7 @@ def load_production_model() -> tuple[FraudLogisticModel, str]:
     model_dir = os.path.dirname(config.model_path()) or "."
     native = os.path.join(model_dir, "model.npz")
     if os.path.exists(native):
-        model = FraudLogisticModel.load(model_dir)
+        model = load_any_model(model_dir)
         log.info("loaded native artifacts from %s", model_dir)
         return model, f"native:{model_dir}"
 

@@ -66,6 +66,11 @@ xai_task_failures = Counter(
 queue_depth = Gauge(
     "xai_queue_depth", "Queued XAI tasks (KEDA scaling signal)", registry=registry
 )
+model_loaded = Gauge(
+    "model_loaded",
+    "1 when a servable model is loaded (ModelUnavailable alert signal)",
+    registry=registry,
+)
 
 # Micro-batcher telemetry (no reference counterpart)
 microbatch_size = Histogram(

@@ -3,8 +3,9 @@
 Unified rebuild of the reference's two parallel workers (xai_tasks.py —
 deployed, wrong attribution formula, wrote ``transaction_results``;
 api/worker.py — legacy, real SHAP, wrote ``shap_explanations``; SURVEY.md
-§2.3.2-3). One worker, one table, the *correct* closed-form interventional
-linear SHAP (coef·(x−μ)) computed as a vmapped XLA call.
+§2.3.2-3). One worker, one table, the *correct* interventional SHAP — the
+closed form (coef·(x−μ)) for the linear family, exact TreeSHAP for the GBT
+family — via the model's family-agnostic ``explain_one`` surface.
 
 Semantics preserved from the reference:
 
@@ -29,7 +30,6 @@ import uuid
 import numpy as np
 
 from fraud_detection_tpu import config
-from fraud_detection_tpu.ops.linear_shap import linear_shap_single
 from fraud_detection_tpu.service import metrics
 from fraud_detection_tpu.service.db import ResultsDB
 from fraud_detection_tpu.service.loading import load_production_model
@@ -56,7 +56,10 @@ class XaiWorker:
         self.poll_interval = poll_interval
         self._stop = threading.Event()
         self.model, source = load_production_model()
-        self.explainer = self.model.raw_explainer()
+        self.model.raw_explainer()  # build + cache at startup, not per task
+        # Workers export the shared registry on :8001 — the gauge must be
+        # truthful here too or the ModelUnavailable alert fires from workers.
+        metrics.model_loaded.set(1)
         log.info("worker %s up; model from %s", self.worker_id, source)
 
     # -- task bodies -------------------------------------------------------
@@ -66,12 +69,12 @@ class XaiWorker:
         with span("compute_shap", correlation_id=correlation_id or ""):
             row = self.model.prepare_row(input_data)
             score = float(self.model.scorer.predict_proba(row[None, :])[0])
-            phi = np.asarray(linear_shap_single(self.explainer, row))
+            phi, expected_value = self.model.explain_one(row)
             shap_values = dict(zip(self.model.feature_names, phi.astype(float)))
             self.db.complete(
                 transaction_id,
                 shap_values,
-                float(self.explainer.expected_value),
+                expected_value,
                 score,
             )
         log.info(

@@ -21,6 +21,7 @@ riding ICI. Host code only orchestrates and generates split indices.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import logging
 import time
 
@@ -34,7 +35,9 @@ from fraud_detection_tpu.data.loader import (
     stratified_kfold_indices,
     stratified_split,
 )
+from fraud_detection_tpu.models.gbt import FraudGBTModel
 from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.ops.gbt import GBTConfig, gbt_fit, gbt_predict_proba
 from fraud_detection_tpu.ops.logistic import (
     logistic_fit_lbfgs,
     logistic_fit_sgd,
@@ -62,6 +65,20 @@ def _fit(x, y, *, seed: int, solver: str, class_weight):
     )
 
 
+def _scale_pos_weight(y) -> float:
+    """n_negative / n_positive — the reference's imbalance knob for the
+    XGBoost path (train_model.py:52-54), computed pre-SMOTE."""
+    n_pos = max(int((np.asarray(y) > 0).sum()), 1)
+    return float((len(y) - n_pos) / n_pos)
+
+
+def _fit_gbt(x, y, *, gbt_config: GBTConfig | None, spw: float):
+    cfg = gbt_config or GBTConfig()
+    if cfg.scale_pos_weight == 1.0 and spw != 1.0:
+        cfg = dataclasses.replace(cfg, scale_pos_weight=spw)
+    return gbt_fit(x, y, cfg, sharded=True), cfg
+
+
 def train(
     data_csv: str | None = None,
     n_folds: int = 5,
@@ -71,6 +88,8 @@ def train(
     class_weight=None,
     register: bool = True,
     out_dir: str = "models",
+    model_family: str = "logistic",
+    gbt_config: GBTConfig | None = None,
 ) -> dict:
     """Run the full pipeline; returns a metrics dict."""
     t0 = time.time()
@@ -89,9 +108,21 @@ def train(
     client = TrackingClient()
     metrics: dict = {}
     with client.start_run() as run:
+        # scale_pos_weight and SMOTE are alternative imbalance corrections:
+        # SMOTE'd data is already ~balanced, so stacking the pre-SMOTE
+        # n_neg/n_pos weight on top (as the reference quirkily does,
+        # train_model.py:52-54 + :65-66) double-corrects and miscalibrates
+        # probabilities. Apply the weight only on the no-SMOTE path.
+        spw = (
+            _scale_pos_weight(y_train)
+            if model_family == "gbt" and not use_smote
+            else 1.0
+        )
         run.log_params(
             {
-                "model_type": "logistic_regression",
+                "model_type": (
+                    "gbt" if model_family == "gbt" else "logistic_regression"
+                ),
                 "solver": solver,
                 "n_folds": n_folds,
                 "use_smote": use_smote,
@@ -113,11 +144,19 @@ def train(
             try:
                 if use_smote:
                     x_tr, y_tr = smote(x_tr, y_tr, jax.random.key(seed + fold))
-                params = _fit(
-                    x_tr, y_tr,
-                    seed=seed + fold, solver=solver, class_weight=class_weight,
-                )
-                val_scores = np.asarray(predict_proba(params, xs_train[va]))
+                if model_family == "gbt":
+                    gmodel, _ = _fit_gbt(
+                        x_tr, y_tr, gbt_config=gbt_config, spw=spw
+                    )
+                    val_scores = np.asarray(
+                        gbt_predict_proba(gmodel, xs_train[va])
+                    )
+                else:
+                    params = _fit(
+                        x_tr, y_tr,
+                        seed=seed + fold, solver=solver, class_weight=class_weight,
+                    )
+                    val_scores = np.asarray(predict_proba(params, xs_train[va]))
                 fold_auc = float(auc_roc(val_scores, y_train[va]))
             except ValueError as e:
                 # Degenerate fold (too few positives for SMOTE neighbors or a
@@ -139,21 +178,47 @@ def train(
             if use_smote
             else (xs_train, y_train)
         )
-        params = _fit(
-            x_fin, y_fin, seed=seed, solver=solver, class_weight=class_weight,
-        )
-
-        test_scores = np.asarray(predict_proba(params, xs_test))
+        if model_family == "gbt":
+            gmodel, used_cfg = _fit_gbt(
+                x_fin, y_fin, gbt_config=gbt_config, spw=spw
+            )
+            run.log_params(
+                {
+                    "n_trees": used_cfg.n_trees,
+                    "max_depth": used_cfg.max_depth,
+                    "learning_rate": used_cfg.learning_rate,
+                    "scale_pos_weight": used_cfg.scale_pos_weight,
+                }
+            )
+            test_scores = np.asarray(gbt_predict_proba(gmodel, xs_test))
+        else:
+            params = _fit(
+                x_fin, y_fin, seed=seed, solver=solver, class_weight=class_weight,
+            )
+            test_scores = np.asarray(predict_proba(params, xs_test))
         test_auc = float(auc_roc(test_scores, y_test))
         metrics["test_auc"] = test_auc
         run.log_metric("test_auc", test_auc)
         log.info("test AUC %.4f", test_auc)
 
         # ---- artifacts: native + joblib interchange ----
-        model = FraudLogisticModel(params, scaler, feature_names)
-        model.save(out_dir)
         model_artifact = run.artifact_path("model")
-        save_artifacts(model_artifact, params, scaler, feature_names)
+        if model_family == "gbt":
+            # The wrapper folds the scaler into the bin edges, so the saved
+            # forest scores raw inputs directly (no scaler sidecar needed).
+            # A raw-space training subsample ships as the TreeSHAP background.
+            bg_idx = np.random.default_rng(seed).choice(
+                len(x_train), min(128, len(x_train)), replace=False
+            )
+            model = FraudGBTModel(
+                gmodel, feature_names, scaler=scaler, background=x_train[bg_idx]
+            )
+            model.save(out_dir)
+            model.save(model_artifact)
+        else:
+            model = FraudLogisticModel(params, scaler, feature_names)
+            model.save(out_dir)
+            save_artifacts(model_artifact, params, scaler, feature_names)
 
         # ---- AUC promotion gate ----
         threshold = config.auc_threshold()
@@ -192,6 +257,11 @@ def main(argv=None):
     ap.add_argument("--folds", type=int, default=5)
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--solver", choices=["auto", "lbfgs", "sgd"], default="auto")
+    ap.add_argument(
+        "--model", choices=["logistic", "gbt"], default="logistic",
+        help="model family: the logistic flagship or the XGBoost-recipe "
+        "histogram GBDT (reference train_model.py:69-80)",
+    )
     ap.add_argument("--no-smote", action="store_true")
     ap.add_argument("--no-register", action="store_true")
     ap.add_argument("--out-dir", default="models")
@@ -204,6 +274,7 @@ def main(argv=None):
         use_smote=not args.no_smote,
         register=not args.no_register,
         out_dir=args.out_dir,
+        model_family=args.model,
     )
     print(metrics)
 

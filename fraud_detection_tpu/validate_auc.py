@@ -16,7 +16,7 @@ import numpy as np
 
 from fraud_detection_tpu import config
 from fraud_detection_tpu.data.synthetic import generate_synthetic_rows
-from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.models import load_any_model
 from fraud_detection_tpu.ops.metrics import auc_roc
 from fraud_detection_tpu.tracking import TrackingClient
 
@@ -34,7 +34,7 @@ def validate_auc(
 
     client = TrackingClient()
     art = client.registry.resolve(model_uri)
-    model = FraudLogisticModel.load(art)
+    model = load_any_model(art)  # either family can be the registered prod
 
     x, y = generate_synthetic_rows(n_samples, fraud_ratio=0.05, seed=seed)
     scores = model.scorer.predict_proba(x)

@@ -1,0 +1,108 @@
+"""TreeSHAP exactness: brute-force parity, additivity, linear-SHAP cross-check.
+
+No reference behavior exists for tree explanations (the reference's SHAP
+paths are linear-only — SURVEY.md §2.3.3), so correctness is established
+first-principles: against direct subset enumeration of the interventional
+Shapley definition.
+"""
+
+from itertools import combinations
+from math import factorial
+
+import numpy as np
+from sklearn.metrics import roc_auc_score
+
+from fraud_detection_tpu.ops.gbt import (
+    GBTConfig,
+    gbt_fit,
+    gbt_predict_logits,
+)
+from fraud_detection_tpu.ops.tree_shap import (
+    build_tree_explainer,
+    tree_shap,
+    tree_shap_single,
+)
+
+
+def _brute_force_shap(predict_logits, x_row, background, d):
+    """Interventional Shapley by full subset enumeration (2^d coalitions):
+    v(S) = mean_b f(x_S ∪ b_S̄)."""
+
+    def v(subset):
+        z = np.repeat(background.copy(), 1, axis=0)
+        z = background.copy()
+        for j in subset:
+            z[:, j] = x_row[j]
+        return float(np.mean(predict_logits(z)))
+
+    phi = np.zeros(d)
+    players = list(range(d))
+    for i in players:
+        others = [j for j in players if j != i]
+        for k in range(len(others) + 1):
+            for s in combinations(others, k):
+                w = factorial(len(s)) * factorial(d - len(s) - 1) / factorial(d)
+                phi[i] += w * (v(set(s) | {i}) - v(set(s)))
+    return phi
+
+
+def test_matches_brute_force():
+    """Exactness on a small forest where 2^d enumeration is feasible."""
+    rng = np.random.default_rng(0)
+    d, n = 5, 400
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = ((x[:, 0] + x[:, 1] * x[:, 2] > 0.3)).astype(np.int32)
+    cfg = GBTConfig(n_trees=5, max_depth=3, learning_rate=0.4, n_bins=16)
+    model = gbt_fit(x, y, cfg)
+    bg = x[:16]
+    explainer = build_tree_explainer(model, bg)
+
+    def predict(z):
+        return np.asarray(gbt_predict_logits(model, z.astype(np.float32)))
+
+    for i in range(3):
+        got = np.asarray(tree_shap_single(explainer, x[i]))
+        want = _brute_force_shap(predict, x[i], bg, d)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_additivity(imbalanced_data):
+    """Σφ + E[f] must equal f(x) exactly — the defining local-accuracy
+    property, on the full reference recipe shape (depth 5, 30 features)."""
+    x, y = imbalanced_data
+    cfg = GBTConfig(n_trees=20, max_depth=5, learning_rate=0.2, n_bins=64)
+    model = gbt_fit(x, y, cfg)
+    explainer = build_tree_explainer(model, x[:100])
+    rows = x[200:232]
+    phi = np.asarray(tree_shap(explainer, rows))
+    recon = phi.sum(axis=1) + float(explainer.expected_value)
+    logits = np.asarray(gbt_predict_logits(model, rows))
+    np.testing.assert_allclose(recon, logits, rtol=1e-3, atol=1e-4)
+
+
+def test_expected_value_is_background_mean(imbalanced_data):
+    x, y = imbalanced_data
+    model = gbt_fit(x, y, GBTConfig(n_trees=10, max_depth=4, n_bins=32))
+    bg = x[:64]
+    explainer = build_tree_explainer(model, bg)
+    want = float(np.mean(np.asarray(gbt_predict_logits(model, bg))))
+    np.testing.assert_allclose(float(explainer.expected_value), want, rtol=1e-4)
+
+
+def test_informative_features_get_attribution(imbalanced_data):
+    """Features carrying the label signal must receive larger mean |φ| than
+    pure-noise features."""
+    rng = np.random.default_rng(1)
+    n = 2000
+    signal = rng.standard_normal((n, 2)).astype(np.float32)
+    noise = rng.standard_normal((n, 4)).astype(np.float32)
+    x = np.concatenate([signal, noise], axis=1)
+    y = (signal.sum(axis=1) > 0).astype(np.int32)
+    model = gbt_fit(x, y, GBTConfig(n_trees=20, max_depth=3, n_bins=32))
+    assert roc_auc_score(
+        y, np.asarray(gbt_predict_logits(model, x))
+    ) > 0.9  # model must have learned the signal for the test to mean much
+    explainer = build_tree_explainer(model, x[:128])
+    phi = np.abs(np.asarray(tree_shap(explainer, x[:256])))
+    mean_abs = phi.mean(axis=0)
+    assert mean_abs[:2].min() > mean_abs[2:].max() * 3

@@ -106,3 +106,38 @@ def test_results_db_upsert_idempotent(env):
     row = db.get("t")
     assert row["status"] == COMPLETED
     assert row["shap_values"] == {"a": 0.6}
+
+
+def test_worker_explains_gbt_model(env, tmp_path, rng, monkeypatch):
+    """A registered GBT production model must be explainable end-to-end
+    (TreeSHAP path), not just the linear flagship."""
+    from fraud_detection_tpu.models.gbt import FraudGBTModel
+    from fraud_detection_tpu.ops.gbt import GBTConfig, gbt_fit
+
+    db_url, broker_url, names = env
+    x = rng.standard_normal((300, 30)).astype(np.float32)
+    y = (x[:, 0] > 0.5).astype(np.int32)
+    gmodel = gbt_fit(x, y, GBTConfig(n_trees=5, max_depth=3, n_bins=16))
+    model_dir = str(tmp_path / "gbt_models")
+    FraudGBTModel(gmodel, names, background=x[:32]).save(model_dir)
+    monkeypatch.setenv("MODEL_PATH", os.path.join(model_dir, "model.npz"))
+
+    broker = Broker(broker_url)
+    db = ResultsDB(db_url)
+    feats = {n: 0.2 for n in names}
+    db.create_pending("txg", feats, "cg")
+    broker.send_task("xai_tasks.compute_shap", ["txg", feats, "cg"])
+
+    w = XaiWorker(broker_url=broker_url, database_url=db_url)
+    assert isinstance(w.model, FraudGBTModel)
+    assert w.run_once() is True
+    row = db.get("txg")
+    assert row["status"] == COMPLETED
+    assert len(row["shap_values"]) == 30
+    # local accuracy: sum(phi) + E[f] == logit(score)
+    import math
+
+    score = row["prediction_score"]
+    logit = math.log(score / (1 - score))
+    recon = sum(row["shap_values"].values()) + row["expected_value"]
+    assert abs(recon - logit) < 1e-3
