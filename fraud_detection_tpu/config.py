@@ -51,6 +51,14 @@ def mesh_model_axis() -> int:
     return _get_int("MESH_MODEL", 1)
 
 
+def use_pallas() -> str:
+    """``1``/``0``/``auto`` — hand-written Pallas kernels for the hot ops
+    (ops/pallas_kernels). Opt-in: XLA's fused paths measured at parity for
+    the 30-feature workload, so ``auto`` resolves to off (see
+    ops/pallas_kernels.pallas_enabled)."""
+    return _get("USE_PALLAS", "auto").lower()
+
+
 # --------------------------------------------------------------------------
 # Tracking / registry (reference: train_model.py:118-120,152, api/app.py:30)
 # --------------------------------------------------------------------------

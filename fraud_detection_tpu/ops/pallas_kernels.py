@@ -1,0 +1,217 @@
+"""Hand-written Pallas TPU kernels for the two hot ops.
+
+XLA's fusion already handles most of this framework well (SURVEY.md §2:
+"Pallas covers it" only where fusion proves insufficient); these kernels
+target the two spots where explicit VMEM control wins:
+
+- :func:`fused_score` — the serving hot path (reference api/app.py:209,
+  predict_single.py:28-32): one pass over the row block in VMEM computing
+  ``sigmoid(x·w + b)`` — load, multiply-reduce on the VPU, sigmoid, store,
+  with no intermediate HBM round-trip.
+- :func:`knn_topk` — SMOTE's quadratic hot loop (reference imblearn k-NN,
+  train_model.py:65-66): per query block, the ``|q|²−2q·x+|x|²`` distance
+  tile rides the MXU against the full minority set held VMEM-resident, and
+  the top-k is extracted by k iterative masked row-min passes — no (m, m)
+  distance matrix ever hits HBM.
+
+Both have identical-semantics XLA fallbacks (ops/scorer, ops/smote);
+dispatch is ``config.use_pallas()``: ``auto`` = TPU only. Kernels run in
+interpreter mode on CPU for tests (``interpret=True``).
+
+Shapes are padded to the TPU tile grid (last dim 128, f32 sublane 8) on the
+host; padding rows/cols are zeros and masked out of the top-k by +inf
+squared norms.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fraud_detection_tpu import config
+
+LANE = 128
+SUBLANE = 8
+
+
+def pallas_enabled(backend: str | None = None) -> bool:
+    """Dispatch gate. Explicit opt-in (``USE_PALLAS=1``): measured on v5e,
+    XLA's fused GEMV+sigmoid and blockwise top-k run at parity with these
+    kernels for the Kaggle-schema shapes (d=30 is VPU-bound, not MXU-bound),
+    so the compiler path stays the default — a hand kernel must beat the
+    compiler to earn dispatch. ``auto`` therefore currently resolves to off;
+    the kernels remain the tuning surface for wider-feature deployments."""
+    flag = config.use_pallas()
+    if flag in ("1", "true", "yes"):
+        if (backend or jax.default_backend()) == "cpu":
+            return False  # Mosaic kernels need a TPU; tests use interpret=True
+        return True
+    return False
+
+
+def _pad_cols(x: np.ndarray | jax.Array, to: int = LANE):
+    d = x.shape[-1]
+    if d % to == 0:
+        return x, d
+    pad = to - d % to
+    return jnp.pad(x, ((0, 0), (0, pad))), d
+
+
+def _pad_rows(x, mult: int):
+    n = x.shape[0]
+    if n % mult == 0:
+        return x, n
+    pad = mult - n % mult
+    widths = ((0, pad),) + ((0, 0),) * (x.ndim - 1)
+    return jnp.pad(x, widths), n
+
+
+# ---------------------------------------------------------------------------
+# Fused scorer
+# ---------------------------------------------------------------------------
+
+
+def _score_kernel(x_ref, w_ref, b_ref, out_ref):
+    # x: (BN, Dpad) block; w: (SUBLANE, Dpad), row 0 live; b: (1, 1) SMEM.
+    w = w_ref[0:1, :]
+    z = jnp.sum(x_ref[:] * w, axis=1, keepdims=True) + b_ref[0, 0]
+    # out block is (BN, LANE); broadcast the score across lanes — only
+    # column 0 is read back (lane-aligned store beats a (BN, 1) store).
+    out_ref[:] = jax.nn.sigmoid(z) * jnp.ones((1, LANE), jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def _fused_score_jit(x, w, b, block_n: int, interpret: bool):
+    # Pad inside jit: the unpadded array crosses host→device; lane/sublane
+    # padding happens on device (4× fewer transfer bytes for d=30).
+    x_pad, _ = _pad_cols(x)
+    x_pad, n_valid = _pad_rows(x_pad, block_n)
+    w_pad, _ = _pad_cols(w.reshape(1, -1))
+    w_pad = jnp.pad(w_pad, ((0, SUBLANE - 1), (0, 0)))  # sublane-aligned
+    b = b.reshape(1, 1)
+    return _fused_score_padded(x_pad, w_pad, b, block_n, interpret)[:n_valid]
+
+
+def _fused_score_padded(x, w_row, b, block_n: int, interpret: bool):
+    n, dpad = x.shape
+    grid = (n // block_n,)
+    out = pl.pallas_call(
+        _score_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, dpad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (SUBLANE, dpad), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_n, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, LANE), jnp.float32),
+        interpret=interpret,
+    )(x, w_row, b)
+    return out[:, 0]
+
+
+def fused_score(coef, intercept, x, block_n: int = 1024, interpret: bool = False):
+    """``sigmoid(x @ coef + intercept)`` as one Pallas pass; drop-in for the
+    XLA scorer (ops/scorer._score)."""
+    return _fused_score_jit(
+        jnp.asarray(x, jnp.float32),
+        jnp.asarray(coef, jnp.float32),
+        jnp.asarray(intercept, jnp.float32),
+        block_n,
+        interpret,
+    )
+
+
+# ---------------------------------------------------------------------------
+# k-NN top-k for SMOTE
+# ---------------------------------------------------------------------------
+
+
+def _knn_kernel(xq_ref, xall_ref, sq_ref, idx_ref, *, k: int, block_q: int):
+    i = pl.program_id(0)
+    q = xq_ref[:]                       # (BQ, Dpad)
+    x = xall_ref[:]                     # (Mpad, Dpad)
+    sq = sq_ref[:]                      # (1, Mpad) — +inf on padding rows
+    qsq = jnp.sum(q * q, axis=1, keepdims=True)            # (BQ, 1)
+    # dist² tile on the MXU: |q|² − 2 q·xᵀ + |x|²
+    d2 = (
+        qsq
+        - 2.0 * jax.lax.dot_general(
+            q, x, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        + sq
+    )                                    # (BQ, Mpad)
+    m = d2.shape[1]
+    # self-exclusion: query row g (global) vs candidate column g
+    rows = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 0) + i * block_q
+    cols = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
+    d2 = jnp.where(rows == cols, jnp.inf, d2)
+
+    # k masked row-min passes (k is tiny; cheaper than a full sort)
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
+    found = []
+    for _ in range(k):
+        best = jnp.min(d2, axis=1, keepdims=True)           # (BQ, 1)
+        is_best = d2 == best
+        # first column achieving the min
+        bcol = jnp.min(jnp.where(is_best, col_ids, m), axis=1, keepdims=True)
+        found.append(bcol)
+        d2 = jnp.where(col_ids == bcol, jnp.inf, d2)
+    idx = jnp.concatenate(found, axis=1)                    # (BQ, k)
+    idx_ref[:] = jnp.pad(idx, ((0, 0), (0, LANE - k)))      # one aligned store
+
+
+def _knn_padded(x_pad, sq_row, k: int, block_q: int, interpret: bool):
+    mpad, dpad = x_pad.shape
+    grid = (mpad // block_q,)
+    out = pl.pallas_call(
+        functools.partial(_knn_kernel, k=k, block_q=block_q),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, dpad), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((mpad, dpad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, mpad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_q, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((mpad, LANE), jnp.int32),
+        interpret=interpret,
+    )(x_pad, x_pad, sq_row)
+    return out
+
+
+# Above this minority-class size the VMEM-resident candidate set (~16 MB/core)
+# stops fitting; the blockwise XLA path takes over.
+KNN_VMEM_ROW_LIMIT = 16384
+
+
+@functools.partial(jax.jit, static_argnames=("k", "block_q", "interpret"))
+def _knn_jit(x, k: int, block_q: int, interpret: bool):
+    m = x.shape[0]
+    # center for f32 precision (distances are translation-invariant)
+    x = x - jnp.mean(x, axis=0)
+    x_pad, _ = _pad_cols(x)
+    x_pad, _ = _pad_rows(x_pad, max(block_q, SUBLANE))
+    mpad = x_pad.shape[0]
+    sq = jnp.sum(x_pad * x_pad, axis=1)
+    # padding rows must never be neighbors
+    sq = jnp.where(jnp.arange(mpad) >= m, jnp.inf, sq).reshape(1, mpad)
+    out = _knn_padded(x_pad, sq, k, min(block_q, mpad), interpret)
+    return out[:m, :k]
+
+
+def knn_topk(x_min, k: int, block_q: int = 256, interpret: bool = False):
+    """Indices (m, k) of each row's k nearest neighbors (self excluded),
+    euclidean; drop-in for ops/smote._knn_indices on VMEM-sized minority
+    sets."""
+    return _knn_jit(jnp.asarray(x_min, jnp.float32), k, block_q, interpret)

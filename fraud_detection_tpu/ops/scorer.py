@@ -90,7 +90,8 @@ class _BucketedScorer:
 
 
 class BatchScorer(_BucketedScorer):
-    """Scaler-folded linear scorer: one GEMV + sigmoid per bucket."""
+    """Scaler-folded linear scorer: one GEMV + sigmoid per bucket (the
+    Pallas fused kernel when ``USE_PALLAS=1`` — ops/pallas_kernels)."""
 
     def __init__(
         self,
@@ -103,8 +104,15 @@ class BatchScorer(_BucketedScorer):
         self.intercept = jnp.asarray(folded.intercept, dtype=jnp.float32)
         self.n_features = int(self.coef.shape[0])
         self.min_bucket = min_bucket
+        from fraud_detection_tpu.ops.pallas_kernels import pallas_enabled
+
+        self._use_pallas = pallas_enabled()
 
     def _score_padded(self, x: jax.Array) -> jax.Array:
+        if self._use_pallas:
+            from fraud_detection_tpu.ops.pallas_kernels import fused_score
+
+            return fused_score(self.coef, self.intercept, x)
         return _score(self.coef, self.intercept, x)
 
 

@@ -113,7 +113,19 @@ def smote(
         k_neighbors = n_min - 1
 
     x_min = jnp.asarray(x_np[y_np == minority])
-    nn_idx = _knn_indices(x_min, k_neighbors, min(block, max(x_min.shape[0], 8)))
+    from fraud_detection_tpu.ops.pallas_kernels import (
+        KNN_VMEM_ROW_LIMIT,
+        knn_topk,
+        pallas_enabled,
+    )
+
+    if pallas_enabled() and x_min.shape[0] <= KNN_VMEM_ROW_LIMIT:
+        # VMEM-resident Pallas kernel (opt-in); XLA blockwise path otherwise.
+        nn_idx = knn_topk(x_min, k_neighbors)
+    else:
+        nn_idx = _knn_indices(
+            x_min, k_neighbors, min(block, max(x_min.shape[0], 8))
+        )
     synth = _interpolate(x_min, nn_idx, key, n_synth)
     x_out = jnp.concatenate([jnp.asarray(x_np), synth], axis=0)
     y_out = jnp.concatenate(
