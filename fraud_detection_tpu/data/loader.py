@@ -21,7 +21,27 @@ def load_creditcard_csv(path: str) -> tuple[np.ndarray, np.ndarray, list[str]]:
 
     Column order follows the file header (the reference freezes whatever
     order training saw — preprocess.py:54-57); ``Class`` is the label.
+
+    Parsing goes through the native C++ loader (fraud_detection_tpu/native,
+    mmap + parallel float parse) when available — set ``NATIVE_CSV=0`` to
+    force pandas; any native failure falls back to pandas transparently.
     """
+    import os
+
+    if os.environ.get("NATIVE_CSV", "1") != "0":
+        from fraud_detection_tpu.data.native import load_csv_native
+
+        native = load_csv_native(path)
+        if native is not None:
+            mat, names = native
+            if LABEL_COLUMN in names:
+                li = names.index(LABEL_COLUMN)
+                feature_names = [c for c in names if c != LABEL_COLUMN]
+                y = mat[:, li].astype(np.int32)
+                x = np.ascontiguousarray(np.delete(mat, li, axis=1))
+                return x, y, feature_names
+            raise ValueError(f"{path} has no '{LABEL_COLUMN}' column")
+
     import pandas as pd
 
     df = pd.read_csv(path)

@@ -1,0 +1,129 @@
+"""ctypes bindings for the native C++ CSV loader.
+
+The framework's own native data tier (fraud_detection_tpu/native/
+csvloader.cpp): mmap + parallel float parsing straight into a numpy buffer.
+Replaces the role pandas' C parser plays for the reference (train_model.py:22)
+with code we own — and keeps pandas as the transparent fallback when the
+toolchain is unavailable (``load_csv_native`` returns None and the caller
+falls through).
+
+Build-on-demand: the shared library compiles at first use via the Makefile
+(g++ only; no pybind11 — plain C ABI through ctypes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger("fraud_detection_tpu.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libfraudcsv.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "csvloader.cpp")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed = False
+
+
+def _stale() -> bool:
+    return (
+        not os.path.exists(_SO_PATH)
+        or os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC_PATH)
+    )
+
+
+def ensure_built() -> bool:
+    """Compile the shared library if missing/stale; False when no toolchain."""
+    if not _stale():
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.warning("native csv loader build failed (%s); using pandas", e)
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _lib_failed:
+            return None
+        if not ensure_built():
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            log.warning("native csv loader load failed (%s); using pandas", e)
+            _lib_failed = True
+            return None
+        lib.csv_dims.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.csv_dims.restype = ctypes.c_int
+        lib.csv_header.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long]
+        lib.csv_header.restype = ctypes.c_int
+        lib.csv_read.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_int,
+        ]
+        lib.csv_read.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def load_csv_native(
+    path: str, n_threads: int = 0
+) -> tuple[np.ndarray, list[str]] | None:
+    """Parse a numeric CSV → (float32 (rows, cols) matrix, column names), or
+    None when the native library is unavailable or the file doesn't parse
+    (caller falls back to pandas)."""
+    lib = _load()
+    if lib is None:
+        return None
+    p = path.encode()
+    rows, cols = ctypes.c_long(), ctypes.c_long()
+    if lib.csv_dims(p, ctypes.byref(rows), ctypes.byref(cols)) != 0:
+        return None
+    if rows.value <= 0 or cols.value <= 0:
+        return None
+    hdr = ctypes.create_string_buffer(1 << 20)
+    if lib.csv_header(p, hdr, len(hdr)) != 0:
+        return None
+    names = [c.strip().strip('"').strip("'") for c in hdr.value.decode().split(",")]
+    out = np.empty((rows.value, cols.value), dtype=np.float32)
+    rc = lib.csv_read(
+        p,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        rows.value,
+        cols.value,
+        n_threads,
+    )
+    if rc != 0:
+        log.warning("native csv parse of %s failed (rc=%d); using pandas", path, rc)
+        return None
+    return out, names

@@ -1,0 +1,219 @@
+// Native CSV loader for the data tier.
+//
+// The reference's L1 is pandas.read_csv (reference train_model.py:22,
+// preprocess.py:15) — a C parser under a Python API. This is the framework's
+// own native equivalent: mmap the file once, index newlines, then parse rows
+// to float32 in parallel across threads — zero Python-object churn, output
+// written straight into a caller-provided (numpy) buffer.
+//
+// C ABI (consumed via ctypes from fraud_detection_tpu/data/native.py):
+//   csv_dims(path, &rows, &cols)          -> 0 ok; rows exclude the header
+//   csv_header(path, buf, buflen)         -> header line copied into buf
+//   csv_read(path, out, rows, cols, nthr) -> 0 ok; out is row-major float32
+//
+// Error codes: -1 io/open, -2 shape mismatch, -3 parse error.
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Mapped {
+  const char *data = nullptr;
+  size_t size = 0;
+  int fd = -1;
+
+  bool open_file(const char *path) {
+    fd = ::open(path, O_RDONLY);
+    if (fd < 0) return false;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size == 0) return false;
+    size = static_cast<size_t>(st.st_size);
+    void *p = mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (p == MAP_FAILED) return false;
+    data = static_cast<const char *>(p);
+    // The scan is strictly sequential per thread chunk.
+    madvise(p, size, MADV_SEQUENTIAL);
+    return true;
+  }
+
+  ~Mapped() {
+    if (data) munmap(const_cast<char *>(data), size);
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+// End offset (one past) of the header line.
+size_t header_end(const Mapped &m) {
+  const char *nl = static_cast<const char *>(memchr(m.data, '\n', m.size));
+  return nl ? static_cast<size_t>(nl - m.data) + 1 : m.size;
+}
+
+size_t count_cols(const Mapped &m) {
+  size_t end = header_end(m);
+  size_t cols = 1;
+  for (size_t i = 0; i < end; ++i)
+    if (m.data[i] == ',') ++cols;
+  return cols;
+}
+
+// Newline offsets after the header (data-row terminators; a missing final
+// newline counts the last partial line as a row).
+void index_rows(const Mapped &m, std::vector<size_t> &starts) {
+  size_t pos = header_end(m);
+  while (pos < m.size) {
+    starts.push_back(pos);
+    const char *nl = static_cast<const char *>(
+        memchr(m.data + pos, '\n', m.size - pos));
+    if (!nl) break;
+    pos = static_cast<size_t>(nl - m.data) + 1;
+  }
+}
+
+// Powers of ten for the fast float path (double keeps f32 round-trips exact).
+const double kPow10[] = {1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+                         1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
+                         1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+// Fast decimal float parse: sign, up-to-18-digit mantissa accumulated as
+// int64, optional fraction and e±dd exponent. Bails to strtof (locale-safe,
+// handles inf/nan/hex/overlong) by returning false with *end untouched —
+// ~4× faster than strtof on typical CSV numerics.
+inline bool fast_float(const char *p, const char *limit, float *out,
+                       const char **end) {
+  const char *s = p;
+  bool neg = false;
+  if (s < limit && (*s == '-' || *s == '+')) neg = (*s++ == '-');
+  long long mant = 0;
+  int digits = 0, frac_digits = 0;
+  while (s < limit && *s >= '0' && *s <= '9') {
+    mant = mant * 10 + (*s++ - '0');
+    if (++digits > 18) return false;
+  }
+  if (s < limit && *s == '.') {
+    ++s;
+    while (s < limit && *s >= '0' && *s <= '9') {
+      mant = mant * 10 + (*s++ - '0');
+      ++frac_digits;
+      if (++digits > 18) return false;
+    }
+  }
+  if (digits == 0) return false;  // "", ".", "nan", "inf" → slow path
+  int exp10 = -frac_digits;
+  if (s < limit && (*s == 'e' || *s == 'E')) {
+    const char *es = s + 1;
+    bool eneg = false;
+    if (es < limit && (*es == '-' || *es == '+')) eneg = (*es++ == '-');
+    int ev = 0, ed = 0;
+    while (es < limit && *es >= '0' && *es <= '9') {
+      ev = ev * 10 + (*es++ - '0');
+      if (++ed > 3) return false;
+    }
+    if (ed == 0) return false;
+    exp10 += eneg ? -ev : ev;
+    s = es;
+  }
+  if (exp10 < -22 || exp10 > 22) return false;  // outside exact pow10 table
+  double v = static_cast<double>(mant);
+  v = exp10 >= 0 ? v * kPow10[exp10] : v / kPow10[-exp10];
+  *out = static_cast<float>(neg ? -v : v);
+  *end = s;
+  return true;
+}
+
+// Parse one data row (cols comma-separated floats) at data[start..).
+// Returns false on malformed input.
+bool parse_row(const char *p, const char *limit, long cols, float *out) {
+  for (long c = 0; c < cols; ++c) {
+    const char *end = nullptr;
+    if (!fast_float(p, limit, &out[c], &end)) {
+      char *send = nullptr;
+      errno = 0;
+      float v = strtof(p, &send);
+      if (send == p) return false;  // empty/garbage field
+      out[c] = v;
+      end = send;
+    }
+    p = end;
+    if (c + 1 < cols) {
+      if (p >= limit || *p != ',') return false;
+      ++p;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+int csv_dims(const char *path, long *rows, long *cols) {
+  Mapped m;
+  if (!m.open_file(path)) return -1;
+  *cols = static_cast<long>(count_cols(m));
+  std::vector<size_t> starts;
+  index_rows(m, starts);
+  *rows = static_cast<long>(starts.size());
+  return 0;
+}
+
+int csv_header(const char *path, char *buf, long buflen) {
+  Mapped m;
+  if (!m.open_file(path)) return -1;
+  size_t end = header_end(m);
+  size_t n = end;
+  while (n > 0 && (m.data[n - 1] == '\n' || m.data[n - 1] == '\r')) --n;
+  if (static_cast<long>(n) + 1 > buflen) return -2;
+  memcpy(buf, m.data, n);
+  buf[n] = '\0';
+  return 0;
+}
+
+int csv_read(const char *path, float *out, long rows, long cols,
+             int n_threads) {
+  Mapped m;
+  if (!m.open_file(path)) return -1;
+  std::vector<size_t> starts;
+  index_rows(m, starts);
+  if (static_cast<long>(starts.size()) != rows ||
+      static_cast<long>(count_cols(m)) != cols)
+    return -2;
+
+  if (n_threads <= 0)
+    n_threads = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads < 1) n_threads = 1;
+  if (static_cast<long>(n_threads) > rows) n_threads = static_cast<int>(rows);
+
+  const char *limit = m.data + m.size;
+  std::vector<int> status(static_cast<size_t>(n_threads), 0);
+  std::vector<std::thread> pool;
+  pool.reserve(static_cast<size_t>(n_threads));
+  long chunk = (rows + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    long lo = static_cast<long>(t) * chunk;
+    long hi = lo + chunk < rows ? lo + chunk : rows;
+    pool.emplace_back([&, t, lo, hi]() {
+      for (long r = lo; r < hi; ++r) {
+        if (!parse_row(m.data + starts[static_cast<size_t>(r)], limit, cols,
+                       out + r * cols)) {
+          status[static_cast<size_t>(t)] = -3;
+          return;
+        }
+      }
+    });
+  }
+  for (auto &th : pool) th.join();
+  for (int s : status)
+    if (s != 0) return s;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,94 @@
+"""Native C++ CSV loader: build, parse parity vs pandas, fallback behavior."""
+
+import shutil
+import subprocess
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from fraud_detection_tpu.data import native
+from fraud_detection_tpu.data.loader import load_creditcard_csv
+from fraud_detection_tpu.data.synthetic import generate_synthetic_data
+
+have_toolchain = shutil.which("g++") is not None and shutil.which("make") is not None
+
+needs_native = pytest.mark.skipif(
+    not have_toolchain, reason="no C++ toolchain in this environment"
+)
+
+
+@needs_native
+def test_builds_and_loads():
+    assert native.ensure_built() is True
+    assert native.native_available() is True
+
+
+@needs_native
+def test_parity_vs_pandas(tmp_path):
+    csv = str(tmp_path / "synth.csv")
+    generate_synthetic_data(csv, n_samples=2000, fraud_ratio=0.05, seed=3)
+    mat, names = native.load_csv_native(csv)
+    df = pd.read_csv(csv)
+    assert names == list(df.columns)
+    np.testing.assert_allclose(
+        mat, df.to_numpy(dtype=np.float32), rtol=1e-6, atol=1e-6
+    )
+
+
+@needs_native
+def test_loader_uses_native_and_matches_pandas(tmp_path, monkeypatch):
+    csv = str(tmp_path / "synth.csv")
+    generate_synthetic_data(csv, n_samples=1500, fraud_ratio=0.03, seed=4)
+    x_n, y_n, names_n = load_creditcard_csv(csv)
+    monkeypatch.setenv("NATIVE_CSV", "0")
+    x_p, y_p, names_p = load_creditcard_csv(csv)
+    assert names_n == names_p
+    np.testing.assert_allclose(x_n, x_p, rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(y_n, y_p)
+
+
+@needs_native
+def test_scientific_notation_and_negatives(tmp_path):
+    csv = tmp_path / "sci.csv"
+    csv.write_text("a,b,Class\n-1.5e-3,2.25E+2,1\n0.0,-3,0\n")
+    mat, names = native.load_csv_native(str(csv))
+    assert names == ["a", "b", "Class"]
+    np.testing.assert_allclose(
+        mat, [[-1.5e-3, 225.0, 1.0], [0.0, -3.0, 0.0]], rtol=1e-6
+    )
+
+
+@needs_native
+def test_no_trailing_newline(tmp_path):
+    csv = tmp_path / "nt.csv"
+    csv.write_text("a,Class\n1.0,0\n2.0,1")  # last row unterminated
+    mat, _ = native.load_csv_native(str(csv))
+    np.testing.assert_allclose(mat, [[1.0, 0.0], [2.0, 1.0]])
+
+
+@needs_native
+def test_malformed_returns_none(tmp_path):
+    csv = tmp_path / "bad.csv"
+    csv.write_text("a,b,Class\n1.0,oops,0\n")
+    assert native.load_csv_native(str(csv)) is None  # → pandas fallback
+
+
+def test_fallback_when_disabled(tmp_path, monkeypatch):
+    """NATIVE_CSV=0 must serve identical results through pandas."""
+    csv = str(tmp_path / "synth.csv")
+    generate_synthetic_data(csv, n_samples=500, fraud_ratio=0.05, seed=5)
+    monkeypatch.setenv("NATIVE_CSV", "0")
+    x, y, names = load_creditcard_csv(csv)
+    assert x.shape == (500, 30) and y.shape == (500,) and len(names) == 30
+
+
+@needs_native
+def test_standalone_make(tmp_path):
+    """The Makefile target builds cleanly from scratch in a copied tree."""
+    src = tmp_path / "native"
+    shutil.copytree(
+        native._NATIVE_DIR, src, ignore=shutil.ignore_patterns("build")
+    )
+    subprocess.run(["make", "-C", str(src)], check=True, capture_output=True)
+    assert (src / "build" / "libfraudcsv.so").exists()
