@@ -33,10 +33,15 @@ _lib_failed = False
 
 
 def _stale() -> bool:
-    return (
-        not os.path.exists(_SO_PATH)
-        or os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC_PATH)
-    )
+    try:
+        so_mtime = os.path.getmtime(_SO_PATH)
+    except OSError:
+        return True  # no built library yet
+    try:
+        src_mtime = os.path.getmtime(_SRC_PATH)
+    except OSError:
+        return False  # sources absent (e.g. binary-only deploy); use the .so
+    return so_mtime < src_mtime
 
 
 def ensure_built() -> bool:
@@ -72,22 +77,30 @@ def _load() -> ctypes.CDLL | None:
             log.warning("native csv loader load failed (%s); using pandas", e)
             _lib_failed = True
             return None
-        lib.csv_dims.argtypes = [
-            ctypes.c_char_p,
+        lib.csv_open.argtypes = [ctypes.c_char_p]
+        lib.csv_open.restype = ctypes.c_void_p
+        lib.csv_close.argtypes = [ctypes.c_void_p]
+        lib.csv_close.restype = None
+        lib.csv_dims_h.argtypes = [
+            ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_long),
             ctypes.POINTER(ctypes.c_long),
         ]
-        lib.csv_dims.restype = ctypes.c_int
-        lib.csv_header.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long]
-        lib.csv_header.restype = ctypes.c_int
-        lib.csv_read.argtypes = [
+        lib.csv_dims_h.restype = ctypes.c_int
+        lib.csv_header_h.argtypes = [
+            ctypes.c_void_p,
             ctypes.c_char_p,
+            ctypes.c_long,
+        ]
+        lib.csv_header_h.restype = ctypes.c_int
+        lib.csv_read_h.argtypes = [
+            ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_float),
             ctypes.c_long,
             ctypes.c_long,
             ctypes.c_int,
         ]
-        lib.csv_read.restype = ctypes.c_int
+        lib.csv_read_h.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -105,25 +118,37 @@ def load_csv_native(
     lib = _load()
     if lib is None:
         return None
-    p = path.encode()
-    rows, cols = ctypes.c_long(), ctypes.c_long()
-    if lib.csv_dims(p, ctypes.byref(rows), ctypes.byref(cols)) != 0:
+    handle = lib.csv_open(path.encode())
+    if not handle:
         return None
-    if rows.value <= 0 or cols.value <= 0:
-        return None
-    hdr = ctypes.create_string_buffer(1 << 20)
-    if lib.csv_header(p, hdr, len(hdr)) != 0:
-        return None
-    names = [c.strip().strip('"').strip("'") for c in hdr.value.decode().split(",")]
-    out = np.empty((rows.value, cols.value), dtype=np.float32)
-    rc = lib.csv_read(
-        p,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-        rows.value,
-        cols.value,
-        n_threads,
-    )
-    if rc != 0:
-        log.warning("native csv parse of %s failed (rc=%d); using pandas", path, rc)
-        return None
-    return out, names
+    try:
+        rows, cols = ctypes.c_long(), ctypes.c_long()
+        if lib.csv_dims_h(handle, ctypes.byref(rows), ctypes.byref(cols)) != 0:
+            return None
+        if rows.value <= 0 or cols.value <= 0:
+            return None
+        hdr = ctypes.create_string_buffer(1 << 20)
+        if lib.csv_header_h(handle, hdr, len(hdr)) != 0:
+            return None
+        # Match pandas: unwrap CSV double-quoting only — whitespace in names
+        # is preserved, so both code paths freeze identical feature_names.
+        names = [
+            c[1:-1] if len(c) >= 2 and c[0] == '"' and c[-1] == '"' else c
+            for c in hdr.value.decode().split(",")
+        ]
+        out = np.empty((rows.value, cols.value), dtype=np.float32)
+        rc = lib.csv_read_h(
+            handle,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            rows.value,
+            cols.value,
+            n_threads,
+        )
+        if rc != 0:
+            log.warning(
+                "native csv parse of %s failed (rc=%d); using pandas", path, rc
+            )
+            return None
+        return out, names
+    finally:
+        lib.csv_close(handle)

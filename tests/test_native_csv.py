@@ -68,6 +68,47 @@ def test_no_trailing_newline(tmp_path):
 
 
 @needs_native
+def test_trailing_blank_lines_skipped(tmp_path):
+    csv = tmp_path / "blank.csv"
+    csv.write_text("a,Class\n1.0,0\n2.0,1\n\n")  # editor-style extra newline
+    mat, _ = native.load_csv_native(str(csv))
+    np.testing.assert_allclose(mat, [[1.0, 0.0], [2.0, 1.0]])
+
+
+@needs_native
+def test_crlf_rows(tmp_path):
+    csv = tmp_path / "crlf.csv"
+    csv.write_text("a,Class\r\n1.5,0\r\n2.5,1\r\n")
+    mat, names = native.load_csv_native(str(csv))
+    assert names == ["a", "Class"]
+    np.testing.assert_allclose(mat, [[1.5, 0.0], [2.5, 1.0]])
+
+
+@needs_native
+def test_ragged_extra_field_rejected(tmp_path):
+    csv = tmp_path / "ragged.csv"
+    csv.write_text("a,Class\n1.0,0,999\n")  # extra trailing field
+    assert native.load_csv_native(str(csv)) is None  # → pandas fallback
+
+
+@needs_native
+def test_empty_last_field_rejected(tmp_path):
+    # Must not bleed into the next row via an unbounded strtof.
+    csv = tmp_path / "empty.csv"
+    csv.write_text("a,b\n1.0,\n2.0,3.0\n")
+    assert native.load_csv_native(str(csv)) is None  # → pandas fallback
+
+
+@needs_native
+def test_nan_inf_slow_path(tmp_path):
+    csv = tmp_path / "naninf.csv"
+    csv.write_text("a,b\nnan,inf\n-inf,1.0\n")
+    mat, _ = native.load_csv_native(str(csv))
+    assert np.isnan(mat[0, 0]) and np.isposinf(mat[0, 1])
+    assert np.isneginf(mat[1, 0]) and mat[1, 1] == 1.0
+
+
+@needs_native
 def test_malformed_returns_none(tmp_path):
     csv = tmp_path / "bad.csv"
     csv.write_text("a,b,Class\n1.0,oops,0\n")
