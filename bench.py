@@ -20,8 +20,10 @@ import time
 import numpy as np
 
 BATCH = 1 << 16  # 65536-row scoring batches
-REPEATS = 30
-N_ROWS = 1 << 20  # 1M-row scoring set
+REPEATS = 30  # synchronous (transfer-bound) sections
+DEV_REPEATS = 256  # device-resident sections: async dispatch makes these
+N_ROWS = 1 << 20  # 1M-row scoring set      cheap, and more repeats damp
+#                                           tunnel/dispatch jitter
 
 
 def _data(n_features: int = 30):
@@ -37,7 +39,6 @@ def _data(n_features: int = 30):
 def bench_sklearn_cpu(x, coef, intercept, mean, scale) -> float:
     """Reference path: StandardScaler.transform + LogisticRegression
     .predict_proba through real sklearn estimators."""
-    from sklearn.linear_model import LogisticRegression
     from sklearn.preprocessing import StandardScaler
 
     sk_scaler = StandardScaler()
@@ -46,11 +47,7 @@ def bench_sklearn_cpu(x, coef, intercept, mean, scale) -> float:
     sk_scaler.var_ = (scale.astype(np.float64)) ** 2
     sk_scaler.n_features_in_ = x.shape[1]
 
-    model = LogisticRegression()
-    model.classes_ = np.array([0, 1])
-    model.coef_ = coef.astype(np.float64)[None, :]
-    model.intercept_ = np.array([float(intercept)])
-    model.n_features_in_ = x.shape[1]
+    model = _sk_model(coef, intercept, x.shape[1])
 
     # warmup
     model.predict_proba(sk_scaler.transform(x[:BATCH]))
@@ -63,55 +60,149 @@ def bench_sklearn_cpu(x, coef, intercept, mean, scale) -> float:
     return rows / (time.perf_counter() - t0)
 
 
-def bench_tpu(x, coef, intercept, mean, scale) -> tuple[float, float]:
-    import jax.numpy as jnp
-
+def _scorer(coef, intercept, mean, scale, **kw):
     from fraud_detection_tpu.ops.logistic import LogisticParams
     from fraud_detection_tpu.ops.scaler import ScalerParams
-    from fraud_detection_tpu.ops.scorer import BatchScorer, _score
+    from fraud_detection_tpu.ops.scorer import BatchScorer
 
-    params = LogisticParams(coef=coef, intercept=intercept)
-    scaler = ScalerParams(mean=mean, scale=scale, var=scale**2, n_samples=np.float32(1))
-    scorer = BatchScorer(params, scaler)
+    return BatchScorer(
+        LogisticParams(coef=coef, intercept=intercept),
+        ScalerParams(mean=mean, scale=scale, var=scale**2, n_samples=np.float32(1)),
+        **kw,
+    )
 
-    # Device-resident throughput: pre-staged batches (one executable for the
-    # (BATCH, d) shape — slicing eagerly with varying offsets would compile
-    # one executable per offset), async-queued, one sync at the end. This is
-    # the steady-state pipeline rate the micro-batching server sustains.
+
+def bench_dev_scoring(x, coef, intercept, mean, scale) -> float:
+    """Device-resident throughput: pre-staged batches (one executable for the
+    (BATCH, d) shape), async-queued, one sync at the end — the steady-state
+    pipeline rate the micro-batching server sustains. Runs before any
+    synchronous d2h section (see bench_shap_device note)."""
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.ops.scorer import _score
+
+    scorer = _scorer(coef, intercept, mean, scale)
     batches = [
         jnp.asarray(x[i * BATCH : (i + 1) * BATCH]) for i in range(N_ROWS // BATCH)
     ]
     _score(scorer.coef, scorer.intercept, batches[0]).block_until_ready()
-    t0 = time.perf_counter()
-    rows = 0
-    outs = []
-    for i in range(REPEATS):
-        outs.append(
+    rates = []
+    for _trial in range(3):  # median-of-3 damps tunnel hiccups
+        t0 = time.perf_counter()
+        outs = [
             _score(scorer.coef, scorer.intercept, batches[i % len(batches)])
-        )
-        rows += BATCH
-    for o in outs:
-        o.block_until_ready()
-    dev_rate = rows / (time.perf_counter() - t0)
+            for i in range(DEV_REPEATS)
+        ]
+        for o in outs:
+            o.block_until_ready()
+        rates.append(DEV_REPEATS * BATCH / (time.perf_counter() - t0))
+    return float(np.median(rates))
 
-    # Online end-to-end: host→device transfer + score + device→host readback,
-    # synchronous per batch (worst case for a remote-tunneled chip).
-    scorer.predict_proba(x[:BATCH])
-    t0 = time.perf_counter()
-    rows = 0
-    for i in range(REPEATS):
-        lo = (i * BATCH) % (N_ROWS - BATCH)
-        scorer.predict_proba(x[lo : lo + BATCH])
-        rows += BATCH
-    h2d_rate = rows / (time.perf_counter() - t0)
 
-    return dev_rate, h2d_rate
+def bench_sync_scoring(x, coef, intercept, mean, scale) -> tuple[float, float]:
+    """Online end-to-end: host→device transfer + score + device→host
+    readback, synchronous per batch (worst case for a remote-tunneled chip).
+    bf16 IO halves the bytes on this bandwidth-bound path (compute stays
+    f32)."""
+
+    def sync_rate(s, reps=REPEATS):
+        s.predict_proba(x[:BATCH])
+        t0 = time.perf_counter()
+        for i in range(reps):
+            lo = (i * BATCH) % (N_ROWS - BATCH)
+            s.predict_proba(x[lo : lo + BATCH])
+        return reps * BATCH / (time.perf_counter() - t0)
+
+    h2d_rate = sync_rate(_scorer(coef, intercept, mean, scale))
+    h2d_bf16_rate = sync_rate(
+        _scorer(coef, intercept, mean, scale, io_dtype="bfloat16")
+    )
+    return h2d_rate, h2d_bf16_rate
+
+
+def bench_shap_device(x, coef, intercept, mean) -> float:
+    """Exact interventional linear SHAP values/sec on device (the async XAI
+    hot loop, reference api/worker.py:73-79). Must run BEFORE any synchronous
+    d2h section: a remote-tunneled chip drops to one-dispatch-per-RTT after
+    the first blocking readback."""
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.ops.linear_shap import linear_shap, make_explainer
+
+    expl = make_explainer(coef, intercept, background_mean=mean)
+    batches = [
+        jnp.asarray(x[i * BATCH : (i + 1) * BATCH]) for i in range(4)
+    ]
+    linear_shap(expl, batches[0]).block_until_ready()
+    rates = []
+    for _trial in range(3):
+        t0 = time.perf_counter()
+        outs = [linear_shap(expl, batches[i % 4]) for i in range(DEV_REPEATS)]
+        for o in outs:
+            o.block_until_ready()
+        rates.append(DEV_REPEATS * BATCH / (time.perf_counter() - t0))
+    return float(np.median(rates))
+
+
+def bench_shap_cpu(x, coef, intercept, mean) -> float:
+    """shap.LinearExplainer on CPU (numpy closed form when shap isn't
+    installed) — the reference worker's implementation of the same values."""
+    try:
+        import shap
+
+        bg = np.zeros((1, x.shape[1])) + mean
+        model = _sk_model(coef, intercept, x.shape[1])
+        ex = shap.LinearExplainer(model, bg)
+        ex.shap_values(x[:1024])
+        t0 = time.perf_counter()
+        ex.shap_values(x[:BATCH])
+        cpu_rate = BATCH / (time.perf_counter() - t0)
+    except ImportError:
+        t0 = time.perf_counter()
+        for i in range(REPEATS):
+            lo = (i * BATCH) % (N_ROWS - BATCH)
+            _ = coef[None, :] * (x[lo : lo + BATCH] - mean[None, :])
+        cpu_rate = REPEATS * BATCH / (time.perf_counter() - t0)
+    return cpu_rate
+
+
+def _sk_model(coef, intercept, d):
+    from sklearn.linear_model import LogisticRegression
+
+    m = LogisticRegression()
+    m.classes_ = np.array([0, 1])
+    m.coef_ = coef.astype(np.float64)[None, :]
+    m.intercept_ = np.array([float(intercept)])
+    m.n_features_in_ = d
+    return m
+
+
+def bench_latency(x, coef, intercept, mean, scale) -> tuple[float, float]:
+    """Single-row online scoring latency (p50/p95 ms): the per-request
+    /predict path incl. host→device transfer and readback — the number the
+    reference's 500 ms p95 SLO governs."""
+    scorer = _scorer(coef, intercept, mean, scale)
+    row = x[:1]
+    for _ in range(5):
+        scorer.predict_proba(row)  # warmup/compile
+    lat = []
+    for i in range(200):
+        t0 = time.perf_counter()
+        scorer.predict_proba(x[i : i + 1])
+        lat.append((time.perf_counter() - t0) * 1e3)
+    return float(np.percentile(lat, 50)), float(np.percentile(lat, 95))
 
 
 def main() -> None:
     x, coef, intercept, mean, scale = _data()
+    # Device-resident sections first: a tunneled chip serializes dispatch
+    # after the first blocking d2h readback, so sync sections go last.
+    dev_rate = bench_dev_scoring(x, coef, intercept, mean, scale)
+    shap_dev = bench_shap_device(x, coef, intercept, mean)
     cpu_rate = bench_sklearn_cpu(x, coef, intercept, mean, scale)
-    dev_rate, h2d_rate = bench_tpu(x, coef, intercept, mean, scale)
+    shap_cpu = bench_shap_cpu(x, coef, intercept, mean)
+    h2d_rate, h2d_bf16_rate = bench_sync_scoring(x, coef, intercept, mean, scale)
+    p50, p95 = bench_latency(x, coef, intercept, mean, scale)
     import jax
 
     print(
@@ -123,6 +214,12 @@ def main() -> None:
                 "vs_baseline": round(dev_rate / cpu_rate, 2),
                 "sklearn_cpu_rows_per_sec": round(cpu_rate),
                 "tpu_host_to_device_rows_per_sec": round(h2d_rate),
+                "tpu_h2d_bf16_io_rows_per_sec": round(h2d_bf16_rate),
+                "shap_values_per_sec": round(shap_dev),
+                "shap_cpu_values_per_sec": round(shap_cpu),
+                "shap_vs_cpu": round(shap_dev / shap_cpu, 2),
+                "single_row_p50_ms": round(p50, 3),
+                "single_row_p95_ms": round(p95, 3),
                 "device": jax.devices()[0].platform,
                 "batch": BATCH,
             }

@@ -73,36 +73,43 @@ def _load() -> ctypes.CDLL | None:
             return None
         try:
             lib = ctypes.CDLL(_SO_PATH)
-        except OSError as e:
+            _bind(lib)
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale prebuilt .so missing the current ABI
+            # (e.g. binary-only deploy of an old build) — fall back, don't
+            # crash data loading.
             log.warning("native csv loader load failed (%s); using pandas", e)
             _lib_failed = True
             return None
-        lib.csv_open.argtypes = [ctypes.c_char_p]
-        lib.csv_open.restype = ctypes.c_void_p
-        lib.csv_close.argtypes = [ctypes.c_void_p]
-        lib.csv_close.restype = None
-        lib.csv_dims_h.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_long),
-            ctypes.POINTER(ctypes.c_long),
-        ]
-        lib.csv_dims_h.restype = ctypes.c_int
-        lib.csv_header_h.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_char_p,
-            ctypes.c_long,
-        ]
-        lib.csv_header_h.restype = ctypes.c_int
-        lib.csv_read_h.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.c_long,
-            ctypes.c_long,
-            ctypes.c_int,
-        ]
-        lib.csv_read_h.restype = ctypes.c_int
         _lib = lib
         return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.csv_open.argtypes = [ctypes.c_char_p]
+    lib.csv_open.restype = ctypes.c_void_p
+    lib.csv_close.argtypes = [ctypes.c_void_p]
+    lib.csv_close.restype = None
+    lib.csv_dims_h.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.POINTER(ctypes.c_long),
+    ]
+    lib.csv_dims_h.restype = ctypes.c_int
+    lib.csv_header_h.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_long,
+    ]
+    lib.csv_header_h.restype = ctypes.c_int
+    lib.csv_read_h.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_long,
+        ctypes.c_long,
+        ctypes.c_int,
+    ]
+    lib.csv_read_h.restype = ctypes.c_int
 
 
 def native_available() -> bool:

@@ -88,7 +88,10 @@ def _score_kernel(x_ref, w_ref, b_ref, out_ref):
 @functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
 def _fused_score_jit(x, w, b, block_n: int, interpret: bool):
     # Pad inside jit: the unpadded array crosses host→device; lane/sublane
-    # padding happens on device (4× fewer transfer bytes for d=30).
+    # padding happens on device (4× fewer transfer bytes for d=30). The
+    # f32 upcast (bf16-IO path) lives inside jit too — same executable,
+    # no standalone convert dispatch.
+    x = x.astype(jnp.float32)
     x_pad, _ = _pad_cols(x)
     x_pad, n_valid = _pad_rows(x_pad, block_n)
     w_pad, _ = _pad_cols(w.reshape(1, -1))
@@ -123,7 +126,7 @@ def fused_score(coef, intercept, x, block_n: int = 1024, interpret: bool = False
     """``sigmoid(x @ coef + intercept)`` as one Pallas pass; drop-in for the
     XLA scorer (ops/scorer._score)."""
     return _fused_score_jit(
-        jnp.asarray(x, jnp.float32),
+        x if isinstance(x, jax.Array) else jnp.asarray(x),
         jnp.asarray(coef, jnp.float32),
         jnp.asarray(intercept, jnp.float32),
         block_n,

@@ -1,0 +1,81 @@
+"""Legacy synchronous scoring API (reference ``deploy.py`` parity).
+
+The reference keeps an older single-process Flask app alongside the primary
+FastAPI service (SURVEY.md §2.1 #14; reference deploy.py:17-50): ``GET /``
+liveness banner, ``POST /predict`` accepting a feature dict, responding
+``{prediction, fraud_probability, alert}`` with ``alert = prob > 0.8``, 500
+with ``{"error": ...}`` on any failure, serving on port 5000.
+
+Same contract here, on the framework's own HTTP stack and the jitted
+scorer — one process, no broker/DB, useful as a minimal smoke-test server.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from fraud_detection_tpu.service.http import App, HTTPError, Request, Response
+
+log = logging.getLogger("fraud_detection_tpu.legacy")
+
+ALERT_THRESHOLD = 0.8  # reference deploy.py:40
+
+
+def create_app(model=None) -> App:
+    app = App()
+    state = {"model": model}
+
+    async def startup():
+        if state["model"] is None:
+            from fraud_detection_tpu.service.loading import load_production_model
+
+            state["model"], src = load_production_model()
+            log.info("legacy API loaded model from %s", src)
+
+    app.on_startup.append(startup)
+
+    @app.get("/")
+    async def index(req: Request) -> Response:
+        return Response({"msg": "Fraud Detection API is live"})
+
+    @app.post("/predict")
+    async def predict(req: Request) -> Response:
+        model = state["model"]
+        if model is None:
+            raise HTTPError(503, "model not loaded")
+        # The reference returns 500 {"error": ...} for every failure mode
+        # (deploy.py:49-50), including malformed input — keep that contract.
+        try:
+            payload = req.json()
+            features = payload.get("features", payload) if isinstance(
+                payload, dict
+            ) else payload
+            label, prob = model.score_one(features)
+        except Exception as e:  # noqa: BLE001 — contract: any error → 500
+            return Response({"error": str(e)}, status_code=500)
+        return Response(
+            {
+                "prediction": int(label),
+                "fraud_probability": round(float(prob), 4),
+                "alert": bool(prob > ALERT_THRESHOLD),
+            }
+        )
+
+    return app
+
+
+def main():
+    import argparse
+
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=5000)  # deploy.py:54
+    args = ap.parse_args()
+    from fraud_detection_tpu.service.http import run
+
+    run(create_app(), args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

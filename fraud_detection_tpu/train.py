@@ -265,17 +265,32 @@ def main(argv=None):
     ap.add_argument("--no-smote", action="store_true")
     ap.add_argument("--no-register", action="store_true")
     ap.add_argument("--out-dir", default="models")
-    args = ap.parse_args(argv)
-    metrics = train(
-        data_csv=args.data,
-        n_folds=args.folds,
-        seed=args.seed,
-        solver=args.solver,
-        use_smote=not args.no_smote,
-        register=not args.no_register,
-        out_dir=args.out_dir,
-        model_family=args.model,
+    ap.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax.profiler device trace of the run to this dir "
+        "(view with tensorboard --logdir or Perfetto)",
     )
+    args = ap.parse_args(argv)
+
+    def go():
+        return train(
+            data_csv=args.data,
+            n_folds=args.folds,
+            seed=args.seed,
+            solver=args.solver,
+            use_smote=not args.no_smote,
+            register=not args.no_register,
+            out_dir=args.out_dir,
+            model_family=args.model,
+        )
+
+    if args.profile_dir:
+        from fraud_detection_tpu.utils.profiling import device_trace
+
+        with device_trace(args.profile_dir):
+            metrics = go()
+    else:
+        metrics = go()
     print(metrics)
 
 

@@ -1,0 +1,6 @@
+"""Cross-cutting utilities: device profiling, structured logging."""
+
+from fraud_detection_tpu.utils.jsonlog import setup_json_logging
+from fraud_detection_tpu.utils.profiling import annotate, device_trace
+
+__all__ = ["annotate", "device_trace", "setup_json_logging"]

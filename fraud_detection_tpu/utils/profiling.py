@@ -1,0 +1,79 @@
+"""Device-side profiling: ``jax.profiler`` trace capture.
+
+The reference has OTEL request tracing but no profiler at all (SURVEY.md §5
+"No profiler exists"). On TPU the interesting time is *inside* the XLA
+program, which OTEL spans cannot see — this module adds the device view:
+``device_trace`` captures an XLA/TensorBoard trace (viewable with
+``tensorboard --logdir`` or Perfetto), ``annotate`` names host-side regions
+so they line up with device ops in the timeline.
+
+Usage:
+    with device_trace("/tmp/jax-trace"):
+        with annotate("score-batch"):
+            scorer.predict_proba(x)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+
+log = logging.getLogger("fraud_detection_tpu.profiling")
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture a jax.profiler trace of everything run inside the block.
+
+    Writes a TensorBoard-compatible trace under ``log_dir``. Never raises
+    out of profiling failures — a broken profiler must not take down
+    training or serving.
+    """
+    import jax
+
+    os.makedirs(log_dir, exist_ok=True)
+    t0 = time.perf_counter()
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+        started = True
+    except Exception as e:  # noqa: BLE001 — profiling is best-effort
+        log.warning("profiler start failed (%s); running unprofiled", e)
+    try:
+        yield log_dir
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                log.info(
+                    "device trace captured to %s (%.2fs)",
+                    log_dir,
+                    time.perf_counter() - t0,
+                )
+            except Exception as e:  # noqa: BLE001
+                log.warning("profiler stop failed: %s", e)
+
+
+@contextlib.contextmanager
+def annotate(name: str, **kwargs):
+    """Name a host-side region in the device timeline
+    (``jax.profiler.TraceAnnotation``); no-op outside an active trace."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name, **kwargs):
+        yield
+
+
+def save_device_memory_profile(path: str) -> bool:
+    """Dump the current device memory profile (pprof format) to ``path``;
+    returns False (logged) when unavailable on this backend."""
+    import jax
+
+    try:
+        jax.profiler.save_device_memory_profile(path)
+        return True
+    except Exception as e:  # noqa: BLE001
+        log.warning("device memory profile unavailable: %s", e)
+        return False

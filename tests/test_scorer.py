@@ -1,6 +1,7 @@
 """Batch-scorer tests: scaler folding + bucket padding correctness."""
 
 import numpy as np
+import pytest
 from sklearn.linear_model import LogisticRegression
 from sklearn.preprocessing import StandardScaler
 
@@ -46,3 +47,26 @@ def test_predict_threshold(rng):
     scorer = BatchScorer(params)
     x = rng.standard_normal((4, d)).astype(np.float32)
     assert scorer.predict(x).tolist() == [1, 1, 1, 1]
+
+
+def test_bf16_io_parity(rng):
+    """bf16 host↔device IO: scores within input-quantization tolerance of
+    f32, output dtype still float32."""
+    from fraud_detection_tpu.ops.logistic import LogisticParams
+    from fraud_detection_tpu.ops.scaler import scaler_fit
+    from fraud_detection_tpu.ops.scorer import BatchScorer
+
+    d = 30
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32), intercept=np.float32(-1.0)
+    )
+    x = rng.standard_normal((257, d)).astype(np.float32)
+    scaler = scaler_fit(x)
+    f32 = BatchScorer(params, scaler).predict_proba(x)
+    bf16 = BatchScorer(params, scaler, io_dtype="bfloat16").predict_proba(x)
+    assert bf16.dtype == np.float32
+    np.testing.assert_allclose(bf16, f32, atol=5e-2)
+    assert np.abs(bf16 - f32).mean() < 5e-3  # typically ~1e-3
+
+    with pytest.raises(ValueError):
+        BatchScorer(params, scaler, io_dtype="float16")

@@ -191,3 +191,60 @@ def test_microbatcher_stop_fails_pending(served):
     result = client.loop.run_until_complete(go())
     assert result in ("resolved", "failed-cleanly")
     client.loop.run_until_complete(batcher.start())  # restore for teardown
+
+
+# -- legacy sync API (reference deploy.py parity, SURVEY §2.1 #14) ----------
+
+
+@pytest.fixture()
+def legacy_client(rng):
+    from fraud_detection_tpu.service import legacy
+
+    d = 30
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32), intercept=np.float32(-1.0)
+    )
+    x = rng.standard_normal((200, d)).astype(np.float32)
+    scaler = scaler_fit(x)
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    model = FraudLogisticModel(params, scaler, names)
+    client = TestClient(legacy.create_app(model=model))
+    yield client, model, names
+    client.close()
+
+
+def test_legacy_index_banner(legacy_client):
+    client, *_ = legacy_client
+    r = client.get("/")
+    assert r.status_code == 200 and "live" in r.json()["msg"]
+
+
+def test_legacy_predict_contract(legacy_client):
+    client, model, names = legacy_client
+    features = {n: 0.1 for n in names}
+    r = client.post("/predict", json=features)
+    assert r.status_code == 200
+    body = r.json()
+    assert set(body) == {"prediction", "fraud_probability", "alert"}
+    assert body["prediction"] in (0, 1)
+    assert isinstance(body["alert"], bool)
+    # alert iff prob > 0.8 (deploy.py:40)
+    assert body["alert"] == (body["fraud_probability"] > 0.8)
+    # parity with the library scorer
+    _, p = model.score_one(features)
+    assert abs(body["fraud_probability"] - round(p, 4)) < 1e-9
+
+
+def test_legacy_predict_list_and_wrapped_forms(legacy_client):
+    client, *_ = legacy_client
+    assert client.post("/predict", json=[0.1] * 30).status_code == 200
+    assert (
+        client.post("/predict", json={"features": [0.1] * 30}).status_code == 200
+    )
+
+
+def test_legacy_error_contract(legacy_client):
+    """Any failure → 500 {"error": ...} (deploy.py:49-50)."""
+    client, *_ = legacy_client
+    r = client.post("/predict", json={"Time": 1.0})  # missing features
+    assert r.status_code == 500 and "error" in r.json()

@@ -1,0 +1,59 @@
+"""utils tier: jax.profiler trace capture + JSON logging."""
+
+import glob
+import json
+import logging
+import os
+
+import numpy as np
+
+from fraud_detection_tpu.utils import annotate, device_trace, setup_json_logging
+from fraud_detection_tpu.utils.jsonlog import JsonFormatter
+
+
+def test_device_trace_captures(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "trace")
+    with device_trace(d):
+        with annotate("matmul"):
+            x = jnp.ones((64, 64))
+            (x @ x).block_until_ready()
+    # jax writes plugins/profile/<ts>/*.trace.json.gz (or .xplane.pb)
+    files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in files), files
+
+
+def test_device_trace_nonfatal_on_double_start(tmp_path):
+    """A second concurrent trace must degrade to unprofiled, not raise."""
+    with device_trace(str(tmp_path / "a")):
+        with device_trace(str(tmp_path / "b")):
+            pass  # inner start fails (already tracing) but is swallowed
+
+
+def test_json_formatter_fields():
+    rec = logging.LogRecord(
+        "fraud.test", logging.WARNING, __file__, 1, "hello %s", ("world",), None
+    )
+    rec.correlation_id = "abc-123"
+    rec.unserializable = object()
+    out = json.loads(JsonFormatter().format(rec))
+    assert out["message"] == "hello world"
+    assert out["level"] == "WARNING"
+    assert out["logger"] == "fraud.test"
+    assert out["correlation_id"] == "abc-123"
+    assert out["unserializable"].startswith("<object")
+    assert out["ts"].endswith("Z")
+
+
+def test_setup_json_logging_idempotent(capsys):
+    name = "fraud.jsonlog.test"
+    setup_json_logging(root=name)
+    setup_json_logging(root=name)  # second call must not duplicate handlers
+    logger = logging.getLogger(name)
+    assert len(logger.handlers) == 1
+    logger.info("structured", extra={"correlation_id": "xyz"})
+    err = capsys.readouterr().err.strip()
+    body = json.loads(err.splitlines()[-1])
+    assert body["correlation_id"] == "xyz" and body["message"] == "structured"
