@@ -27,11 +27,14 @@ from fraud_detection_tpu.data.loader import KAGGLE_FEATURES, LABEL_COLUMN
 _SHIFT_SEED = 1729
 
 
-def fraud_shift() -> np.ndarray:
+def fraud_shift(scale: float = 1.5) -> np.ndarray:
     """The direction fraud rows are shifted along in V-space. One consistent
     direction for all chunks and all seeds (a per-chunk or per-seed direction
-    would destroy cross-dataset linear separability)."""
-    return np.random.default_rng(_SHIFT_SEED).standard_normal(28).astype(np.float32) * 1.5
+    would destroy cross-dataset linear separability). ``scale`` sets the
+    separability: 1.5 (default) is near-perfectly separable for CI gates;
+    ~0.5 lands AUC near the reference's real-Kaggle 0.971 baseline
+    (plots/roc_curve.png), which is what the checked-in demo dataset uses."""
+    return np.random.default_rng(_SHIFT_SEED).standard_normal(28).astype(np.float32) * scale
 
 
 def generate_synthetic_rows(
@@ -64,6 +67,7 @@ def generate_synthetic_data(
     fraud_ratio: float = 0.01,
     seed: int = 42,
     chunk_rows: int = 1_000_000,
+    shift_scale: float = 1.5,
 ) -> str:
     """Write a synthetic Kaggle-schema CSV, chunked for 10M-row scale.
 
@@ -82,7 +86,7 @@ def generate_synthetic_data(
         f.write(header + "\n")
         written = 0
         chunk_i = 0
-        shift = fraud_shift()
+        shift = fraud_shift(shift_scale)
         while written < n_samples:
             n = min(chunk_rows, n_samples - written)
             x, y = generate_synthetic_rows(n, fraud_ratio, seed + chunk_i, shift)

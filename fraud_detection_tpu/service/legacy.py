@@ -42,7 +42,7 @@ def create_app(model=None) -> App:
     async def predict(req: Request) -> Response:
         model = state["model"]
         if model is None:
-            raise HTTPError(503, "model not loaded")
+            return Response({"error": "model not loaded"}, status_code=500)
         # The reference returns 500 {"error": ...} for every failure mode
         # (deploy.py:49-50), including malformed input — keep that contract.
         try:

@@ -51,3 +51,8 @@ def setup_json_logging(level: int = logging.INFO, root: str | None = None) -> No
     for h in logger.handlers:
         h.setFormatter(JsonFormatter())
     logger.setLevel(level)
+    if root:
+        # A named logger keeps emitting through root handlers too unless
+        # propagation is cut — otherwise every record prints twice (once as
+        # JSON here, once plain-text via the root handler).
+        logger.propagate = False
