@@ -54,6 +54,32 @@ log = logging.getLogger("fraud_detection_tpu.api")
 TASK_NAME = "xai_tasks.compute_shap"  # reference task name (api/worker.py:65)
 
 
+def _frontend_index() -> bytes | None:
+    """Locate frontend/index.html. An explicit ``FRONTEND_DIR`` is
+    authoritative (a missing bundle there is reported, not silently papered
+    over with another UI); otherwise try the working directory then the repo
+    checkout the package lives in."""
+    import os
+
+    explicit = os.environ.get("FRONTEND_DIR")
+    if explicit is not None:
+        path = os.path.join(explicit, "index.html")
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return f.read()
+        log.warning("FRONTEND_DIR=%s has no index.html — UI disabled", explicit)
+        return None
+    for d in (
+        "frontend",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "frontend"),
+    ):
+        path = os.path.join(d, "index.html")
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return f.read()
+    return None
+
+
 def create_app(
     database_url: str | None = None, broker_url: str | None = None
 ) -> App:
@@ -114,6 +140,17 @@ def create_app(
     app.on_shutdown.append(shutdown)
 
     # -- endpoints ---------------------------------------------------------
+    @app.get("/")
+    async def index(req: Request) -> Response:
+        """Dashboard UI. The reference ships a frontend scaffold with no
+        source (fraud-frontend/, SURVEY.md §2.2); here GET / serves the
+        working single-page dashboard when the frontend bundle is present,
+        and degrades to a JSON banner when it isn't."""
+        page = _frontend_index()
+        if page is not None:
+            return Response(page, media_type="text/html; charset=utf-8")
+        return Response({"msg": "fraud-detection-tpu API is live", "ui": "unavailable"})
+
     @app.get("/status")
     async def status(req: Request) -> Response:
         return Response({"status": "UP"})

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import logging
 
-from fraud_detection_tpu.service.http import App, HTTPError, Request, Response
+from fraud_detection_tpu.service.http import App, Request, Response
 
 log = logging.getLogger("fraud_detection_tpu.legacy")
 

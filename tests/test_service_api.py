@@ -45,6 +45,24 @@ def test_status(served):
     assert r.json()["status"] == "UP"
 
 
+def test_index_serves_dashboard(served, monkeypatch, tmp_path):
+    """GET / serves the frontend bundle when present (the reference's
+    fraud-frontend/ counterpart) and degrades to a JSON banner when not."""
+    client, *_ = served
+    r = client.get("/")
+    assert r.status_code == 200
+    assert r.headers["content-type"].startswith("text/html")
+    assert b"fraud-detection-tpu" in r.body
+    assert b"/predict" in r.body  # the page drives the scoring API
+
+    # An explicit FRONTEND_DIR without a bundle disables the UI rather than
+    # silently serving some other checkout's page.
+    monkeypatch.setenv("FRONTEND_DIR", str(tmp_path / "nowhere"))
+    r = client.get("/")
+    assert r.status_code == 200
+    assert "API is live" in r.json()["msg"]
+
+
 def test_health(served):
     client, *_ = served
     r = client.get("/health")
