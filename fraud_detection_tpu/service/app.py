@@ -54,30 +54,43 @@ log = logging.getLogger("fraud_detection_tpu.api")
 TASK_NAME = "xai_tasks.compute_shap"  # reference task name (api/worker.py:65)
 
 
+_frontend_cache: dict[str | None, bytes | None] = {}
+
+
 def _frontend_index() -> bytes | None:
     """Locate frontend/index.html. An explicit ``FRONTEND_DIR`` is
     authoritative (a missing bundle there is reported, not silently papered
-    over with another UI); otherwise try the working directory then the repo
-    checkout the package lives in."""
+    over with another UI); otherwise the bundle shipped with this package
+    wins over whatever the working directory happens to contain. Bytes are
+    cached per FRONTEND_DIR so the handler never touches disk on the event
+    loop after the first request."""
     import os
 
     explicit = os.environ.get("FRONTEND_DIR")
+    if explicit in _frontend_cache:
+        return _frontend_cache[explicit]
+    page: bytes | None = None
     if explicit is not None:
         path = os.path.join(explicit, "index.html")
         if os.path.exists(path):
             with open(path, "rb") as f:
-                return f.read()
-        log.warning("FRONTEND_DIR=%s has no index.html — UI disabled", explicit)
-        return None
-    for d in (
-        "frontend",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "frontend"),
-    ):
-        path = os.path.join(d, "index.html")
-        if os.path.exists(path):
-            with open(path, "rb") as f:
-                return f.read()
-    return None
+                page = f.read()
+        else:
+            log.warning("FRONTEND_DIR=%s has no index.html — UI disabled", explicit)
+    else:
+        for d in (
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "..", "..", "frontend"
+            ),
+            "frontend",
+        ):
+            path = os.path.join(d, "index.html")
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    page = f.read()
+                break
+    _frontend_cache[explicit] = page
+    return page
 
 
 def create_app(
