@@ -6,3 +6,4 @@ from fraud_detection_tpu.ckpt.checkpoint import (  # noqa: F401
     load_artifacts,
     save_artifacts,
 )
+from fraud_detection_tpu.ckpt.train_state import SGDCheckpointer  # noqa: F401

@@ -236,6 +236,8 @@ def logistic_fit_sgd(
     class_weight: dict | str | None = None,
     seed: int = 0,
     mesh=None,
+    epoch_callback=None,
+    resume: dict | None = None,
 ) -> LogisticParams:
     """Data-parallel minibatch SGD with explicit ``psum`` allreduce.
 
@@ -243,6 +245,14 @@ def logistic_fit_sgd(
     independent). Not bit-identical to L-BFGS but converges to the same
     optimum; used for the 10M-row configuration where L-BFGS full-batch
     linesearch passes are wasteful.
+
+    Elastic-training hooks (the reference has no checkpoint/resume story —
+    SURVEY.md §5): ``epoch_callback(epoch, params, velocity, rng)`` fires
+    after each completed epoch (``ckpt.SGDCheckpointer.epoch_callback``
+    persists it atomically), and ``resume`` is that checkpointer's saved
+    state — training continues at the next epoch with the exact optimizer
+    velocity and host PRNG stream, so an interrupted+resumed fit is
+    bit-identical to an uninterrupted one.
     """
     mesh = mesh or default_mesh()
     ndev = mesh.shape[DATA_AXIS]
@@ -290,7 +300,42 @@ def logistic_fit_sgd(
         coef=jnp.zeros((d,), jnp.float32), intercept=jnp.zeros(())
     )
     rng = np.random.default_rng(seed)
-    for e in range(epochs):
+    start_epoch = 0
+    # Everything the LR schedule / permutation stream / shapes depend on.
+    # A checkpoint taken under a different fingerprint cannot resume this
+    # fit bit-identically, so it is rejected instead of silently reused.
+    fingerprint = {
+        "n": int(n), "d": int(d), "epochs": int(epochs),
+        "batch_size": int(batch_size), "lr": float(lr),
+        "momentum": float(momentum), "seed": int(seed), "ndev": int(ndev),
+    }
+    if resume is not None:
+        saved_fp = resume.get("fingerprint")
+        if saved_fp is not None and saved_fp != fingerprint:
+            diff = {
+                k: (saved_fp.get(k), fingerprint[k])
+                for k in fingerprint
+                if saved_fp.get(k) != fingerprint[k]
+            }
+            raise ValueError(
+                f"checkpoint does not match this fit (saved vs current): {diff}"
+            )
+        if np.asarray(resume["coef"]).shape != (d,):
+            raise ValueError(
+                f"checkpoint coef shape {np.asarray(resume['coef']).shape} "
+                f"does not match {d} features"
+            )
+        params = LogisticParams(
+            coef=jnp.asarray(resume["coef"], jnp.float32),
+            intercept=jnp.asarray(resume["intercept"], jnp.float32),
+        )
+        velocity = LogisticParams(
+            coef=jnp.asarray(resume["v_coef"], jnp.float32),
+            intercept=jnp.asarray(resume["v_intercept"], jnp.float32),
+        )
+        rng.bit_generator.state = resume["rng_state"]
+        start_epoch = int(resume["epoch"]) + 1
+    for e in range(start_epoch, epochs):
         # Cosine-decayed lr: converges to the optimum instead of hovering at
         # the SGD noise floor (needed for AUC parity with the L-BFGS path).
         lr_e = jnp.float32(lr * 0.5 * (1.0 + np.cos(np.pi * e / max(epochs, 1))))
@@ -298,4 +343,6 @@ def logistic_fit_sgd(
             params, velocity, x_dev, y_dev, sw_dev, valid_dev,
             jnp.asarray(rng.permutation(n_local)), lr_e,
         )
+        if epoch_callback is not None:
+            epoch_callback(e, params, velocity, rng, fingerprint)
     return params

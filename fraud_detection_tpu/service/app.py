@@ -67,8 +67,9 @@ def _frontend_index() -> bytes | None:
     import os
 
     explicit = os.environ.get("FRONTEND_DIR")
-    if explicit in _frontend_cache:
-        return _frontend_cache[explicit]
+    cached = _frontend_cache.get(explicit)
+    if cached is not None:
+        return cached
     page: bytes | None = None
     if explicit is not None:
         path = os.path.join(explicit, "index.html")
@@ -89,7 +90,8 @@ def _frontend_index() -> bytes | None:
                 with open(path, "rb") as f:
                     page = f.read()
                 break
-    _frontend_cache[explicit] = page
+    if page is not None:  # a missing bundle stays re-checkable (late deploy)
+        _frontend_cache[explicit] = page
     return page
 
 

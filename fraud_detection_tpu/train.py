@@ -30,6 +30,7 @@ import numpy as np
 
 from fraud_detection_tpu import config
 from fraud_detection_tpu.ckpt.checkpoint import save_artifacts
+from fraud_detection_tpu.ckpt.train_state import SGDCheckpointer
 from fraud_detection_tpu.data.loader import (
     load_creditcard_csv,
     stratified_kfold_indices,
@@ -55,11 +56,16 @@ log = logging.getLogger("fraud_detection_tpu.train")
 SGD_ROW_THRESHOLD = 2_000_000
 
 
-def _fit(x, y, *, seed: int, solver: str, class_weight):
+def _fit(x, y, *, seed: int, solver: str, class_weight, checkpointer=None):
     if solver == "sgd" or (solver == "auto" and x.shape[0] > SGD_ROW_THRESHOLD):
         return logistic_fit_sgd(
-            x, y, epochs=8, batch_size=65536, lr=1.0, seed=seed, class_weight=class_weight
+            x, y, epochs=8, batch_size=65536, lr=1.0, seed=seed,
+            class_weight=class_weight,
+            epoch_callback=checkpointer.epoch_callback if checkpointer else None,
+            resume=checkpointer.latest() if checkpointer else None,
         )
+    # L-BFGS is a single compiled solve — nothing to resume mid-way; a
+    # checkpoint request silently applies only to the SGD path.
     return logistic_fit_lbfgs(
         x, y, max_iter=200, sharded=True, class_weight=class_weight
     )
@@ -90,6 +96,7 @@ def train(
     out_dir: str = "models",
     model_family: str = "logistic",
     gbt_config: GBTConfig | None = None,
+    checkpoint_dir: str | None = None,
 ) -> dict:
     """Run the full pipeline; returns a metrics dict."""
     t0 = time.time()
@@ -192,9 +199,18 @@ def train(
             )
             test_scores = np.asarray(gbt_predict_proba(gmodel, xs_test))
         else:
+            # Elastic recovery applies to the long stage (the final fit on
+            # the SMOTE'd full train split); a preempted run restarted with
+            # the same checkpoint_dir continues at the next epoch.
+            ck = SGDCheckpointer(checkpoint_dir) if checkpoint_dir else None
             params = _fit(
                 x_fin, y_fin, seed=seed, solver=solver, class_weight=class_weight,
+                checkpointer=ck,
             )
+            if ck is not None:
+                # The fit finished: leftover checkpoints must not hijack a
+                # future run with this directory into "resuming" stale params.
+                ck.clear()
             test_scores = np.asarray(predict_proba(params, xs_test))
         test_auc = float(auc_roc(test_scores, y_test))
         metrics["test_auc"] = test_auc
@@ -270,6 +286,12 @@ def main(argv=None):
         help="capture a jax.profiler device trace of the run to this dir "
         "(view with tensorboard --logdir or Perfetto)",
     )
+    ap.add_argument(
+        "--checkpoint-dir", default=None,
+        help="write per-epoch SGD training checkpoints here; re-running "
+        "with the same dir resumes an interrupted fit at the next epoch "
+        "(sgd/auto solver only)",
+    )
     args = ap.parse_args(argv)
 
     def go():
@@ -282,6 +304,7 @@ def main(argv=None):
             register=not args.no_register,
             out_dir=args.out_dir,
             model_family=args.model,
+            checkpoint_dir=args.checkpoint_dir,
         )
 
     if args.profile_dir:

@@ -85,3 +85,147 @@ def test_model_score_one_validates_arity(rng):
         m.score_one([1.0, 2.0])
     with pytest.raises(ValueError, match="missing"):
         m.score_one({"Time": 1.0})
+
+
+# ---------------------------------------------------------------------------
+# Elastic training checkpoints (ckpt/train_state.py) — the reference has no
+# checkpoint/resume story (SURVEY.md §5); these pin the TPU-native one.
+# ---------------------------------------------------------------------------
+
+def _sgd_data(rng, n=4096, d=12):
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal(d).astype(np.float32)
+    y = ((x @ w) > 0).astype(np.int32)
+    return x, y
+
+
+def test_sgd_checkpointer_save_latest_and_retention(tmp_path, rng):
+    from fraud_detection_tpu.ckpt.train_state import SGDCheckpointer
+    from fraud_detection_tpu.ops.logistic import LogisticParams
+
+    ck = SGDCheckpointer(str(tmp_path / "ck"), keep=2)
+    host_rng = np.random.default_rng(0)
+    for e in range(5):
+        p = LogisticParams(
+            coef=np.full((3,), float(e), np.float32), intercept=np.float32(e)
+        )
+        ck.epoch_callback(e, p, p, host_rng)
+    # retention: only the last 2 epochs remain
+    assert ck._epochs() == [3, 4] or sorted(ck._epochs()) == [3, 4]
+    latest = ck.latest()
+    assert latest["epoch"] == 4
+    np.testing.assert_array_equal(latest["coef"], np.full((3,), 4.0, np.float32))
+    # rng state round-trips exactly
+    rng2 = np.random.default_rng(123)
+    rng2.bit_generator.state = latest["rng_state"]
+    assert rng2.bit_generator.state == host_rng.bit_generator.state
+    assert rng2.permutation(10).tolist() == host_rng.permutation(10).tolist()
+
+
+def test_sgd_resume_bit_identical(tmp_path, rng):
+    """An interrupted fit resumed from a checkpoint must equal the
+    uninterrupted fit exactly — optimizer velocity and the host PRNG stream
+    are part of the checkpoint."""
+    from fraud_detection_tpu.ckpt.train_state import SGDCheckpointer
+    from fraud_detection_tpu.ops.logistic import logistic_fit_sgd
+
+    x, y = _sgd_data(rng)
+    kw = dict(epochs=6, batch_size=512, lr=0.5, seed=7)
+
+    full = logistic_fit_sgd(x, y, **kw)
+
+    ck = SGDCheckpointer(str(tmp_path / "ck"))
+
+    # "Crash" mid-run: preemption lands after epoch 2 of the 6-epoch fit
+    # (same epochs → same LR schedule, which is part of what resume must
+    # reproduce).
+    class Preempted(RuntimeError):
+        pass
+
+    def crashing_callback(e, params, velocity, rng, fingerprint=None):
+        ck.epoch_callback(e, params, velocity, rng, fingerprint)
+        if e == 2:
+            raise Preempted()
+
+    try:
+        logistic_fit_sgd(x, y, **kw, epoch_callback=crashing_callback)
+        raise AssertionError("fit was expected to be preempted")
+    except Preempted:
+        pass
+    state = ck.latest()
+    assert state["epoch"] == 2
+    resumed = logistic_fit_sgd(x, y, **kw, resume=state)
+
+    np.testing.assert_array_equal(np.asarray(full.coef), np.asarray(resumed.coef))
+    np.testing.assert_array_equal(
+        np.asarray(full.intercept), np.asarray(resumed.intercept)
+    )
+
+
+def test_sgd_resume_nothing_to_do(tmp_path, rng):
+    """Resuming at epoch == epochs runs zero further epochs and returns the
+    checkpointed params unchanged."""
+    from fraud_detection_tpu.ckpt.train_state import SGDCheckpointer
+    from fraud_detection_tpu.ops.logistic import logistic_fit_sgd
+
+    x, y = _sgd_data(rng, n=1024)
+    ck = SGDCheckpointer(str(tmp_path / "ck"))
+    logistic_fit_sgd(
+        x, y, epochs=2, batch_size=256, seed=3, epoch_callback=ck.epoch_callback
+    )
+    state = ck.latest()
+    out = logistic_fit_sgd(x, y, epochs=2, batch_size=256, seed=3, resume=state)
+    np.testing.assert_array_equal(np.asarray(out.coef), state["coef"])
+
+
+def test_train_pipeline_checkpoints_then_clears(tmp_path, rng, monkeypatch):
+    """train(checkpoint_dir=...) with the sgd solver checkpoints every epoch
+    of the final fit, and clears them once the fit completes so a later run
+    with the same directory cannot resume past stale params."""
+    import fraud_detection_tpu.train as train_mod
+    from fraud_detection_tpu.ckpt.train_state import SGDCheckpointer
+    from fraud_detection_tpu.data.synthetic import generate_synthetic_data
+
+    saves = []
+
+    class SpyCheckpointer(SGDCheckpointer):
+        def epoch_callback(self, *a, **kw):
+            path = super().epoch_callback(*a, **kw)
+            saves.append(path)
+            return path
+
+    monkeypatch.setattr(train_mod, "SGDCheckpointer", SpyCheckpointer)
+    csv = str(tmp_path / "cc.csv")
+    generate_synthetic_data(csv, n_samples=1500, seed=5)
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    ckdir = str(tmp_path / "ck")
+    metrics = train_mod.train(
+        data_csv=csv, n_folds=2, solver="sgd", register=False,
+        out_dir=str(tmp_path / "models"), checkpoint_dir=ckdir,
+    )
+    import os
+
+    assert metrics["test_auc"] > 0.8
+    assert len(saves) == 8  # one per epoch of the final fit
+    assert not any(f.startswith("sgd_epoch_") for f in os.listdir(ckdir))
+
+
+def test_sgd_resume_rejects_mismatched_fingerprint(tmp_path, rng):
+    from fraud_detection_tpu.ckpt.train_state import SGDCheckpointer
+    from fraud_detection_tpu.ops.logistic import logistic_fit_sgd
+
+    x, y = _sgd_data(rng, n=1024)
+    ck = SGDCheckpointer(str(tmp_path / "ck"))
+    logistic_fit_sgd(
+        x, y, epochs=2, batch_size=256, seed=3, epoch_callback=ck.epoch_callback
+    )
+    state = ck.latest()
+    assert state["fingerprint"]["epochs"] == 2
+    import pytest
+
+    with pytest.raises(ValueError, match="does not match this fit"):
+        # different epochs → different LR schedule → not resumable
+        logistic_fit_sgd(x, y, epochs=4, batch_size=256, seed=3, resume=state)
+    with pytest.raises(ValueError, match="does not match this fit"):
+        # different seed → different shuffle stream → not the same run
+        logistic_fit_sgd(x, y, epochs=2, batch_size=256, seed=4, resume=state)
