@@ -104,15 +104,18 @@ def scaler_path() -> str:
 # --------------------------------------------------------------------------
 
 def broker_url() -> str:
-    """Task-queue broker. Native default is a SQLite-backed queue; a
-    ``redis://``/``sentinel://`` URL selects Redis when the client lib is
-    installed (reference default: sentinel://redis-master:26379/0)."""
+    """Task-queue broker URL (reference default:
+    sentinel://redis-master:26379/0, xai_tasks.py:59). This build ships the
+    SQLite-WAL queue (Celery delivery semantics); a ``redis://`` /
+    ``sentinel://`` URL fails fast with a clear error — the scheme is the
+    dispatch point for a Redis backend."""
     return _get("CELERY_BROKER_URL", "sqlite:///taskq.db")
 
 
 def database_url() -> str:
-    """Results DB. Native default is SQLite; ``postgresql://`` URLs are used
-    when psycopg2 is installed (reference default in db/db.py:6-9)."""
+    """Results DB URL (reference default in db/db.py:6-9). This build ships
+    SQLite; a ``postgresql://`` URL fails fast with a clear error — the SQL
+    is Postgres-compatible and the scheme is the dispatch point."""
     return _get("DATABASE_URL", "sqlite:///fraud.db")
 
 

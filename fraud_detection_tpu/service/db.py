@@ -2,8 +2,10 @@
 
 Replaces the reference's SQLAlchemy/Postgres + alembic stack (db/db.py,
 db/models.py, alembic/) with a dependency-free layer. ``DATABASE_URL``
-selects the backend: ``sqlite:///path`` (default, stdlib) or
-``postgresql://...`` when psycopg2 is installed.
+selects the backend; this build ships ``sqlite:///path`` (stdlib, WAL).
+The SQL is deliberately Postgres-compatible and the URL scheme is the
+dispatch point — a ``postgresql://`` URL fails fast with a clear error
+rather than pretending (psycopg2 is not vendored here).
 
 One table, ``transaction_results`` (db/models.py:16-24), used by BOTH the
 worker writes and the ``/explain`` readback — unifying the reference's
