@@ -48,8 +48,17 @@ def initialize_distributed() -> None:
     Controlled by the standard JAX env vars (``JAX_COORDINATOR_ADDRESS``,
     ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``) or TPU pod metadata.
     """
-    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
-        jax.distributed.initialize()
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coord:
+        kwargs: dict = {"coordinator_address": coord}
+        # jax's cluster auto-detect knows TPU-pod/SLURM metadata, but plain
+        # env-var deployments (k8s StatefulSet, manual multi-host) must pass
+        # the counts explicitly.
+        if os.environ.get("JAX_NUM_PROCESSES"):
+            kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+        if os.environ.get("JAX_PROCESS_ID"):
+            kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+        jax.distributed.initialize(**kwargs)
         log.info(
             "jax.distributed initialized: process %d/%d, %d local / %d global devices",
             jax.process_index(),

@@ -1,0 +1,110 @@
+"""Multi-host (DCN) bring-up test.
+
+Everything else in the suite exercises collectives on a single-process
+8-virtual-device mesh. This spawns TWO coordinated JAX processes (the
+jax.distributed runtime over localhost — the same code path a real
+multi-host TPU pod uses over DCN) with 4 virtual CPU devices each, builds
+the global (8, 1) mesh through ``parallel.mesh``, and runs a cross-process
+``psum`` under ``shard_map``. It validates:
+
+- ``initialize_distributed()`` env-var wiring (JAX_COORDINATOR_ADDRESS /
+  JAX_NUM_PROCESSES / JAX_PROCESS_ID);
+- the global mesh spans both processes' devices;
+- a collective actually reduces across the process boundary.
+
+The reference has no analogue (its only inter-process transport is
+Redis/Postgres — SURVEY.md §5 "Distributed communication backend").
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import numpy as np
+import jax
+
+# Site plugins (the TPU PJRT plugin in sitecustomize) may force their own
+# platform list — pin CPU the way tests/conftest.py does.
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fraud_detection_tpu.parallel.mesh import DATA_AXIS, create_mesh, initialize_distributed
+
+initialize_distributed()
+assert jax.process_count() == 2, jax.process_count()
+assert jax.local_device_count() == 4
+assert jax.device_count() == 8
+
+mesh = create_mesh()  # all 8 global devices on the data axis
+from jax import shard_map
+
+summed = shard_map(
+    lambda x: jax.lax.psum(x, DATA_AXIS),
+    mesh=mesh,
+    in_specs=P(DATA_AXIS),
+    out_specs=P(),
+)
+
+# Each process contributes its rank+1 from its own 4 shards:
+# psum = 4*1 + 4*2 = 12 — provably crossed the process boundary.
+local = np.full((4,), float(jax.process_index() + 1), np.float32)
+garr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P(DATA_AXIS)), local
+)
+out = summed(garr)
+val = float(np.asarray(jax.jit(lambda v: v[0])(out)))
+assert val == 12.0, val
+print(f"DCN_OK rank={jax.process_index()} psum={val}", flush=True)
+"""
+
+
+def test_two_process_dcn_psum():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(rank),
+            PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        )
+        # The parent test process pins single-process XLA flags at import
+        # time; children get their own (set inside WORKER).
+        env.pop("XLA_FLAGS", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", WORKER],
+                env=env,
+                cwd=REPO,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+    finally:
+        # A hang in one rank must not leak children or hide the other
+        # rank's traceback.
+        for p in procs[len(outs):]:
+            p.kill()
+            out, _ = p.communicate()
+            outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"DCN_OK rank={rank} psum=12.0" in out, out
