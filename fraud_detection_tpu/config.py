@@ -156,6 +156,12 @@ def scorer_max_wait_ms() -> float:
     return _get_float("SCORER_MAX_WAIT_MS", 2.0)
 
 
+def scorer_max_inflight() -> int:
+    """Concurrently-scored batches: >1 pipelines transfers on a high-RTT
+    link while the device runs batches back-to-back."""
+    return _get_int("SCORER_MAX_INFLIGHT", 4)
+
+
 @dataclass
 class Settings:
     """Snapshot of all settings, for logging/debugging."""
