@@ -136,12 +136,13 @@ def create_app(
             model, source = load_production_model()
             state["model"], state["model_source"] = model, source
             batcher = MicroBatcher(model.scorer)
-            await batcher.start()
+            await batcher.start()  # warms the bucket ladder; can raise
             state["batcher"] = batcher
             metrics.model_loaded.set(1)
         except RuntimeError as e:
             metrics.model_loaded.set(0)
-            log.error("model load failed at startup: %s", e)
+            state["model"] = state["batcher"] = None  # all-or-nothing
+            log.error("model load/warmup failed at startup: %s", e)
 
     async def shutdown():
         if state["batcher"]:
@@ -191,7 +192,9 @@ def create_app(
         metrics.predictions_submitted.inc()
         corr_id = req.state["correlation_id"]
         model = state["model"]
-        if model is None:
+        if model is None or state["batcher"] is None:
+            # batcher can be None with a loaded model if its startup warmup
+            # raised (e.g. device compile failure) — degraded, not a 500.
             raise HTTPError(503, "model not loaded")
         try:
             features = parse_transaction(req.json())

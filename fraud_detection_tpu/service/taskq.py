@@ -127,33 +127,50 @@ class Broker:
         Deliverable = QUEUED and visible, or CLAIMED whose visibility window
         lapsed (the acks_late redelivery path after a worker death).
         """
+        tasks = self.claim_many(worker_id, 1, visibility_timeout)
+        return tasks[0] if tasks else None
+
+    def claim_many(
+        self,
+        worker_id: str,
+        limit: int,
+        visibility_timeout: float = DEFAULT_VISIBILITY_TIMEOUT,
+    ) -> list[Task]:
+        """Atomically claim up to ``limit`` deliverable tasks (oldest first).
+
+        Same visibility/acks-late semantics as :meth:`claim`; one UPDATE per
+        row under one transaction. Lets a worker amortize a single device
+        dispatch over many tasks (the batched-SHAP hot path).
+        """
         now = time.time()
+        claimed: list[Task] = []
         with self._lock, self._conn:
-            row = self._conn.execute(
+            rows = self._conn.execute(
                 "SELECT * FROM tasks WHERE status IN (?, ?) AND visible_at <= ? "
-                "ORDER BY created_at LIMIT 1",
-                (QUEUED, CLAIMED, now),
-            ).fetchone()
-            if row is None:
-                return None
-            cur = self._conn.execute(
-                "UPDATE tasks SET status = ?, claimed_by = ?, visible_at = ?, "
-                "updated_at = ? WHERE id = ? AND status = ? AND visible_at <= ?",
-                (
-                    CLAIMED, worker_id, now + visibility_timeout, now,
-                    row["id"], row["status"], now,
-                ),
-            )
-            if cur.rowcount != 1:  # lost the race to another worker
-                return None
-        return Task(
-            id=row["id"],
-            name=row["name"],
-            args=json.loads(row["args"]),
-            correlation_id=row["correlation_id"],
-            attempts=row["attempts"],
-            max_retries=row["max_retries"],
-        )
+                "ORDER BY created_at LIMIT ?",
+                (QUEUED, CLAIMED, now, limit),
+            ).fetchall()
+            for row in rows:
+                cur = self._conn.execute(
+                    "UPDATE tasks SET status = ?, claimed_by = ?, visible_at = ?, "
+                    "updated_at = ? WHERE id = ? AND status = ? AND visible_at <= ?",
+                    (
+                        CLAIMED, worker_id, now + visibility_timeout, now,
+                        row["id"], row["status"], now,
+                    ),
+                )
+                if cur.rowcount == 1:  # else lost the race to another worker
+                    claimed.append(
+                        Task(
+                            id=row["id"],
+                            name=row["name"],
+                            args=json.loads(row["args"]),
+                            correlation_id=row["correlation_id"],
+                            attempts=row["attempts"],
+                            max_retries=row["max_retries"],
+                        )
+                    )
+        return claimed
 
     def ack(self, task_id: str) -> None:
         """Acknowledge success — only called AFTER execution (acks_late)."""
