@@ -236,6 +236,54 @@ def bench_online_load(x, coef, intercept, mean, scale) -> tuple[float, float, fl
     return float(np.percentile(lat, 50)), float(np.percentile(lat, 99)), rps
 
 
+def bench_worker_tasks(coef, mean, scale) -> float:
+    """End-to-end async-XAI worker throughput (tasks/s): queue → batched
+    claim → one stacked score+explain dispatch → DB write → ack. The
+    reference analogue is the Celery worker at --concurrency=1
+    (xai_tasks.py), one task per delivery."""
+    import os
+    import tempfile
+
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+    from fraud_detection_tpu.ops.logistic import LogisticParams
+    from fraud_detection_tpu.ops.scaler import ScalerParams
+    from fraud_detection_tpu.service.db import ResultsDB
+    from fraud_detection_tpu.service.taskq import Broker
+    from fraud_detection_tpu.service.worker import XaiWorker
+
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    d = len(names)
+    scaler = ScalerParams(
+        mean=mean, scale=scale, var=scale**2, n_samples=np.float32(1)
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        model_dir = os.path.join(tmp, "models")
+        FraudLogisticModel(
+            LogisticParams(coef=coef, intercept=np.float32(-3.0)), scaler, names
+        ).save(model_dir, joblib_too=False)
+        os.environ["MODEL_PATH"] = os.path.join(model_dir, "logistic_model.joblib")
+        os.environ["MLFLOW_TRACKING_URI"] = f"file:{tmp}/mlruns"
+        db = ResultsDB(f"sqlite:///{tmp}/fraud.db")
+        broker = Broker(f"sqlite:///{tmp}/q.db")
+        feats = {k: 0.1 for k in names}
+        n_tasks = 512
+        for i in range(n_tasks):
+            db.create_pending(f"t{i}", feats, "c")
+            broker.send_task("xai_tasks.compute_shap", [f"t{i}", feats, "c"])
+        w = XaiWorker(
+            broker_url=broker.url, database_url=db.url, max_batch=64
+        )
+        w.warmup()
+        t0 = time.perf_counter()
+        done = 0
+        while True:
+            k = w.run_batch()
+            if not k:
+                break
+            done += k
+        return done / (time.perf_counter() - t0)
+
+
 def bench_latency(x, coef, intercept, mean, scale) -> tuple[float, float]:
     """Single-row online scoring latency (p50/p95 ms): the per-request
     /predict path incl. host→device transfer and readback — the number the
@@ -265,6 +313,7 @@ def main() -> None:
     online_p50, online_p99, online_rps = bench_online_load(
         x, coef, intercept, mean, scale
     )
+    worker_rate = bench_worker_tasks(coef, mean, scale)
     p50, p95 = bench_latency(x, coef, intercept, mean, scale)
     import jax
 
@@ -285,6 +334,7 @@ def main() -> None:
                 "online_p50_ms": round(online_p50, 3),
                 "online_p99_ms": round(online_p99, 3),
                 "online_rows_per_sec": round(online_rps),
+                "xai_worker_tasks_per_sec": round(worker_rate),
                 "single_row_p50_ms": round(p50, 3),
                 "single_row_p95_ms": round(p95, 3),
                 "device": jax.devices()[0].platform,
