@@ -20,6 +20,10 @@ WORKDIR /app
 COPY pyproject.toml ./
 COPY fraud_detection_tpu ./fraud_detection_tpu
 COPY bench.py __graft_entry__.py ./
+# Dashboard bundle (GET /) and the demo artifact tier (registry-fallback
+# fixtures — the container serves out of the box with no trained model).
+COPY frontend ./frontend
+COPY models ./models
 
 RUN pip install --no-cache-dir -U pip \
     && if [ "$JAX_VARIANT" = "tpu" ]; then \
