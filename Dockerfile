@@ -20,10 +20,6 @@ WORKDIR /app
 COPY pyproject.toml ./
 COPY fraud_detection_tpu ./fraud_detection_tpu
 COPY bench.py __graft_entry__.py ./
-# Dashboard bundle (GET /) and the demo artifact tier (registry-fallback
-# fixtures — the container serves out of the box with no trained model).
-COPY frontend ./frontend
-COPY models ./models
 
 RUN pip install --no-cache-dir -U pip \
     && if [ "$JAX_VARIANT" = "tpu" ]; then \
@@ -32,6 +28,13 @@ RUN pip install --no-cache-dir -U pip \
          pip install --no-cache-dir "jax>=0.8"; \
        fi \
     && pip install --no-cache-dir .[service,tools]
+
+# Dashboard bundle (GET /) and the demo artifact tier (registry-fallback
+# fixtures — the container serves out of the box with no trained model; set
+# REQUIRE_REGISTRY_MODEL=1 in production to forbid that fallback). After the
+# install layer so content edits don't re-install dependencies.
+COPY frontend ./frontend
+COPY models ./models
 
 # Non-root runtime user (reference Dockerfile:13-16 pattern). /data must be
 # created and owned here: fresh volumes inherit the image mountpoint's

@@ -156,6 +156,15 @@ def scorer_max_wait_ms() -> float:
     return _get_float("SCORER_MAX_WAIT_MS", 2.0)
 
 
+def require_registry_model() -> bool:
+    """``REQUIRE_REGISTRY_MODEL=1`` disables the local-artifact fallback:
+    serving fails loudly (degraded /health) when the registry has no model,
+    instead of silently scoring with whatever artifacts sit on disk (e.g.
+    the baked-in demo tier). Default off = the reference's fallback
+    behavior (api/app.py:41-44)."""
+    return _get("REQUIRE_REGISTRY_MODEL", "0").lower() in ("1", "true", "yes")
+
+
 def scorer_max_inflight() -> int:
     """Concurrently-scored batches: >1 pipelines transfers on a high-RTT
     link while the device runs batches back-to-back."""

@@ -36,6 +36,11 @@ def load_production_model() -> tuple[FraudLogisticModel, str]:
         log.info("loaded model from registry %s (%s)", uri, art)
         return model, f"registry:{uri}"
     except (FileNotFoundError, ValueError) as e:
+        if config.require_registry_model():
+            raise RuntimeError(
+                f"registry model {uri} unavailable ({e}) and "
+                "REQUIRE_REGISTRY_MODEL=1 forbids local-artifact fallback"
+            ) from e
         log.warning("registry load failed (%s); falling back to local artifacts", e)
 
     # 2. native artifact directory

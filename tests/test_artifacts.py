@@ -108,3 +108,18 @@ def test_demo_dataset_realistic_separability():
     model = FraudLogisticModel.load(_p("models"))
     auc = float(auc_roc(np.asarray(model.predict_proba(x))[:, 1], y))
     assert auc <= 0.999
+
+
+def test_require_registry_model_forbids_fallback(monkeypatch, tmp_path):
+    """REQUIRE_REGISTRY_MODEL=1 (production guard): an empty registry must
+    fail loudly instead of silently serving whatever artifacts sit on disk."""
+    import pytest
+
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    monkeypatch.setenv("MODEL_PATH", _p("models", "logistic_model.joblib"))
+    monkeypatch.setenv("SCALER_PATH", _p("models", "scaler.joblib"))
+    monkeypatch.setenv("REQUIRE_REGISTRY_MODEL", "1")
+    from fraud_detection_tpu.service.loading import load_production_model
+
+    with pytest.raises(RuntimeError, match="REQUIRE_REGISTRY_MODEL"):
+        load_production_model()

@@ -1,0 +1,148 @@
+"""Property-based tests: the mathematical axioms each kernel must satisfy
+for ANY input, not just the fixtures the parity tests use.
+
+Shapes are fixed (hypothesis draws values only) so the jitted kernels
+compile once per test, not per example — a compile storm on the 8-device
+CPU mesh would dominate the suite.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+SETTINGS = dict(max_examples=15, deadline=None)
+
+seeds = st.integers(min_value=0, max_value=2**31 - 1)
+
+
+def _values(seed: int, shape, scale=3.0):
+    return (
+        np.random.default_rng(seed).standard_normal(shape).astype(np.float32) * scale
+    )
+
+
+# ---------------------------------------------------------------------------
+# StandardScaler: transformed non-degenerate columns have mean 0 / std 1,
+# and transform is invertible.
+# ---------------------------------------------------------------------------
+
+@settings(**SETTINGS)
+@given(seeds)
+def test_scaler_normalizes_and_inverts(seed):
+    from fraud_detection_tpu.ops.scaler import scaler_fit, scaler_transform
+
+    x = _values(seed, (257, 7))
+    x[:, 3] *= 50.0  # wild scale differences must not matter
+    params = scaler_fit(x)
+    z = np.asarray(scaler_transform(params, x))
+    np.testing.assert_allclose(z.mean(axis=0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(z.std(axis=0), 1.0, atol=1e-3)
+    # invertibility: x == z * scale + mean
+    back = z * np.asarray(params.scale) + np.asarray(params.mean)
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# AUC: invariance under strictly monotone score transforms; extremes.
+# ---------------------------------------------------------------------------
+
+@settings(**SETTINGS)
+@given(seeds)
+def test_auc_monotone_invariance(seed):
+    from fraud_detection_tpu.ops.metrics import auc_roc
+
+    rng = np.random.default_rng(seed)
+    scores = rng.random(400).astype(np.float32)
+    labels = (rng.random(400) < 0.3).astype(np.int32)
+    labels[:2] = [0, 1]  # both classes present
+    base = float(auc_roc(scores, labels))
+    for f in (lambda s: 2 * s + 1, lambda s: np.tanh(s), lambda s: s**3):
+        np.testing.assert_allclose(
+            float(auc_roc(f(scores).astype(np.float32), labels)), base, atol=1e-6
+        )
+
+
+def test_auc_extremes():
+    from fraud_detection_tpu.ops.metrics import auc_roc
+
+    labels = np.array([0] * 50 + [1] * 50, np.int32)
+    perfect = np.concatenate([np.zeros(50), np.ones(50)]).astype(np.float32)
+    assert float(auc_roc(perfect, labels)) == 1.0
+    assert float(auc_roc(1 - perfect, labels)) == 0.0
+    constant = np.full(100, 0.5, np.float32)
+    np.testing.assert_allclose(float(auc_roc(constant, labels)), 0.5, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# Linear SHAP: the efficiency/completeness axiom — attributions sum exactly
+# to (logit(x) − base value) for every row.
+# ---------------------------------------------------------------------------
+
+@settings(**SETTINGS)
+@given(seeds)
+def test_linear_shap_completeness(seed):
+    from fraud_detection_tpu.ops.linear_shap import linear_shap, make_explainer
+
+    rng = np.random.default_rng(seed)
+    d = 30
+    coef = rng.standard_normal(d).astype(np.float32)
+    intercept = np.float32(rng.standard_normal())
+    mu = rng.standard_normal(d).astype(np.float32)
+    x = _values(seed + 1, (64, d))
+    ex = make_explainer(coef, intercept, background_mean=mu)
+    phi = np.asarray(linear_shap(ex, x))
+    logits = x @ coef + intercept
+    np.testing.assert_allclose(
+        phi.sum(axis=1) + ex.expected_value, logits, rtol=2e-4, atol=2e-3
+    )
+
+
+# ---------------------------------------------------------------------------
+# TreeSHAP: same axiom for the GBT family — sum(phi) + expected == logit.
+# ---------------------------------------------------------------------------
+
+def test_tree_shap_completeness():
+    from fraud_detection_tpu.ops.gbt import GBTConfig, gbt_fit, gbt_predict_logits
+    from fraud_detection_tpu.ops.tree_shap import build_tree_explainer, tree_shap
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((600, 8)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 3] > 0.4).astype(np.int32)
+    model = gbt_fit(x, y, GBTConfig(n_trees=12, max_depth=3))
+    explainer = build_tree_explainer(model, x[:32])
+    q = x[:40]
+    phi = np.asarray(tree_shap(explainer, q))
+    logits = np.asarray(gbt_predict_logits(model, q))
+    np.testing.assert_allclose(
+        phi.sum(axis=1) + float(explainer.expected_value), logits,
+        rtol=1e-3, atol=1e-3,
+    )
+
+
+# ---------------------------------------------------------------------------
+# SMOTE: synthetic rows are convex combinations of minority rows — each
+# coordinate lies inside the minority bounding box — and the output is
+# balanced with originals preserved.
+# ---------------------------------------------------------------------------
+
+@settings(**SETTINGS)
+@given(seeds)
+def test_smote_convexity_and_balance(seed):
+    import jax
+
+    from fraud_detection_tpu.ops.smote import smote
+
+    rng = np.random.default_rng(seed)
+    n, d = 400, 6
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = np.zeros(n, np.int32)
+    y[: n // 10] = 1  # 10% minority
+    x_res, y_res = smote(x, y, jax.random.key(seed % 1000))
+    x_res, y_res = np.asarray(x_res), np.asarray(y_res)
+    # balanced-ish output, originals first
+    assert int(y_res.sum()) >= int((y_res == 0).sum()) * 0.9
+    np.testing.assert_array_equal(x_res[:n], x)
+    # synthetic minority rows stay inside the minority bounding box
+    minority = x[y == 1]
+    lo, hi = minority.min(axis=0) - 1e-4, minority.max(axis=0) + 1e-4
+    synth = x_res[n:]
+    assert np.all(synth >= lo[None, :]) and np.all(synth <= hi[None, :])
