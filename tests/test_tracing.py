@@ -1,0 +1,48 @@
+"""Tracing wiring (service/tracing.py): real provider when configured,
+clean no-op otherwise — the reference's OTEL contract (api/app.py:88-104)
+without a hard dependency."""
+
+import fraud_detection_tpu.service.tracing as tracing
+
+
+def _reset(monkeypatch):
+    monkeypatch.setattr(tracing, "_initialized", False)
+    monkeypatch.setattr(tracing, "_tracer", None)
+
+
+def test_span_is_noop_without_setup(monkeypatch):
+    _reset(monkeypatch)
+    with tracing.span("anything", correlation_id="c1") as s:
+        assert s is None
+
+
+def test_setup_disabled_without_endpoint(monkeypatch):
+    _reset(monkeypatch)
+    monkeypatch.delenv("OTEL_EXPORTER_OTLP_ENDPOINT", raising=False)
+    assert tracing.setup_tracing() is False
+    # idempotent: repeated setup keeps the same answer without re-init
+    assert tracing.setup_tracing() is False
+
+
+def test_setup_with_endpoint_matches_sdk_availability(monkeypatch):
+    """With an endpoint configured: real spans when the OTEL SDK + OTLP
+    exporter are importable, graceful no-op (never a crash) when they
+    aren't — the degradation contract the module promises."""
+    import importlib.util
+
+    _reset(monkeypatch)
+    # The exporter batches in the background; nothing listens on the port,
+    # which must not affect span creation.
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT", "http://127.0.0.1:1")
+    sdk_present = importlib.util.find_spec("opentelemetry.sdk") is not None and (
+        importlib.util.find_spec("opentelemetry.exporter.otlp.proto.http")
+        is not None
+    )
+    enabled = tracing.setup_tracing(service_name="test-svc")
+    assert enabled is sdk_present
+    with tracing.span("unit-span", correlation_id="c2") as s:
+        if enabled:
+            assert s is not None and s.is_recording()
+        else:
+            assert s is None
+    _reset(monkeypatch)  # don't leak the provider into other tests
