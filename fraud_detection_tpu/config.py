@@ -53,9 +53,10 @@ def mesh_model_axis() -> int:
 
 def use_pallas() -> str:
     """``1``/``0``/``auto`` — hand-written Pallas kernels for the hot ops
-    (ops/pallas_kernels). Opt-in: XLA's fused paths measured at parity for
-    the 30-feature workload, so ``auto`` resolves to off (see
-    ops/pallas_kernels.pallas_enabled)."""
+    (ops/pallas_kernels). Per-kernel ``auto``: the blocked SMOTE k-NN is ON
+    for TPU backends (beats the XLA path at scale — see knn_pallas_enabled),
+    the scoring GEMV stays OFF (XLA's fusion wins at d=30 — see
+    pallas_enabled). ``1`` forces both on, ``0`` both off."""
     return _get("USE_PALLAS", "auto").lower()
 
 

@@ -114,13 +114,13 @@ def smote(
 
     x_min = jnp.asarray(x_np[y_np == minority])
     from fraud_detection_tpu.ops.pallas_kernels import (
-        KNN_VMEM_ROW_LIMIT,
+        knn_pallas_enabled,
         knn_topk,
-        pallas_enabled,
     )
 
-    if pallas_enabled() and x_min.shape[0] <= KNN_VMEM_ROW_LIMIT:
-        # VMEM-resident Pallas kernel (opt-in); XLA blockwise path otherwise.
+    if knn_pallas_enabled():
+        # Blocked Pallas kernel (default on TPU — beats the XLA path at
+        # scale and streams the minority set from HBM, no size limit).
         nn_idx = knn_topk(x_min, k_neighbors)
     else:
         nn_idx = _knn_indices(

@@ -65,3 +65,69 @@ def test_dispatch_is_opt_in(monkeypatch):
     assert pallas_enabled(backend="tpu") is True
     monkeypatch.setenv("USE_PALLAS", "0")
     assert pallas_enabled(backend="tpu") is False
+
+
+def test_knn_multi_key_block_merge(rng):
+    """Key-axis blocking: with several key blocks the running top-slot merge
+    must produce exactly the same neighbors as a single-block pass (this is
+    the path that lets the minority set stream from HBM with no size
+    limit)."""
+    from fraud_detection_tpu.ops.pallas_kernels import knn_topk
+    from fraud_detection_tpu.ops.smote import _knn_indices
+
+    x = rng.standard_normal((96, 5)).astype(np.float32)
+    ref = np.asarray(_knn_indices(x, 4))
+    # block_k=32 → 3 key blocks; block_q=32 → 3 query blocks
+    got = np.asarray(knn_topk(x, 4, block_q=32, block_k=32, interpret=True))
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_knn_kernel_handles_duplicate_rows(rng):
+    """Duplicate points (distance ties at 0) must still exclude self and
+    return valid neighbor indices."""
+    from fraud_detection_tpu.ops.pallas_kernels import knn_topk
+
+    from fraud_detection_tpu.ops.smote import _knn_indices
+
+    base = rng.standard_normal((10, 4)).astype(np.float32)
+    x = np.concatenate([base, base, base])  # every row duplicated 3×
+    idx = np.asarray(knn_topk(x, 2, block_q=8, block_k=16, interpret=True))
+    n = x.shape[0]
+    assert idx.shape == (n, 2)
+    assert (idx >= 0).all() and (idx < n).all()
+    for i in range(n):
+        assert i not in idx[i]  # self excluded
+        # nearest neighbors of a duplicated point are its duplicates
+        np.testing.assert_allclose(x[idx[i, 0]], x[i], atol=1e-6)
+    # exact parity with the XLA path including tie order (ascending index,
+    # the lax.top_k convention)
+    np.testing.assert_array_equal(idx, np.asarray(_knn_indices(x, 2)))
+
+
+def test_knn_rejects_non_commensurate_blocks(rng):
+    from fraud_detection_tpu.ops.pallas_kernels import knn_topk
+
+    x = rng.standard_normal((100, 5)).astype(np.float32)
+    import pytest
+
+    with pytest.raises(ValueError, match="divide"):
+        knn_topk(x, 4, block_q=48, block_k=64, interpret=True)
+
+
+def test_knn_gate_flag_normalization(monkeypatch):
+    """Both kernels' gates must read USE_PALLAS the same way — 'off' (or any
+    disable spelling) disables BOTH."""
+    from fraud_detection_tpu.ops.pallas_kernels import (
+        knn_pallas_enabled,
+        pallas_enabled,
+    )
+
+    for v in ("0", "false", "no", "off"):
+        monkeypatch.setenv("USE_PALLAS", v)
+        assert pallas_enabled("tpu") is False
+        assert knn_pallas_enabled("tpu") is False
+    monkeypatch.setenv("USE_PALLAS", "auto")
+    assert pallas_enabled("tpu") is False      # scorer: compiler wins
+    assert knn_pallas_enabled("tpu") is True   # knn: kernel wins
+    assert knn_pallas_enabled("cpu") is False  # mosaic needs a TPU
+    assert knn_pallas_enabled("gpu") is False  # pltpu kernels are TPU-only
