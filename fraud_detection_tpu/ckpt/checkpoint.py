@@ -169,7 +169,27 @@ def export_joblib_artifacts(
     model.n_features_in_ = len(feature_names)
     model.n_iter_ = np.array([1])
     joblib.dump(model, os.path.join(directory, model_filename))
+    export_scaler_artifacts(directory, scaler, feature_names)
 
+
+def export_scaler_artifacts(
+    directory: str,
+    scaler: ScalerParams | None,
+    feature_names: list[str],
+) -> None:
+    """The model-free slice of the reference artifact layout: scaler.joblib +
+    columns.joblib + feature_names.json (what preprocess.py:51-57 emits
+    before any model exists)."""
+    try:
+        import joblib
+        from sklearn.preprocessing import StandardScaler
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "joblib/sklearn are required for joblib export; install the "
+            "'tools' extra"
+        ) from e
+
+    os.makedirs(directory, exist_ok=True)
     if scaler is not None:
         sk = StandardScaler()
         sk.mean_ = np.asarray(scaler.mean, np.float64)

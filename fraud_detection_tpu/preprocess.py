@@ -18,9 +18,8 @@ import jax
 import numpy as np
 
 from fraud_detection_tpu import config
-from fraud_detection_tpu.ckpt.checkpoint import export_joblib_artifacts, save_artifacts
+from fraud_detection_tpu.ckpt.checkpoint import export_scaler_artifacts
 from fraud_detection_tpu.data.loader import load_creditcard_csv, stratified_split
-from fraud_detection_tpu.ops.logistic import LogisticParams
 from fraud_detection_tpu.ops.scaler import scaler_fit, scaler_transform
 from fraud_detection_tpu.ops.smote import smote
 
@@ -54,17 +53,11 @@ def preprocess(
 
     # Scaler + feature-name artifacts (preprocess.py:51-57's layout).
     os.makedirs(models_dir, exist_ok=True)
-    placeholder = LogisticParams(
-        coef=np.zeros(len(feature_names), np.float32), intercept=np.float32(0)
-    )
     try:
-        export_joblib_artifacts(models_dir, placeholder, scaler, feature_names,
-                                model_filename="_preprocess_placeholder.joblib")
-        os.remove(os.path.join(models_dir, "_preprocess_placeholder.joblib"))
-    except RuntimeError:
-        pass
-    with open(os.path.join(models_dir, "feature_names.json"), "w") as f:
-        json.dump(feature_names, f)
+        export_scaler_artifacts(models_dir, scaler, feature_names)
+    except RuntimeError:  # joblib absent — native feature list still lands
+        with open(os.path.join(models_dir, "feature_names.json"), "w") as f:
+            json.dump(feature_names, f)
 
     log.info(
         "preprocessed: resampled %d rows (from %d), test %d rows → %s",
