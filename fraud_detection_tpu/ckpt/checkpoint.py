@@ -154,7 +154,6 @@ def export_joblib_artifacts(
     try:
         import joblib
         from sklearn.linear_model import LogisticRegression
-        from sklearn.preprocessing import StandardScaler
     except ImportError as e:  # pragma: no cover
         raise RuntimeError(
             "joblib/sklearn are required for joblib export; install the "

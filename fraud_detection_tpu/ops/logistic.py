@@ -33,7 +33,11 @@ from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 from fraud_detection_tpu.parallel.mesh import DATA_AXIS, default_mesh
-from fraud_detection_tpu.parallel.sharding import pad_to_multiple, shard_batch
+from fraud_detection_tpu.parallel.sharding import (
+    as_device_f32,
+    pad_to_multiple,
+    shard_batch,
+)
 
 
 class LogisticParams(NamedTuple):
@@ -147,9 +151,7 @@ def logistic_fit_lbfgs(
     # device when it already lives there (e.g. straight out of smote()).
     y_np = np.asarray(y)
     sw = _resolve_sample_weight(y_np, sample_weight, class_weight)
-    x_in = x.astype(jnp.float32) if isinstance(x, jax.Array) else np.asarray(
-        x, dtype=np.float32
-    )
+    x_in = as_device_f32(x)
 
     if sharded:
         x_dev, _ = shard_batch(x_in, mesh)
@@ -259,9 +261,7 @@ def logistic_fit_sgd(
     # X stays on device when it already lives there (SGD is the >2M-row
     # solver — a host round-trip of the SMOTE'd matrix is the expensive
     # mistake); y comes to host (small) for class counts.
-    x_in = x.astype(jnp.float32) if isinstance(x, jax.Array) else np.asarray(
-        x, dtype=np.float32
-    )
+    x_in = as_device_f32(x)
     y_np = np.asarray(y)
     n = x_in.shape[0]
     sw = _resolve_sample_weight(y_np, None, class_weight)

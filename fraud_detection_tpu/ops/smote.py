@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fraud_detection_tpu.parallel.sharding import as_device_f32
+
 
 @partial(jax.jit, static_argnames=("k", "block"))
 def _knn_indices(x_min: jax.Array, k: int, block: int = 1024) -> jax.Array:
@@ -92,8 +94,11 @@ def smote(
     synthetic rows appended (imblearn's layout). Host-side: class counts and
     output shapes; device-side: k-NN + interpolation.
     """
-    x_np = np.asarray(x, dtype=np.float32)
+    # Labels come to host (tiny: class counts + minority indices drive the
+    # static output shape); the feature matrix NEVER does — at the 10M-row
+    # config a d2h+h2d round trip of x costs seconds on its own.
     y_np = np.asarray(y).astype(np.int32)
+    x_dev = jnp.asarray(as_device_f32(x))
     classes, counts = np.unique(y_np, return_counts=True)
     if len(classes) != 2:
         raise ValueError("smote supports binary labels")
@@ -102,7 +107,7 @@ def smote(
     n_maj = int(counts.max())
     n_synth = int(round(sampling_ratio * n_maj)) - n_min
     if n_synth <= 0:
-        return jnp.asarray(x_np), jnp.asarray(y_np)
+        return x_dev, jnp.asarray(y_np)
     if n_min < 2:
         # One minority row has no neighbors to interpolate toward; emitting
         # duplicates would silently poison training (imblearn raises here too).
@@ -112,7 +117,7 @@ def smote(
     if n_min <= k_neighbors:
         k_neighbors = n_min - 1
 
-    x_min = jnp.asarray(x_np[y_np == minority])
+    x_min = x_dev[jnp.asarray(np.nonzero(y_np == minority)[0])]
     from fraud_detection_tpu.ops.pallas_kernels import (
         knn_pallas_enabled,
         knn_topk,
@@ -127,7 +132,7 @@ def smote(
             x_min, k_neighbors, min(block, max(x_min.shape[0], 8))
         )
     synth = _interpolate(x_min, nn_idx, key, n_synth)
-    x_out = jnp.concatenate([jnp.asarray(x_np), synth], axis=0)
+    x_out = jnp.concatenate([x_dev, synth], axis=0)
     y_out = jnp.concatenate(
         [jnp.asarray(y_np), jnp.full((n_synth,), minority, dtype=jnp.int32)]
     )

@@ -14,6 +14,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from fraud_detection_tpu.parallel.mesh import DATA_AXIS, default_mesh
 
 
+def as_device_f32(x) -> jax.Array | np.ndarray:
+    """float32 coercion that never bounces a device array through host:
+    jax Arrays cast in place on device; anything else becomes host float32
+    (staged to device by whatever consumes it). The one placement rule for
+    'X may be huge and may already live on device' inputs."""
+    if isinstance(x, jax.Array):
+        return x.astype(jnp.float32)
+    return np.asarray(x, dtype=np.float32)
+
+
 def batch_sharding(mesh: Mesh | None = None) -> NamedSharding:
     """Rows sharded over the data axis, features replicated."""
     mesh = mesh or default_mesh()
