@@ -266,3 +266,21 @@ def test_legacy_error_contract(legacy_client):
     client, *_ = legacy_client
     r = client.post("/predict", json={"Time": 1.0})  # missing features
     assert r.status_code == 500 and "error" in r.json()
+
+
+def test_predict_survives_broker_failure(served, monkeypatch):
+    """Queue down must not fail scoring: the reference reports
+    explanation_status='Queue failed' and still returns the prediction
+    (api/app.py:248-250)."""
+    client, *_ = served
+
+    def boom(*a, **kw):
+        raise RuntimeError("broker down")
+
+    client.get("/status")  # trigger startup so the broker exists
+    monkeypatch.setattr(client.app.state["broker"], "send_task", boom)
+    r = client.post("/predict", json={"features": [0.1] * 30})
+    assert r.status_code == 200
+    body = r.json()
+    assert body["explanation_status"] == "Queue failed"
+    assert 0.0 <= body["score"] <= 1.0
