@@ -2,10 +2,11 @@
 
 Replaces the reference's SQLAlchemy/Postgres + alembic stack (db/db.py,
 db/models.py, alembic/) with a dependency-free layer. ``DATABASE_URL``
-selects the backend; this build ships ``sqlite:///path`` (stdlib, WAL).
-The SQL is deliberately Postgres-compatible and the URL scheme is the
-dispatch point — a ``postgresql://`` URL fails fast with a clear error
-rather than pretending (psycopg2 is not vendored here).
+selects the backend: ``sqlite:///`` (stdlib, WAL; single host),
+``fraud://`` / ``sentinel://`` (this build's network store server with
+replication + failover — the multi-node tier, netserver.py/netclient.py),
+or ``postgresql://`` (a real PostgreSQL over the built-in pure-Python wire
+client, pgwire.py — no psycopg2).
 
 One table, ``transaction_results`` (db/models.py:16-24), used by BOTH the
 worker writes and the ``/explain`` readback — unifying the reference's
@@ -65,16 +66,11 @@ def _sqlite_path(url: str) -> str:
     return path or ":memory:"
 
 
-class ResultsDB:
+class SqliteResultsDB:
     """Thread-safe store for transaction scoring/explanation results."""
 
     def __init__(self, url: str | None = None):
         self.url = url or config.database_url()
-        if not self.url.startswith("sqlite"):
-            raise NotImplementedError(
-                f"backend for {self.url.split(':', 1)[0]} not available in this "
-                "build; set DATABASE_URL=sqlite:///..."
-            )
         self._lock = threading.Lock()
         self._conn = sqlite3.connect(
             _sqlite_path(self.url), check_same_thread=False, timeout=30.0
@@ -213,3 +209,66 @@ class ResultsDB:
     def close(self) -> None:
         with self._lock:
             self._conn.close()
+
+    # -- replication hooks (used by the network store server) --------------
+    def fetch_rows(self, ids: list[str]) -> list[dict]:
+        """Full rows for the given primary keys, as plain dicts (JSON columns
+        left encoded — these cross the wire verbatim)."""
+        if not ids:
+            return []
+        qs = ",".join("?" * len(ids))
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT * FROM transaction_results WHERE transaction_id IN ({qs})",
+                ids,
+            ).fetchall()
+        return [dict(r) for r in rows]
+
+    def dump_rows(self) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute("SELECT * FROM transaction_results").fetchall()
+        return [dict(r) for r in rows]
+
+    def apply_rows(self, rows: list[dict]) -> None:
+        """Replica-side upsert of replicated rows (last-writer-wins by pk)."""
+        if not rows:
+            return
+        cols = list(rows[0].keys())
+        sql = (
+            f"INSERT OR REPLACE INTO transaction_results ({','.join(cols)}) "
+            f"VALUES ({','.join('?' * len(cols))})"
+        )
+        with self._lock, self._conn:
+            self._conn.executemany(sql, [[r[c] for c in cols] for r in rows])
+
+
+def ResultsDB(url: str | None = None):
+    """Open a results DB for ``url`` (default ``DATABASE_URL``).
+
+    Scheme dispatch — the reference's SQLAlchemy engine URL contract
+    (db/db.py:6-14):
+
+    - ``sqlite:///path``          — stdlib SQLite in WAL mode (single host);
+    - ``fraud://host:port``       — this build's network store server
+                                    (netserver.py), the Postgres-role
+                                    equivalent for multi-node topologies;
+    - ``sentinel://h:p,.../name``  — sentinel-resolved primary with failover
+                                    (netclient.py), the HA tier;
+    - ``postgresql://...``        — a real PostgreSQL server via the built-in
+                                    wire-protocol client (pgwire.py).
+    """
+    url = url or config.database_url()
+    if url.startswith("sqlite"):
+        return SqliteResultsDB(url)
+    if url.startswith(("fraud://", "sentinel://")):
+        from fraud_detection_tpu.service.netclient import NetResultsDB
+
+        return NetResultsDB(url)
+    if url.startswith(("postgresql://", "postgres://")):
+        from fraud_detection_tpu.service.pgclient import PgResultsDB
+
+        return PgResultsDB(url)
+    raise NotImplementedError(
+        f"backend for {url.split(':', 1)[0]} not available; use sqlite:///, "
+        "fraud://, sentinel://, or postgresql://"
+    )

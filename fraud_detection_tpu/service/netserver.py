@@ -1,0 +1,340 @@
+"""Network store server: results DB + task broker over one TCP endpoint.
+
+This is the multi-node tier the reference gets from Postgres + Redis
+(docker-compose.yml:4-57): one stateful server process that API pods,
+worker pods, and KEDA's scaling signal all talk to over the network, so
+worker replicas on *different nodes* share one queue and one results table
+(the round-1 build's SQLite files were single-host only).
+
+Design:
+
+- The server *hosts* the existing SQLite-WAL engines (`SqliteResultsDB`,
+  `SqliteBroker`) on its local disk and exposes their exact method surface
+  over the framed-JSON protocol (wire.py). Clients (netclient.py) mirror
+  the surface, so ``ResultsDB("fraud://host:port")`` is a drop-in.
+- **Replication**: a replica connects with ``subscribe`` and receives a
+  full snapshot followed by row-level upserts (primary-computed rows, so
+  replay is deterministic — no re-execution of time-dependent logic).
+  Asynchronous, like Redis replication: an acked write can be lost if the
+  primary dies before the row ships; failover preserves at-least-once task
+  delivery (the queue's visibility-timeout redelivery covers the gap).
+- **Failover**: a replica accepts ``promote`` (from sentinel.py) and
+  becomes a writable primary; writes to a replica fail fast with
+  ``kind="readonly"`` so clients re-resolve the primary.
+
+Run: ``python -m fraud_detection_tpu.service.netserver --port 7600
+--data-dir /var/lib/fraudstore [--replicate-from host:port]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import queue
+import socket
+import threading
+import time
+from typing import Any
+
+from fraud_detection_tpu.service.db import SqliteResultsDB
+from fraud_detection_tpu.service.taskq import DEFAULT_MAX_RETRIES, SqliteBroker
+from fraud_detection_tpu.service.wire import parse_hostport, recv_frame, send_frame
+
+log = logging.getLogger("fraud_detection_tpu.netserver")
+
+HEARTBEAT_INTERVAL = 1.0
+RESYNC_INTERVAL = 0.5
+
+PRIMARY = "primary"
+REPLICA = "replica"
+
+
+class StoreServer:
+    def __init__(
+        self,
+        data_dir: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        replicate_from: str | None = None,
+    ):
+        os.makedirs(data_dir, exist_ok=True)
+        self.db = SqliteResultsDB(f"sqlite:///{os.path.join(data_dir, 'results.db')}")
+        self.broker = SqliteBroker(f"sqlite:///{os.path.join(data_dir, 'queue.db')}")
+        self.host, self.port = host, port
+        self.role = REPLICA if replicate_from else PRIMARY
+        self.replicate_from = replicate_from
+        self.seq = 0
+        self._pub_lock = threading.Lock()
+        self._subs: list[queue.Queue] = []
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._listener: socket.socket | None = None
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self.port = self._listener.getsockname()[1]
+        self._listener.listen(64)
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self.role == REPLICA:
+            t = threading.Thread(target=self._replica_loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+        log.info("store server %s on %s:%d", self.role, self.host, self.port)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            # shutdown() wakes the thread blocked in accept(); close() alone
+            # leaves the open file description (and the LISTEN port) alive
+            # until that accept returns.
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._pub_lock:
+            for q in self._subs:
+                q.put(None)
+        with self._conns_lock:
+            for c in list(self._conns):
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            while not self._stop.is_set():
+                time.sleep(0.2)
+        finally:
+            self.stop()
+
+    # -- accept / dispatch -------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
+            t = threading.Thread(target=self._handle, args=(conn,), daemon=True)
+            t.start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                req = recv_frame(conn)
+                if req is None:
+                    return
+                op = req.pop("op", None)
+                if op == "subscribe":
+                    self._serve_subscriber(conn)
+                    return
+                try:
+                    result = self._dispatch(op, req)
+                    send_frame(conn, {"ok": True, "result": result})
+                except _ReadOnly:
+                    send_frame(
+                        conn,
+                        {"ok": False, "kind": "readonly",
+                         "error": f"{op} rejected: server is a replica"},
+                    )
+                except Exception as e:  # surface server faults to the client
+                    send_frame(conn, {"ok": False, "kind": "error", "error": str(e)})
+        except Exception:
+            pass  # client went away; per-connection thread exits
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, op: str, a: dict[str, Any]) -> Any:
+        # reads — allowed on any role (replicas serve monitoring/readbacks)
+        if op == "ping":
+            return {"role": self.role, "seq": self.seq}
+        if op == "info":
+            return {
+                "role": self.role,
+                "seq": self.seq,
+                "replicas": len(self._subs),
+                "depth": self.broker.depth(),
+                "results": self.db.count(),
+            }
+        if op == "db.get":
+            return self.db.get(a["transaction_id"])
+        if op == "db.count":
+            return self.db.count(a.get("status"))
+        if op == "q.depth":
+            return self.broker.depth()
+        if op == "q.get_status":
+            return self.broker.get_status(a["task_id"])
+        # role transitions
+        if op == "promote":
+            self.role = PRIMARY
+            log.warning("PROMOTED to primary (seq %d)", self.seq)
+            return {"role": self.role}
+        # writes — primary only
+        if self.role != PRIMARY:
+            raise _ReadOnly()
+        if op == "db.create_pending":
+            tx_id = self.db.create_pending(
+                a.get("transaction_id"), a["input_data"], a.get("correlation_id")
+            )
+            self._publish("transaction_results", self.db.fetch_rows([tx_id]))
+            return tx_id
+        if op == "db.complete":
+            self.db.complete(
+                a["transaction_id"], a["shap_values"], a["expected_value"],
+                a["prediction_score"],
+            )
+            self._publish(
+                "transaction_results", self.db.fetch_rows([a["transaction_id"]])
+            )
+            return None
+        if op == "db.fail":
+            self.db.fail(a["transaction_id"], a["error"])
+            self._publish(
+                "transaction_results", self.db.fetch_rows([a["transaction_id"]])
+            )
+            return None
+        if op == "q.send_task":
+            task_id = self.broker.send_task(
+                a["name"], a["args"], a.get("correlation_id"),
+                a.get("max_retries", DEFAULT_MAX_RETRIES), a.get("countdown", 0.0),
+            )
+            self._publish("tasks", self.broker.fetch_rows([task_id]))
+            return task_id
+        if op == "q.claim_many":
+            tasks = self.broker.claim_many(
+                a["worker_id"], a["limit"], a["visibility_timeout"]
+            )
+            self._publish("tasks", self.broker.fetch_rows([t.id for t in tasks]))
+            return [t.__dict__ for t in tasks]
+        if op == "q.ack":
+            self.broker.ack(a["task_id"])
+            self._publish("tasks", self.broker.fetch_rows([a["task_id"]]))
+            return None
+        if op == "q.nack":
+            will_retry = self.broker.nack(a["task_id"], a["countdown"], a.get("error", ""))
+            self._publish("tasks", self.broker.fetch_rows([a["task_id"]]))
+            return will_retry
+        raise ValueError(f"unknown op {op!r}")
+
+    # -- replication (primary side) ----------------------------------------
+    def _publish(self, table: str, rows: list[dict]) -> None:
+        if not rows:
+            return
+        with self._pub_lock:
+            self.seq += 1
+            msg = {"t": "rows", "table": table, "rows": rows, "seq": self.seq}
+            for q in self._subs:
+                q.put(msg)
+
+    def _serve_subscriber(self, conn: socket.socket) -> None:
+        """Snapshot + live row stream + heartbeats, until disconnect."""
+        sub: queue.Queue = queue.Queue()
+        with self._pub_lock:
+            # snapshot under the publish lock so no row-batch is lost between
+            # the dump and the subscription becoming live
+            snapshot = {
+                "t": "snapshot",
+                "seq": self.seq,
+                "results": self.db.dump_rows(),
+                "tasks": self.broker.dump_rows(),
+            }
+            self._subs.append(sub)
+        try:
+            send_frame(conn, snapshot)
+            while not self._stop.is_set():
+                try:
+                    msg = sub.get(timeout=HEARTBEAT_INTERVAL)
+                except queue.Empty:
+                    msg = {"t": "hb", "seq": self.seq}
+                if msg is None:
+                    return
+                send_frame(conn, msg)
+        except OSError:
+            pass
+        finally:
+            with self._pub_lock:
+                if sub in self._subs:
+                    self._subs.remove(sub)
+
+    # -- replication (replica side) ----------------------------------------
+    def _replica_loop(self) -> None:
+        host, port = parse_hostport(self.replicate_from, 7600)
+        while not self._stop.is_set() and self.role == REPLICA:
+            try:
+                with socket.create_connection((host, port), timeout=5.0) as s:
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    s.settimeout(3 * HEARTBEAT_INTERVAL)
+                    send_frame(s, {"op": "subscribe"})
+                    while not self._stop.is_set() and self.role == REPLICA:
+                        msg = recv_frame(s)
+                        if msg is None:
+                            break
+                        if msg["t"] == "snapshot":
+                            self.db.apply_rows(msg["results"])
+                            self.broker.apply_rows(msg["tasks"])
+                            self.seq = msg["seq"]
+                            log.info(
+                                "replica synced: %d results, %d tasks (seq %d)",
+                                len(msg["results"]), len(msg["tasks"]), msg["seq"],
+                            )
+                        elif msg["t"] == "rows":
+                            if msg["table"] == "transaction_results":
+                                self.db.apply_rows(msg["rows"])
+                            else:
+                                self.broker.apply_rows(msg["rows"])
+                            self.seq = msg["seq"]
+                        # "hb": keepalive only
+            except OSError:
+                pass
+            if self.role == REPLICA:
+                self._stop.wait(RESYNC_INTERVAL)
+
+
+class _ReadOnly(Exception):
+    pass
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=7600)
+    ap.add_argument("--data-dir", default="./fraudstore")
+    ap.add_argument(
+        "--replicate-from", default=None,
+        help="host:port of the primary; starts this server as a replica",
+    )
+    args = ap.parse_args()
+    StoreServer(
+        args.data_dir, args.host, args.port, replicate_from=args.replicate_from
+    ).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

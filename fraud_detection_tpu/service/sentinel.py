@@ -1,0 +1,255 @@
+"""Sentinel: failure detection + quorum failover for the store tier.
+
+Plays the role of the reference's Redis Sentinel (docker-compose.yml:20-36,
+quorum 2 in charts/fraud-detection/values.yaml): monitors the store servers
+(netserver.py), answers "who is the primary?" for clients
+(``sentinel://h1:p1,h2:p2/mastername`` URLs, netclient.py), and — when the
+primary stays unreachable past ``down_after`` and a quorum of sentinels
+agrees — promotes the best replica (highest replication seq) to primary.
+
+Semantics (matching Redis Sentinel's, and documented with the same
+honesty): replication is asynchronous, so a failover can lose writes the
+dead primary acked but never shipped; the task queue's visibility-timeout
+redelivery turns that loss into at-least-once re-execution, and the results
+table's idempotent upserts make re-execution safe. A failed-over old
+primary must be restarted with ``--replicate-from`` pointing at the new
+one (split-brain is prevented by clients resolving through sentinels, who
+answer with the *elected* primary only).
+
+Run: ``python -m fraud_detection_tpu.service.sentinel --port 26379
+--master-name mymaster --stores h1:7600,h2:7600 [--peers h3:26379,...]
+[--quorum 2]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import socket
+import threading
+import time
+from typing import Any
+
+from fraud_detection_tpu.service.wire import parse_hostport, recv_frame, send_frame
+
+log = logging.getLogger("fraud_detection_tpu.sentinel")
+
+Endpoint = tuple[str, int]
+
+
+def _call(ep: Endpoint, op: str, timeout: float = 1.0, **kwargs: Any) -> Any:
+    """One-shot request/response to a store or peer sentinel."""
+    with socket.create_connection(ep, timeout=timeout) as s:
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(timeout)
+        send_frame(s, {"op": op, **kwargs})
+        resp = recv_frame(s)
+    if resp is None or not resp.get("ok"):
+        raise OSError(f"{op} to {ep} failed: {resp and resp.get('error')}")
+    return resp["result"]
+
+
+class Sentinel:
+    def __init__(
+        self,
+        master_name: str,
+        stores: list[Endpoint],
+        peers: list[Endpoint] | None = None,
+        quorum: int = 1,
+        down_after: float = 3.0,
+        poll_interval: float = 0.5,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.master_name = master_name
+        self.stores = stores
+        self.peers = peers or []
+        self.quorum = quorum
+        self.down_after = down_after
+        self.poll_interval = poll_interval
+        self.host, self.port = host, port
+        self.master: Endpoint | None = None
+        self._last_ok: dict[Endpoint, float] = {}
+        self._last_info: dict[Endpoint, dict] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._listener: socket.socket | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self.port = self._listener.getsockname()[1]
+        self._listener.listen(16)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        threading.Thread(target=self._monitor_loop, daemon=True).start()
+        log.info(
+            "sentinel for %r on %s:%d (stores %s, quorum %d)",
+            self.master_name, self.host, self.port, self.stores, self.quorum,
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            while not self._stop.is_set():
+                time.sleep(0.2)
+        finally:
+            self.stop()
+
+    # -- monitoring / failover ---------------------------------------------
+    def _probe_all(self) -> None:
+        now = time.time()
+        for ep in self.stores:
+            try:
+                info = _call(ep, "info", timeout=min(1.0, self.down_after / 2))
+            except OSError:
+                continue
+            with self._lock:
+                self._last_ok[ep] = now
+                self._last_info[ep] = info
+
+    def _is_down(self, ep: Endpoint) -> bool:
+        with self._lock:
+            last = self._last_ok.get(ep, 0.0)
+        return time.time() - last > self.down_after
+
+    def _elect_initial(self) -> Endpoint | None:
+        """Discovery: the healthy store reporting role=primary, highest seq."""
+        with self._lock:
+            infos = dict(self._last_info)
+        primaries = [
+            ep for ep in self.stores
+            if not self._is_down(ep) and infos.get(ep, {}).get("role") == "primary"
+        ]
+        if not primaries:
+            return None
+        return max(primaries, key=lambda ep: infos[ep].get("seq", 0))
+
+    def _failover(self) -> None:
+        """Master is down for us; with quorum agreement, promote a replica."""
+        votes = 1
+        for peer in self.peers:
+            try:
+                if _call(
+                    peer, "s.is-down",
+                    name=self.master_name,
+                    host=self.master[0], port=self.master[1],
+                ):
+                    votes += 1
+            except OSError:
+                pass
+        if votes < self.quorum:
+            log.warning(
+                "master %s down for me but quorum not met (%d/%d)",
+                self.master, votes, self.quorum,
+            )
+            return
+        with self._lock:
+            infos = dict(self._last_info)
+        candidates = [
+            ep for ep in self.stores
+            if ep != self.master and not self._is_down(ep)
+        ]
+        if not candidates:
+            log.error("master %s down and no live replica to promote", self.master)
+            return
+        best = max(candidates, key=lambda ep: infos.get(ep, {}).get("seq", 0))
+        try:
+            _call(best, "promote")
+        except OSError as e:
+            log.error("promote of %s failed: %s", best, e)
+            return
+        log.warning(
+            "FAILOVER %r: %s → %s (quorum %d/%d)",
+            self.master_name, self.master, best, votes, self.quorum,
+        )
+        self.master = best
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            self._probe_all()
+            if self.master is None:
+                self.master = self._elect_initial()
+                if self.master:
+                    log.info("discovered primary %s", self.master)
+            elif self._is_down(self.master):
+                self._failover()
+            self._stop.wait(self.poll_interval)
+
+    # -- server ------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                req = recv_frame(conn)
+                if req is None:
+                    return
+                op = req.get("op")
+                if op == "ping":
+                    send_frame(conn, {"ok": True, "result": {"role": "sentinel"}})
+                elif op == "s.get-master":
+                    m = self.master if req.get("name", self.master_name) == self.master_name else None
+                    result = {"host": m[0], "port": m[1]} if m else None
+                    send_frame(conn, {"ok": True, "result": result})
+                elif op == "s.is-down":
+                    ep = (req["host"], int(req["port"]))
+                    send_frame(conn, {"ok": True, "result": self._is_down(ep)})
+                else:
+                    send_frame(
+                        conn, {"ok": False, "kind": "error", "error": f"unknown op {op!r}"}
+                    )
+        except Exception:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=26379)
+    ap.add_argument("--master-name", default="mymaster")
+    ap.add_argument("--stores", required=True, help="h1:p1,h2:p2 store servers")
+    ap.add_argument("--peers", default="", help="other sentinels, h:p,...")
+    ap.add_argument("--quorum", type=int, default=1)
+    ap.add_argument("--down-after", type=float, default=3.0)
+    ap.add_argument("--poll-interval", type=float, default=0.5)
+    args = ap.parse_args()
+    Sentinel(
+        args.master_name,
+        stores=[parse_hostport(s, 7600) for s in args.stores.split(",") if s],
+        peers=[parse_hostport(s, 26379) for s in args.peers.split(",") if s],
+        quorum=args.quorum,
+        down_after=args.down_after,
+        poll_interval=args.poll_interval,
+        host=args.host,
+        port=args.port,
+    ).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

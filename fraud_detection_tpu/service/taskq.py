@@ -13,10 +13,11 @@ reference's reliability story depends on (docs/WorkerRecoveryTestPlan.md):
 - **queue depth** observable for autoscaling (the KEDA listLength trigger,
   k8s/xai-worker-scaledobject.yaml).
 
-SQLite in WAL mode is safe across processes on one host; the broker URL is
-``CELERY_BROKER_URL`` for env compatibility (``sqlite:///taskq.db``). A
-Redis-backed broker can be slotted in behind the same interface when the
-client library exists.
+``CELERY_BROKER_URL`` selects the backend: ``sqlite:///`` (WAL; safe across
+processes on one host), ``fraud://`` / ``sentinel://`` (the network store
+server with replication + quorum failover — the multi-node/HA tier that
+plays the Redis-Sentinel role), or ``postgresql://`` (real Postgres via the
+built-in wire client).
 """
 
 from __future__ import annotations
@@ -55,14 +56,9 @@ def _path(url: str) -> str:
     return url[len("sqlite:///") :] if url.startswith("sqlite:///") else url
 
 
-class Broker:
+class SqliteBroker:
     def __init__(self, url: str | None = None):
         self.url = url or config.broker_url()
-        if not self.url.startswith("sqlite"):
-            raise NotImplementedError(
-                f"broker backend for {self.url.split(':', 1)[0]} not available; "
-                "set CELERY_BROKER_URL=sqlite:///..."
-            )
         path = _path(self.url)
         if path != ":memory:" and os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -237,3 +233,61 @@ class Broker:
     def close(self) -> None:
         with self._lock:
             self._conn.close()
+
+    # -- replication hooks (used by the network store server) --------------
+    def fetch_rows(self, ids: list[str]) -> list[dict]:
+        if not ids:
+            return []
+        qs = ",".join("?" * len(ids))
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT * FROM tasks WHERE id IN ({qs})", ids
+            ).fetchall()
+        return [dict(r) for r in rows]
+
+    def dump_rows(self) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute("SELECT * FROM tasks").fetchall()
+        return [dict(r) for r in rows]
+
+    def apply_rows(self, rows: list[dict]) -> None:
+        if not rows:
+            return
+        cols = list(rows[0].keys())
+        sql = (
+            f"INSERT OR REPLACE INTO tasks ({','.join(cols)}) "
+            f"VALUES ({','.join('?' * len(cols))})"
+        )
+        with self._lock, self._conn:
+            self._conn.executemany(sql, [[r[c] for c in cols] for r in rows])
+
+
+def Broker(url: str | None = None):
+    """Open a broker for ``url`` (default ``CELERY_BROKER_URL``).
+
+    Scheme dispatch — the Redis-Sentinel-role equivalents of the reference's
+    broker URL contract (xai_tasks.py:59, sentinel://redis-master:26379/0):
+
+    - ``sqlite:///path``           — stdlib SQLite WAL queue (single host);
+    - ``fraud://host:port``        — network store server (netserver.py);
+    - ``sentinel://h:p,.../name``  — sentinel-resolved primary with quorum
+                                     failover (sentinel.py) — the HA tier;
+    - ``postgresql://...``         — PostgreSQL via the built-in wire client
+                                     (SKIP LOCKED-free claim loop works on
+                                     the same UPDATE-guard SQL).
+    """
+    url = url or config.broker_url()
+    if url.startswith("sqlite"):
+        return SqliteBroker(url)
+    if url.startswith(("fraud://", "sentinel://")):
+        from fraud_detection_tpu.service.netclient import NetBroker
+
+        return NetBroker(url)
+    if url.startswith(("postgresql://", "postgres://")):
+        from fraud_detection_tpu.service.pgclient import PgBroker
+
+        return PgBroker(url)
+    raise NotImplementedError(
+        f"broker backend for {url.split(':', 1)[0]} not available; use "
+        "sqlite:///, fraud://, sentinel://, or postgresql://"
+    )

@@ -1,0 +1,62 @@
+"""Framed-JSON wire protocol for the network store tier.
+
+One frame = 4-byte big-endian length + UTF-8 JSON payload. Requests are
+``{"op": <name>, ...kwargs}``; responses ``{"ok": true, "result": ...}`` or
+``{"ok": false, "error": <msg>, "kind": <classifier>}``. A subscription
+stream (replication) reuses the same framing with typed messages.
+
+This replaces the reference's two wire protocols — the Celery/Redis protocol
+(xai_tasks.py:59-60) and libpq (db/db.py:6-9) — with one dependency-free
+protocol carrying both the queue and the results store.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any
+
+from fraud_detection_tpu.service.errors import ProtocolError
+
+MAX_FRAME = 64 << 20  # 64 MiB: snapshots of large stores stay under this
+_HDR = struct.Struct(">I")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    """Read exactly n bytes; None on clean EOF at a frame boundary."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            if not buf:
+                return None
+            raise ProtocolError("connection closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    if len(payload) > MAX_FRAME:
+        raise ProtocolError(f"frame too large ({len(payload)} bytes)")
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> Any | None:
+    """One decoded frame, or None on clean EOF."""
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    (n,) = _HDR.unpack(hdr)
+    if n > MAX_FRAME:
+        raise ProtocolError(f"frame too large ({n} bytes)")
+    data = _recv_exact(sock, n)
+    if data is None:
+        raise ProtocolError("connection closed before frame body")
+    return json.loads(data)
+
+
+def parse_hostport(s: str, default_port: int) -> tuple[str, int]:
+    host, _, port = s.partition(":")
+    return host or "127.0.0.1", int(port) if port else default_port

@@ -33,6 +33,7 @@ import numpy as np
 from fraud_detection_tpu import config
 from fraud_detection_tpu.service import metrics
 from fraud_detection_tpu.service.db import ResultsDB
+from fraud_detection_tpu.service.errors import DatabaseError
 from fraud_detection_tpu.service.loading import load_production_model
 from fraud_detection_tpu.service.taskq import Broker, Task
 from fraud_detection_tpu.service.tracing import setup_tracing, span
@@ -152,7 +153,7 @@ class XaiWorker:
             self.broker.ack(task.id)  # acks_late: only after success
             metrics.xai_task_success.inc()
             return
-        is_db = isinstance(err, sqlite3.Error)
+        is_db = isinstance(err, (sqlite3.Error, DatabaseError))
         countdown = DB_RETRY_COUNTDOWN if is_db else OTHER_RETRY_COUNTDOWN
         will_retry = self.broker.nack(task.id, countdown, str(err))
         metrics.xai_task_failures.inc()

@@ -1,0 +1,320 @@
+"""Minimal PostgreSQL v3 protocol *server* emulator, for testing pgwire.py.
+
+Speaks the server side of the messages the client implements — startup,
+SCRAM-SHA-256 (with real proof verification), extended query protocol
+(Parse/Bind/Describe/Execute/Sync), simple query, typed RowDescription,
+CommandComplete tags, ErrorResponse — over a real TCP socket, executing the
+SQL against a private SQLite database. It validates the *protocol machinery*
+end to end; dialect compatibility is kept by pgclient.py writing in the
+PG/SQLite common subset.
+
+Test-only: lives under tests/, never shipped in the package.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import re
+import secrets
+import socket
+import sqlite3
+import struct
+import threading
+
+
+def _read_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError
+        buf += chunk
+    return bytes(buf)
+
+
+def _msg(type_byte: bytes, body: bytes = b"") -> bytes:
+    return type_byte + struct.pack(">i", len(body) + 4) + body
+
+
+_NUMERIC = re.compile(r"^-?\d{1,17}(\.\d+)?([eE][+-]?\d+)?$")
+
+
+def _coerce(text: str | None):
+    """Text-format param → Python value, approximating PG's type inference
+    from column context (long digit strings like uuid hexes stay text)."""
+    if text is None:
+        return None
+    if _NUMERIC.match(text):
+        try:
+            return int(text)
+        except ValueError:
+            return float(text)
+    return text
+
+
+def _oid_of(v) -> int:
+    if isinstance(v, bool):
+        return 16
+    if isinstance(v, int):
+        return 20  # int8
+    if isinstance(v, float):
+        return 701  # float8
+    return 25  # text
+
+
+def _encode_val(v) -> bytes | None:
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, float):
+        return repr(v).encode()
+    return str(v).encode()
+
+
+_DOLLAR = re.compile(r"\$\d+")
+
+
+class PgEmulator:
+    def __init__(self, user="postgres", password="postgres", host="127.0.0.1"):
+        import tempfile
+
+        self.user, self.password = user, password
+        self.host = host
+        self.port = 0
+        # one sqlite FILE, one connection PER SESSION — real PG has
+        # per-connection transactions; a single shared connection would make
+        # concurrent clients' BEGINs collide
+        fd, self._db_path = tempfile.mkstemp(suffix=".pgemu.db")
+        import os
+
+        os.close(fd)
+        boot = sqlite3.connect(self._db_path)
+        boot.execute("PRAGMA journal_mode=WAL")
+        boot.close()
+        self._stop = threading.Event()
+        self._listener: socket.socket | None = None
+
+    def start(self):
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self.port = self._listener.getsockname()[1]
+        self._listener.listen(8)
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def stop(self):
+        import os
+
+        self._stop.set()
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._listener.close()
+        for suffix in ("", "-wal", "-shm"):
+            try:
+                os.unlink(self._db_path + suffix)
+            except OSError:
+                pass
+
+    def _accept(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._session, args=(conn,), daemon=True).start()
+
+    # -- one client session -------------------------------------------------
+    def _session(self, sock):
+        db = sqlite3.connect(self._db_path, timeout=30.0)
+        db.isolation_level = None  # manual BEGIN/COMMIT like PG
+        db.execute("PRAGMA busy_timeout=30000")
+        try:
+            if not self._auth(sock):
+                return
+            sock.sendall(
+                _msg(b"S", b"server_version\x00emulated-16.0\x00")
+                + _msg(b"K", struct.pack(">ii", 1234, 5678))
+                + _msg(b"Z", b"I")
+            )
+            self._serve(sock, db)
+        except EOFError:
+            pass
+        finally:
+            try:
+                db.execute("ROLLBACK")  # drop any txn a dead client left open
+            except sqlite3.Error:
+                pass
+            db.close()
+            sock.close()
+
+    def _auth(self, sock) -> bool:
+        (n,) = struct.unpack(">i", _read_exact(sock, 4))
+        body = _read_exact(sock, n - 4)
+        (proto,) = struct.unpack(">i", body[:4])
+        if proto == 80877103:  # SSLRequest → refuse, client may retry plain
+            sock.sendall(b"N")
+            return self._auth(sock)
+        assert proto == 196608, f"unexpected protocol {proto}"
+        # AuthenticationSASL offering SCRAM-SHA-256
+        sock.sendall(_msg(b"R", struct.pack(">i", 10) + b"SCRAM-SHA-256\x00\x00"))
+        t, body = self._read_typed(sock)
+        assert t == b"p"
+        mech_end = body.index(0)
+        assert body[:mech_end] == b"SCRAM-SHA-256"
+        (ilen,) = struct.unpack(">i", body[mech_end + 1 : mech_end + 5])
+        client_first = body[mech_end + 5 : mech_end + 5 + ilen].decode()
+        client_first_bare = client_first.split(",", 2)[2]
+        client_nonce = dict(
+            kv.split("=", 1) for kv in client_first_bare.split(",")
+        )["r"]
+        # server-first
+        salt = secrets.token_bytes(16)
+        iters = 4096
+        server_nonce = client_nonce + base64.b64encode(secrets.token_bytes(12)).decode()
+        server_first = (
+            f"r={server_nonce},s={base64.b64encode(salt).decode()},i={iters}"
+        )
+        sock.sendall(_msg(b"R", struct.pack(">i", 11) + server_first.encode()))
+        t, body = self._read_typed(sock)
+        assert t == b"p"
+        client_final = body.decode()
+        final_no_proof, proof_b64 = client_final.rsplit(",p=", 1)
+        attrs = dict(kv.split("=", 1) for kv in final_no_proof.split(","))
+        if attrs["r"] != server_nonce:
+            sock.sendall(self._err("28000", "nonce mismatch"))
+            return False
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(), salt, iters)
+        stored_key = hashlib.sha256(
+            hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        ).digest()
+        auth_message = ",".join([client_first_bare, server_first, final_no_proof])
+        signature = hmac.new(stored_key, auth_message.encode(), hashlib.sha256).digest()
+        client_key = bytes(
+            a ^ b for a, b in zip(base64.b64decode(proof_b64), signature)
+        )
+        if hashlib.sha256(client_key).digest() != stored_key:
+            sock.sendall(
+                self._err("28P01", f'password authentication failed for "{self.user}"')
+            )
+            return False
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        server_sig = hmac.new(server_key, auth_message.encode(), hashlib.sha256).digest()
+        sock.sendall(
+            _msg(
+                b"R",
+                struct.pack(">i", 12)
+                + b"v=" + base64.b64encode(server_sig),
+            )
+            + _msg(b"R", struct.pack(">i", 0))
+        )
+        return True
+
+    @staticmethod
+    def _read_typed(sock):
+        hdr = _read_exact(sock, 5)
+        (n,) = struct.unpack(">i", hdr[1:])
+        return hdr[:1], _read_exact(sock, n - 4) if n > 4 else b""
+
+    @staticmethod
+    def _err(code: str, msg: str) -> bytes:
+        body = (
+            b"SERROR\x00" + b"C" + code.encode() + b"\x00"
+            + b"M" + msg.encode() + b"\x00\x00"
+        )
+        return _msg(b"E", body)
+
+    @staticmethod
+    def _tag(sql: str, cur) -> str:
+        head = sql.lstrip().split(None, 1)[0].upper() if sql.strip() else ""
+        if head == "SELECT":
+            return "SELECT 0"
+        if head == "INSERT":
+            return f"INSERT 0 {max(cur.rowcount, 0)}"
+        if head in ("UPDATE", "DELETE"):
+            return f"{head} {max(cur.rowcount, 0)}"
+        return head or "OK"
+
+    def _serve(self, sock, db):
+        stmt_sql = ""
+        params: list = []
+        while not self._stop.is_set():
+            t, body = self._read_typed(sock)
+            if t == b"X":
+                return
+            if t == b"P":  # Parse
+                # name \0 sql \0 n_param_oids...
+                zero = body.index(0)
+                rest = body[zero + 1 :]
+                stmt_sql = rest[: rest.index(0)].decode()
+                sock.sendall(_msg(b"1"))
+            elif t == b"B":  # Bind
+                pos = body.index(0) + 1  # portal name
+                pos = body.index(0, pos) + 1  # statement name
+                (nfmt,) = struct.unpack_from(">h", body, pos)
+                pos += 2 + 2 * nfmt
+                (nparams,) = struct.unpack_from(">h", body, pos)
+                pos += 2
+                params = []
+                for _ in range(nparams):
+                    (plen,) = struct.unpack_from(">i", body, pos)
+                    pos += 4
+                    if plen < 0:
+                        params.append(None)
+                    else:
+                        params.append(_coerce(body[pos : pos + plen].decode()))
+                        pos += plen
+                sock.sendall(_msg(b"2"))
+            elif t == b"D":  # Describe → defer row description to Execute
+                sock.sendall(_msg(b"n"))
+            elif t == b"E":  # Execute
+                sql = _DOLLAR.sub("?", stmt_sql)
+                try:
+                    cur = db.execute(sql, params)
+                    rows = cur.fetchall() if cur.description else []
+                except sqlite3.Error as e:
+                    code = (
+                        "23505" if isinstance(e, sqlite3.IntegrityError) else "XX000"
+                    )
+                    sock.sendall(self._err(code, str(e)))
+                    continue
+                if cur.description:
+                    cols = [d[0] for d in cur.description]
+                    probe = rows[0] if rows else [None] * len(cols)
+                    desc = struct.pack(">h", len(cols))
+                    for name, v in zip(cols, probe):
+                        desc += (
+                            name.encode() + b"\x00"
+                            + struct.pack(">ihihih", 0, 0, _oid_of(v), -1, -1, 0)
+                        )
+                    sock.sendall(_msg(b"T", desc))
+                    for r in rows:
+                        out = struct.pack(">h", len(r))
+                        for v in r:
+                            enc = _encode_val(v)
+                            if enc is None:
+                                out += struct.pack(">i", -1)
+                            else:
+                                out += struct.pack(">i", len(enc)) + enc
+                        sock.sendall(_msg(b"D", out))
+                    tag = f"SELECT {len(rows)}"
+                else:
+                    tag = self._tag(sql, cur)
+                sock.sendall(_msg(b"C", tag.encode() + b"\x00"))
+            elif t == b"S":  # Sync
+                sock.sendall(_msg(b"Z", b"I"))
+            elif t == b"Q":  # simple query
+                sql = body[:-1].decode()
+                try:
+                    cur = db.execute(sql)
+                    sock.sendall(_msg(b"C", self._tag(sql, cur).encode() + b"\x00"))
+                except sqlite3.Error as e:
+                    sock.sendall(self._err("XX000", str(e)))
+                sock.sendall(_msg(b"Z", b"I"))
+            else:
+                sock.sendall(self._err("0A000", f"unhandled message {t!r}"))

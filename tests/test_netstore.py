@@ -1,0 +1,368 @@
+"""Network store tier: wire protocol, replication, readonly guards, and
+sentinel quorum failover under primary death — the automated equivalent of
+the reference's Redis-Sentinel HA story (docker-compose.yml:4-36, quorum
+failover) and of docs/WorkerRecoveryTestPlan.md's broker-death scenario."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fraud_detection_tpu.service.db import ResultsDB
+from fraud_detection_tpu.service.errors import BrokerError, ProtocolError
+from fraud_detection_tpu.service.netclient import NetBroker, NetResultsDB, _parse
+from fraud_detection_tpu.service.netserver import StoreServer
+from fraud_detection_tpu.service.sentinel import Sentinel, _call
+from fraud_detection_tpu.service.taskq import Broker
+from fraud_detection_tpu.service.wire import parse_hostport, recv_frame, send_frame
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip_large_frame():
+    import threading
+
+    a, b = socket.socketpair()
+    try:
+        big = {"op": "x", "blob": "y" * (1 << 20)}
+        # sender in a thread: a 1 MiB frame overflows the socketpair buffer,
+        # so send and recv must run concurrently
+        t = threading.Thread(target=lambda: (send_frame(a, big), a.close()))
+        t.start()
+        assert recv_frame(b) == big
+        assert recv_frame(b) is None  # clean EOF
+        t.join(timeout=10)
+    finally:
+        b.close()
+
+
+def test_wire_mid_frame_eof_is_protocol_error():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"\x00\x00\x00\x10partial")
+        a.close()
+        with pytest.raises(ProtocolError):
+            recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_url_parsing():
+    assert _parse("fraud://h:7601") == ("direct", [("h", 7601)], "")
+    assert _parse("fraud://h") == ("direct", [("h", 7600)], "")
+    mode, eps, name = _parse("sentinel://s1:1,s2:2/m1")
+    assert mode == "sentinel" and eps == [("s1", 1), ("s2", 2)] and name == "m1"
+    assert _parse("sentinel://s1/")[2] == "mymaster"
+    assert parse_hostport(":9", 1) == ("127.0.0.1", 9)
+
+
+# ---------------------------------------------------------------------------
+# in-process server: dispatch, replication, readonly
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def primary(tmp_path):
+    srv = StoreServer(str(tmp_path / "p"), port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def replica(tmp_path, primary):
+    srv = StoreServer(
+        str(tmp_path / "r"), port=0, replicate_from=f"127.0.0.1:{primary.port}"
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _wait(pred, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_factories_dispatch_on_scheme(primary, tmp_path):
+    url = f"fraud://127.0.0.1:{primary.port}"
+    assert isinstance(Broker(url), NetBroker)
+    assert isinstance(ResultsDB(url), NetResultsDB)
+    with pytest.raises(NotImplementedError):
+        Broker("amqp://x")
+
+
+def test_db_roundtrip_over_network(primary):
+    db = ResultsDB(f"fraud://127.0.0.1:{primary.port}")
+    tx = db.create_pending(None, {"Amount": 5.0}, "corr-1")
+    assert db.get(tx)["status"] == "PENDING"
+    db.complete(tx, {"Amount": 0.7}, 0.1, 0.93)
+    row = db.get(tx)
+    assert row["status"] == "COMPLETED"
+    assert row["shap_values"] == {"Amount": 0.7}
+    assert row["prediction_score"] == pytest.approx(0.93)
+    assert db.count() == 1 and db.count("COMPLETED") == 1
+    assert db.ping()
+    db.fail("other", "boom")
+    assert db.get("other")["status"] == "FAILED"
+
+
+def test_replication_streams_rows(primary, replica):
+    db = ResultsDB(f"fraud://127.0.0.1:{primary.port}")
+    q = Broker(f"fraud://127.0.0.1:{primary.port}")
+    tx = db.create_pending(None, {"a": 1.0}, None)
+    tid = q.send_task("xai_tasks.compute_shap", [tx, {"a": 1.0}, None])
+    # replica applies the row stream (async; poll its local engines)
+    assert _wait(lambda: replica.db.get(tx) is not None)
+    assert _wait(lambda: replica.broker.get_status(tid) == "QUEUED")
+    assert replica.db.get(tx)["input_data"] == {"a": 1.0}
+
+
+def test_replica_snapshot_catches_up_preexisting_state(tmp_path, primary):
+    db = ResultsDB(f"fraud://127.0.0.1:{primary.port}")
+    for i in range(5):
+        db.create_pending(f"tx{i}", {"i": float(i)}, None)
+    late = StoreServer(
+        str(tmp_path / "late"), port=0, replicate_from=f"127.0.0.1:{primary.port}"
+    )
+    late.start()
+    try:
+        assert _wait(lambda: late.db.count() == 5)
+    finally:
+        late.stop()
+
+
+def test_replica_rejects_writes_allows_reads(primary, replica):
+    ResultsDB(f"fraud://127.0.0.1:{primary.port}").create_pending("t1", {}, None)
+    assert _wait(lambda: replica.db.get("t1") is not None)
+    rdb = ResultsDB(f"fraud://127.0.0.1:{replica.port}")
+    assert rdb.get("t1") is not None  # reads OK on replica
+    with pytest.raises(Exception):  # write → readonly rejection → retries fail
+        rdb.create_pending("t2", {}, None)
+
+
+def test_client_reconnects_after_server_restart(tmp_path):
+    srv = StoreServer(str(tmp_path / "s"), port=0)
+    srv.start()
+    port = srv.port
+    q = Broker(f"fraud://127.0.0.1:{port}")
+    q.send_task("t", [1])
+    srv.stop()
+    q.close()  # drop the dead socket so the port leaves FIN_WAIT promptly
+    time.sleep(0.1)
+    srv2 = StoreServer(str(tmp_path / "s"), host="127.0.0.1", port=port)
+    srv2.start()
+    try:
+        # same data dir → task persisted; client's dead socket reconnects
+        assert q.depth() == 1
+        assert q.claim("w").args == [1]
+    finally:
+        srv2.stop()
+
+
+# ---------------------------------------------------------------------------
+# sentinel: discovery, quorum, failover (in-process)
+# ---------------------------------------------------------------------------
+
+def test_sentinel_discovers_and_serves_master(primary, replica):
+    s = Sentinel(
+        "m1",
+        stores=[("127.0.0.1", primary.port), ("127.0.0.1", replica.port)],
+        down_after=0.6,
+        poll_interval=0.1,
+    )
+    s.start()
+    try:
+        assert _wait(lambda: s.master == ("127.0.0.1", primary.port))
+        q = Broker(f"sentinel://127.0.0.1:{s.port}/m1")
+        q.send_task("t", [])
+        assert q.depth() == 1
+    finally:
+        s.stop()
+
+
+def test_sentinel_quorum_blocks_lone_vote(primary, replica):
+    """quorum=2 with no peers: a single sentinel must NOT fail over."""
+    s = Sentinel(
+        "m1",
+        stores=[("127.0.0.1", primary.port), ("127.0.0.1", replica.port)],
+        quorum=2,
+        down_after=0.4,
+        poll_interval=0.1,
+    )
+    s.start()
+    try:
+        assert _wait(lambda: s.master is not None)
+        primary.stop()
+        time.sleep(1.5)
+        assert replica.role == "replica"  # no promotion without quorum
+    finally:
+        s.stop()
+
+
+def test_sentinel_quorum_failover_promotes_replica(primary, replica):
+    """Two sentinels, quorum 2: primary death → agreement → replica promoted,
+    clients resolving through either sentinel keep working; queued tasks
+    survive (they were replicated)."""
+    stores = [("127.0.0.1", primary.port), ("127.0.0.1", replica.port)]
+    s1 = Sentinel("m1", stores=stores, quorum=2, down_after=0.5, poll_interval=0.1)
+    s1.start()
+    s2 = Sentinel(
+        "m1", stores=stores, peers=[("127.0.0.1", s1.port)],
+        quorum=2, down_after=0.5, poll_interval=0.1,
+    )
+    s2.start()
+    s1.peers = [("127.0.0.1", s2.port)]
+    try:
+        assert _wait(lambda: s1.master is not None and s2.master is not None)
+        q = Broker(f"sentinel://127.0.0.1:{s1.port},127.0.0.1:{s2.port}/m1")
+        sent = [q.send_task("t", [i]) for i in range(8)]
+        assert _wait(
+            lambda: replica.broker.depth() == 8
+        ), "replication did not catch up"
+        primary.stop()
+        assert _wait(lambda: replica.role == "primary", timeout=15.0), (
+            "no failover within deadline"
+        )
+        # same client object keeps working against the new primary
+        sent.append(q.send_task("t", [99]))
+        got = []
+        while True:
+            t = q.claim("w", visibility_timeout=60)
+            if t is None:
+                break
+            got.append(t.id)
+        assert sorted(got) == sorted(sent)  # zero task loss across failover
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# subprocess chaos: kill -9 the primary under load (the WorkerRecoveryTestPlan
+# broker-death scenario, automated)
+# ---------------------------------------------------------------------------
+
+def _spawn(args):
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+    return subprocess.Popen(
+        [sys.executable, "-m", *args],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_server(port, timeout=20.0):
+    def up():
+        try:
+            _call(("127.0.0.1", port), "ping", timeout=0.5)
+            return True
+        except OSError:
+            return False
+
+    assert _wait(up, timeout=timeout), f"server on :{port} never came up"
+
+
+@pytest.mark.slow
+def test_kill9_primary_failover_no_task_loss(tmp_path):
+    p1, p2, ps = _free_port(), _free_port(), _free_port()
+    procs = []
+    try:
+        procs.append(_spawn([
+            "fraud_detection_tpu.service.netserver", "--host", "127.0.0.1",
+            "--port", str(p1), "--data-dir", str(tmp_path / "d1"),
+        ]))
+        _wait_server(p1)
+        procs.append(_spawn([
+            "fraud_detection_tpu.service.netserver", "--host", "127.0.0.1",
+            "--port", str(p2), "--data-dir", str(tmp_path / "d2"),
+            "--replicate-from", f"127.0.0.1:{p1}",
+        ]))
+        _wait_server(p2)
+        procs.append(_spawn([
+            "fraud_detection_tpu.service.sentinel", "--host", "127.0.0.1",
+            "--port", str(ps), "--master-name", "m1",
+            "--stores", f"127.0.0.1:{p1},127.0.0.1:{p2}",
+            "--quorum", "1", "--down-after", "0.8", "--poll-interval", "0.2",
+        ]))
+        _wait_server(ps)
+
+        url = f"sentinel://127.0.0.1:{ps}/m1"
+        q, db = Broker(url), ResultsDB(url)
+        sent = []
+        for i in range(20):
+            db.create_pending(f"tx{i}", {"i": float(i)}, None)
+            sent.append(q.send_task("xai_tasks.compute_shap", [f"tx{i}", {}, None]))
+        # wait for replica to be in sync before the kill
+        assert _wait(
+            lambda: _call(("127.0.0.1", p2), "info", timeout=0.5)["depth"] == 20,
+            timeout=15.0,
+        )
+
+        procs[0].send_signal(signal.SIGKILL)  # primary dies hard
+        procs[0].wait(timeout=10)
+
+        def promoted():
+            try:
+                return _call(("127.0.0.1", p2), "ping", timeout=0.5)["role"] == "primary"
+            except OSError:
+                return False
+
+        assert _wait(promoted, timeout=20.0), "sentinel never promoted the replica"
+
+        # the SAME clients keep working; all 20 tasks + rows survived
+        assert db.count() == 20
+        sent.append(q.send_task("xai_tasks.compute_shap", ["tx_post", {}, None]))
+        got = []
+        while True:
+            t = q.claim("w", visibility_timeout=60)
+            if t is None:
+                break
+            got.append(t.id)
+        assert sorted(got) == sorted(sent)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
+@pytest.mark.slow
+def test_predict_stays_up_with_dead_broker(tmp_path, monkeypatch):
+    """Broker completely down: /predict must still answer 200 with
+    explanation_status="Queue failed" (the reference's degradation contract,
+    api/app.py:248-250)."""
+    monkeypatch.setenv("CELERY_BROKER_URL", "fraud://127.0.0.1:1")  # nothing there
+    monkeypatch.setenv("DATABASE_URL", f"sqlite:///{tmp_path}/fraud.db")
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    from fraud_detection_tpu.service.app import create_app
+    from fraud_detection_tpu.service.http import TestClient
+    from fraud_detection_tpu.service.netclient import _StoreClient
+
+    # drop per-call retries so the degraded path answers fast
+    monkeypatch.setattr(
+        "fraud_detection_tpu.service.netclient.RETRIES", 1, raising=True
+    )
+    app = create_app()
+    with TestClient(app) as client:
+        r = client.post("/predict", json={"features": [0.1] * 30})
+        assert r.status_code == 200
+        assert r.json()["explanation_status"] == "Queue failed"

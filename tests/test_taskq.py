@@ -4,6 +4,8 @@ state — the delivery guarantees the reference gets from Celery
 
 import time
 
+import pytest
+
 from fraud_detection_tpu.service.taskq import (
     CLAIMED,
     DONE,
@@ -13,12 +15,37 @@ from fraud_detection_tpu.service.taskq import (
 )
 
 
-def _broker(tmp_path):
-    return Broker(f"sqlite:///{tmp_path}/q.db")
+@pytest.fixture(params=["sqlite", "net"])
+def _srv(request, tmp_path):
+    """None for the sqlite backend, an in-process StoreServer for net —
+    every queue-semantics test runs against both."""
+    if request.param == "sqlite":
+        yield None
+    else:
+        from fraud_detection_tpu.service.netserver import StoreServer
+
+        srv = StoreServer(str(tmp_path / "store"), port=0)
+        srv.start()
+        yield srv
+        srv.stop()
 
 
-def test_send_claim_ack(tmp_path):
-    b = _broker(tmp_path)
+@pytest.fixture()
+def make_broker(_srv, tmp_path):
+    def _make():
+        if _srv is None:
+            return Broker(f"sqlite:///{tmp_path}/q.db")
+        return Broker(f"fraud://127.0.0.1:{_srv.port}")
+
+    return _make
+
+
+def _broker(make_broker):
+    return make_broker()
+
+
+def test_send_claim_ack(make_broker):
+    b = _broker(make_broker)
     tid = b.send_task("t", [1, "x"], correlation_id="c1")
     assert b.depth() == 1
     task = b.claim("w1")
@@ -31,10 +58,10 @@ def test_send_claim_ack(tmp_path):
     assert b.claim("w1") is None
 
 
-def test_acks_late_redelivery_after_worker_death(tmp_path):
+def test_acks_late_redelivery_after_worker_death(make_broker):
     """A claimed-but-never-acked task (dead worker) becomes deliverable again
     once the visibility timeout lapses — at-least-once, zero loss."""
-    b = _broker(tmp_path)
+    b = _broker(make_broker)
     tid = b.send_task("t", [])
     t1 = b.claim("w1", visibility_timeout=0.05)
     assert t1 is not None
@@ -44,8 +71,8 @@ def test_acks_late_redelivery_after_worker_death(tmp_path):
     assert t2 is not None and t2.id == tid
 
 
-def test_retry_backoff_and_terminal_failure(tmp_path):
-    b = _broker(tmp_path)
+def test_retry_backoff_and_terminal_failure(make_broker):
+    b = _broker(make_broker)
     tid = b.send_task("t", [], max_retries=2)
     for attempt in range(2):
         task = b.claim("w")
@@ -58,8 +85,8 @@ def test_retry_backoff_and_terminal_failure(tmp_path):
     assert b.claim("w") is None
 
 
-def test_countdown_delays_redelivery(tmp_path):
-    b = _broker(tmp_path)
+def test_countdown_delays_redelivery(make_broker):
+    b = _broker(make_broker)
     b.send_task("t", [])
     task = b.claim("w")
     b.nack(task.id, countdown=0.08, error="later")
@@ -68,15 +95,15 @@ def test_countdown_delays_redelivery(tmp_path):
     assert b.claim("w") is not None
 
 
-def test_fifo_order(tmp_path):
-    b = _broker(tmp_path)
+def test_fifo_order(make_broker):
+    b = _broker(make_broker)
     ids = [b.send_task("t", [i]) for i in range(3)]
     got = [b.claim("w").id for _ in range(3)]
     assert got == ids
 
 
-def test_depth_counts_expired_claims(tmp_path):
-    b = _broker(tmp_path)
+def test_depth_counts_expired_claims(make_broker):
+    b = _broker(make_broker)
     b.send_task("t", [])
     b.claim("w", visibility_timeout=0.01)
     time.sleep(0.02)
