@@ -132,6 +132,14 @@ def worker_metrics_port() -> int:
     return _get_int("WORKER_METRICS_PORT", 8001)
 
 
+def store_token() -> str:
+    """Shared secret for the network store tier (netserver/sentinel/clients).
+    When set, every frame must carry it and servers reject unauthenticated
+    peers — the credential-equivalent of the reference's Postgres password
+    (db/db.py:6-9). Empty (default) = unauthenticated, loopback/dev only."""
+    return _get("FRAUD_STORE_TOKEN", "")
+
+
 # --------------------------------------------------------------------------
 # Synthetic data (reference: scripts/generate_synthetic_data.py:32-33)
 # --------------------------------------------------------------------------

@@ -27,6 +27,7 @@ Differences from the reference, by design:
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 import uuid
@@ -173,10 +174,20 @@ def create_app(
 
     @app.get("/health")
     async def health(req: Request) -> Response:
+        # Pings run concurrently off-loop; the net clients' ping() is a
+        # single-attempt probe on its own connection, so a store outage
+        # yields a fast 503 instead of a probe-timeout hang behind the
+        # pooled connection's failover retry budget.
+        db_ok, broker_ok = await asyncio.gather(
+            asyncio.to_thread(lambda: bool(state["db"] and state["db"].ping())),
+            asyncio.to_thread(
+                lambda: bool(state["broker"] and state["broker"].ping())
+            ),
+        )
         checks = {
             "model": "ok" if state["model"] is not None else "unavailable",
-            "database": "ok" if state["db"] and state["db"].ping() else "unavailable",
-            "broker": "ok" if state["broker"] and state["broker"].ping() else "unavailable",
+            "database": "ok" if db_ok else "unavailable",
+            "broker": "ok" if broker_ok else "unavailable",
         }
         healthy = all(v == "ok" for v in checks.values())
         body = HealthOut(
@@ -211,12 +222,18 @@ def create_app(
         feature_dict = dict(zip(model.feature_names, row.tolist()))
         tx_id = str(uuid.uuid4())
         explanation_status = "queued"
-        try:
+        # The store clients are synchronous with a multi-second retry budget
+        # (sized to ride through a sentinel failover); run them off-loop so
+        # an outage stalls only this request, never /health or scoring.
+        def _persist_and_enqueue():
             with metrics.timed(metrics.db_latency):
                 state["db"].create_pending(tx_id, feature_dict, corr_id)
             state["broker"].send_task(
                 TASK_NAME, [tx_id, feature_dict, corr_id], correlation_id=corr_id
             )
+
+        try:
+            await asyncio.to_thread(_persist_and_enqueue)
         except Exception as e:
             # Queue down must not fail scoring (api/app.py:248-250).
             log.error("[%s] enqueue failed: %s", corr_id, e)
@@ -236,7 +253,7 @@ def create_app(
     async def explain(req: Request) -> Response:
         tx_id = req.path_params["transaction_id"]
         with metrics.timed(metrics.db_latency):
-            row = state["db"].get(tx_id)
+            row = await asyncio.to_thread(state["db"].get, tx_id)
         if row is None or row["status"] == "PENDING":
             raise HTTPError(
                 404,

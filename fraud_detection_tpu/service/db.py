@@ -160,13 +160,17 @@ class SqliteResultsDB:
     def fail(self, transaction_id: str, error: str) -> None:
         now = time.time()
         with self._lock, self._conn:
+            # The WHERE guard keeps a late/duplicate failure report (e.g. a
+            # worker whose nack response was lost while another worker went
+            # on to complete the task) from clobbering a COMPLETED result.
             self._conn.execute(
                 "INSERT INTO transaction_results "
                 "(transaction_id, input_data, shap_values, status, created_at, updated_at) "
                 "VALUES (?, '{}', ?, ?, ?, ?) "
                 "ON CONFLICT(transaction_id) DO UPDATE SET "
                 "shap_values=excluded.shap_values, status=excluded.status, "
-                "updated_at=excluded.updated_at",
+                "updated_at=excluded.updated_at "
+                "WHERE transaction_results.status != 'COMPLETED'",
                 (transaction_id, json.dumps({"error": error}), FAILED, now, now),
             )
 
@@ -240,6 +244,20 @@ class SqliteResultsDB:
         )
         with self._lock, self._conn:
             self._conn.executemany(sql, [[r[c] for c in cols] for r in rows])
+
+    def replace_rows(self, rows: list[dict]) -> None:
+        """Snapshot application: delete-then-apply so rows a demoted
+        ex-primary wrote while partitioned don't survive resync (see
+        taskq.SqliteBroker.replace_rows)."""
+        with self._lock, self._conn:
+            self._conn.execute("DELETE FROM transaction_results")
+            if rows:
+                cols = list(rows[0].keys())
+                self._conn.executemany(
+                    f"INSERT OR REPLACE INTO transaction_results "
+                    f"({','.join(cols)}) VALUES ({','.join('?' * len(cols))})",
+                    [[r[c] for c in cols] for r in rows],
+                )
 
 
 def ResultsDB(url: str | None = None):

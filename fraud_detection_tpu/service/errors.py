@@ -27,3 +27,7 @@ class ReadOnlyError(StoreError):
 
 class ProtocolError(StoreError):
     """Malformed frame on the wire."""
+
+
+class StoreAuthError(StoreError):
+    """Server rejected our credentials — misconfiguration, never retried."""

@@ -27,11 +27,14 @@ from __future__ import annotations
 import socket
 import threading
 import time
+import uuid
 from typing import Any
 
+from fraud_detection_tpu import config
 from fraud_detection_tpu.service.errors import (
     BrokerError,
     DatabaseError,
+    StoreAuthError,
     StoreError,
 )
 from fraud_detection_tpu.service.taskq import (
@@ -39,12 +42,22 @@ from fraud_detection_tpu.service.taskq import (
     DEFAULT_VISIBILITY_TIMEOUT,
     Task,
 )
-from fraud_detection_tpu.service.wire import parse_hostport, recv_frame, send_frame
+from fraud_detection_tpu.service.wire import (
+    attach_auth,
+    parse_hostport,
+    recv_frame,
+    send_frame,
+)
 
 CONNECT_TIMEOUT = 3.0
 CALL_TIMEOUT = 15.0
-RETRIES = 6          # total attempts per call across reconnect/re-resolve
-BACKOFF_BASE = 0.05  # seconds; doubles per attempt, capped at 1s
+# Total attempts per call across reconnect/re-resolve. The backoff sum
+# (~7s with the 2s cap) must exceed the sentinel's down_after (3s default)
+# plus promotion time, so a call issued the instant the primary dies
+# survives into the post-failover world instead of crashing its caller.
+RETRIES = 8
+BACKOFF_BASE = 0.05  # seconds; doubles per attempt, capped at 2s
+BACKOFF_CAP = 2.0
 
 
 def _parse(url: str) -> tuple[str, list[tuple[str, int]], str]:
@@ -68,8 +81,12 @@ class _StoreClient:
     def __init__(self, url: str):
         self.url = url
         self.mode, self.endpoints, self.master_name = _parse(url)
+        self.auth_token = config.store_token()
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
+
+    def _frame(self, op: str, **kwargs: Any) -> dict[str, Any]:
+        return attach_auth({"op": op, **kwargs}, self.auth_token)
 
     # -- connection management --------------------------------------------
     def _resolve_primary(self) -> tuple[str, int]:
@@ -80,9 +97,15 @@ class _StoreClient:
             try:
                 with socket.create_connection(ep, timeout=CONNECT_TIMEOUT) as s:
                     send_frame(
-                        s, {"op": "s.get-master", "name": self.master_name}
+                        s, self._frame("s.get-master", name=self.master_name)
                     )
                     resp = recv_frame(s)
+                if resp and resp.get("kind") == "auth":
+                    # misconfiguration, not transience: skip the retry budget
+                    raise StoreAuthError(
+                        f"sentinel {ep} rejected credentials: "
+                        + resp.get("error", "authentication failed")
+                    )
                 if resp and resp.get("ok") and resp["result"]:
                     m = resp["result"]
                     return m["host"], int(m["port"])
@@ -114,14 +137,16 @@ class _StoreClient:
         with self._lock:
             for attempt in range(RETRIES):
                 if attempt:
-                    time.sleep(min(BACKOFF_BASE * 2 ** (attempt - 1), 1.0))
+                    time.sleep(min(BACKOFF_BASE * 2 ** (attempt - 1), BACKOFF_CAP))
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
-                    send_frame(self._sock, {"op": op, **kwargs})
+                    send_frame(self._sock, self._frame(op, **kwargs))
                     resp = recv_frame(self._sock)
                     if resp is None:
                         raise OSError("server closed connection")
+                except StoreAuthError:
+                    raise  # misconfiguration, not transience: never retry
                 except (OSError, StoreError) as e:
                     last_err = e
                     self._drop()
@@ -133,16 +158,28 @@ class _StoreClient:
                     last_err = self.error_cls(resp.get("error", "readonly"))
                     self._drop()
                     continue
+                if resp.get("kind") == "auth":
+                    raise StoreAuthError(resp.get("error", "authentication failed"))
                 raise self.error_cls(resp.get("error", "server error"))
         raise self.error_cls(
             f"{op} failed after {RETRIES} attempts: {last_err}"
         )
 
     def ping(self) -> bool:
+        """Single-attempt liveness probe on its own short-lived connection:
+        no retry budget and no shared client lock, so a health check answers
+        within one connect timeout even while request traffic is riding out
+        a failover on the pooled connection."""
         try:
-            self.call("ping")
-            return True
-        except StoreError:
+            host, port = self._resolve_primary()
+            with socket.create_connection(
+                (host, port), timeout=CONNECT_TIMEOUT
+            ) as s:
+                s.settimeout(CONNECT_TIMEOUT)
+                send_frame(s, self._frame("ping"))
+                resp = recv_frame(s)
+            return bool(resp and resp.get("ok"))
+        except (OSError, StoreError):
             return False
 
     def info(self) -> dict:
@@ -169,9 +206,12 @@ class NetResultsDB(_StoreClient):
         input_data: dict,
         correlation_id: str | None = None,
     ) -> str:
+        # Generate the id client-side: a retry after an ambiguous failure
+        # (connection lost between send and response) then upserts the SAME
+        # row instead of inserting a second one under a server-minted id.
         return self.call(
             "db.create_pending",
-            transaction_id=transaction_id,
+            transaction_id=transaction_id or str(uuid.uuid4()),
             input_data=input_data,
             correlation_id=correlation_id,
         )
@@ -211,7 +251,10 @@ class NetBroker(_StoreClient):
         correlation_id: str | None = None,
         max_retries: int = DEFAULT_MAX_RETRIES,
         countdown: float = 0.0,
+        task_id: str | None = None,
     ) -> str:
+        # Client-side id + server-side ON CONFLICT DO NOTHING = an ambiguous
+        # retry cannot enqueue the task twice.
         return self.call(
             "q.send_task",
             name=name,
@@ -219,6 +262,7 @@ class NetBroker(_StoreClient):
             correlation_id=correlation_id,
             max_retries=max_retries,
             countdown=countdown,
+            task_id=task_id or uuid.uuid4().hex,
         )
 
     def claim(
@@ -244,8 +288,18 @@ class NetBroker(_StoreClient):
     def ack(self, task_id: str) -> None:
         self.call("q.ack", task_id=task_id)
 
-    def nack(self, task_id: str, countdown: float, error: str = "") -> bool:
-        return self.call("q.nack", task_id=task_id, countdown=countdown, error=error)
+    def nack(
+        self,
+        task_id: str,
+        countdown: float,
+        error: str = "",
+        expected_attempts: int | None = None,
+        claimed_by: str | None = None,
+    ) -> bool:
+        return self.call(
+            "q.nack", task_id=task_id, countdown=countdown, error=error,
+            expected_attempts=expected_attempts, claimed_by=claimed_by,
+        )
 
     def depth(self) -> int:
         return self.call("q.depth")

@@ -20,7 +20,14 @@ Design:
   delivery (the queue's visibility-timeout redelivery covers the gap).
 - **Failover**: a replica accepts ``promote`` (from sentinel.py) and
   becomes a writable primary; writes to a replica fail fast with
-  ``kind="readonly"`` so clients re-resolve the primary.
+  ``kind="readonly"`` so clients re-resolve the primary. A rejoining stale
+  primary is sent ``demote`` by the sentinels (split-brain recovery): it
+  becomes a replica of the elected primary and *replaces* its local state
+  with the primary's snapshot, discarding partitioned writes.
+- **Auth**: when ``FRAUD_STORE_TOKEN`` is set, every frame must carry the
+  shared secret (constant-time compare) — the credential-equivalent of the
+  reference's Postgres password. The listener binds loopback by default;
+  container topologies pass ``--host 0.0.0.0`` explicitly.
 
 Run: ``python -m fraud_detection_tpu.service.netserver --port 7600
 --data-dir /var/lib/fraudstore [--replicate-from host:port]``.
@@ -37,9 +44,18 @@ import threading
 import time
 from typing import Any
 
+from fraud_detection_tpu import config
+
 from fraud_detection_tpu.service.db import SqliteResultsDB
 from fraud_detection_tpu.service.taskq import DEFAULT_MAX_RETRIES, SqliteBroker
-from fraud_detection_tpu.service.wire import parse_hostport, recv_frame, send_frame
+from fraud_detection_tpu.service.wire import (
+    AUTH_REJECTION,
+    attach_auth,
+    check_auth,
+    parse_hostport,
+    recv_frame,
+    send_frame,
+)
 
 log = logging.getLogger("fraud_detection_tpu.netserver")
 
@@ -57,6 +73,7 @@ class StoreServer:
         host: str = "127.0.0.1",
         port: int = 0,
         replicate_from: str | None = None,
+        auth_token: str | None = None,
     ):
         os.makedirs(data_dir, exist_ok=True)
         self.db = SqliteResultsDB(f"sqlite:///{os.path.join(data_dir, 'results.db')}")
@@ -64,8 +81,17 @@ class StoreServer:
         self.host, self.port = host, port
         self.role = REPLICA if replicate_from else PRIMARY
         self.replicate_from = replicate_from
+        self.auth_token = config.store_token() if auth_token is None else auth_token
         self.seq = 0
-        self._pub_lock = threading.Lock()
+        # Bumped on every role/upstream change (promote, demote/re-point):
+        # a replica loop only applies frames while its spawn generation is
+        # current, so a re-point or promote↔demote flap can't leave an old
+        # loop applying stale frames alongside (or instead of) the new one.
+        self.repl_gen = 0
+        # RLock: writes capture their row image and publish under the same
+        # critical section (_dispatch → _publish), so a slower writer can't
+        # publish an older row image with a newer seq (replica staleness).
+        self._pub_lock = threading.RLock()
         self._subs: list[queue.Queue] = []
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -84,7 +110,9 @@ class StoreServer:
         t.start()
         self._threads.append(t)
         if self.role == REPLICA:
-            t = threading.Thread(target=self._replica_loop, daemon=True)
+            t = threading.Thread(
+                target=self._replica_loop, args=(self.repl_gen,), daemon=True
+            )
             t.start()
             self._threads.append(t)
         log.info("store server %s on %s:%d", self.role, self.host, self.port)
@@ -145,6 +173,9 @@ class StoreServer:
                 req = recv_frame(conn)
                 if req is None:
                     return
+                if not check_auth(req, self.auth_token):
+                    send_frame(conn, AUTH_REJECTION)
+                    continue
                 op = req.pop("op", None)
                 if op == "subscribe":
                     self._serve_subscriber(conn)
@@ -173,11 +204,15 @@ class StoreServer:
     def _dispatch(self, op: str, a: dict[str, Any]) -> Any:
         # reads — allowed on any role (replicas serve monitoring/readbacks)
         if op == "ping":
-            return {"role": self.role, "seq": self.seq}
+            return {
+                "role": self.role, "seq": self.seq,
+                "replicate_from": self.replicate_from,
+            }
         if op == "info":
             return {
                 "role": self.role,
                 "seq": self.seq,
+                "replicate_from": self.replicate_from,
                 "replicas": len(self._subs),
                 "depth": self.broker.depth(),
                 "results": self.db.count(),
@@ -192,54 +227,95 @@ class StoreServer:
             return self.broker.get_status(a["task_id"])
         # role transitions
         if op == "promote":
-            self.role = PRIMARY
+            # Under _pub_lock: the replica apply loop holds the same lock
+            # and re-checks role/generation, so no stale frame from the old
+            # primary can land after promotion (it would overwrite acked
+            # writes).
+            with self._pub_lock:
+                self.role = PRIMARY
+                self.replicate_from = None
+                self.repl_gen += 1
             log.warning("PROMOTED to primary (seq %d)", self.seq)
             return {"role": self.role}
-        # writes — primary only
-        if self.role != PRIMARY:
-            raise _ReadOnly()
-        if op == "db.create_pending":
-            tx_id = self.db.create_pending(
-                a.get("transaction_id"), a["input_data"], a.get("correlation_id")
+        if op == "demote":
+            # Sentinel found us running as a stale primary after a failover,
+            # or is re-pointing a replica at the new primary. The role flip
+            # happens under _pub_lock so no in-flight write can pass the
+            # primary check and then commit after the snapshot-replace
+            # resync discards partitioned state. The generation bump retires
+            # any existing replica loop (still chained to the old upstream)
+            # and a fresh loop is ALWAYS spawned — re-pointing must take
+            # effect even when the old subscription is healthy.
+            with self._pub_lock:
+                self.replicate_from = a["replicate_from"]
+                was = self.role
+                self.role = REPLICA
+                self.repl_gen += 1
+                gen = self.repl_gen
+            log.warning(
+                "DEMOTED/re-pointed to replica of %s (was %s, seq %d)",
+                self.replicate_from, was, self.seq,
             )
-            self._publish("transaction_results", self.db.fetch_rows([tx_id]))
-            return tx_id
-        if op == "db.complete":
-            self.db.complete(
-                a["transaction_id"], a["shap_values"], a["expected_value"],
-                a["prediction_score"],
+            t = threading.Thread(
+                target=self._replica_loop, args=(gen,), daemon=True
             )
-            self._publish(
-                "transaction_results", self.db.fetch_rows([a["transaction_id"]])
-            )
-            return None
-        if op == "db.fail":
-            self.db.fail(a["transaction_id"], a["error"])
-            self._publish(
-                "transaction_results", self.db.fetch_rows([a["transaction_id"]])
-            )
-            return None
-        if op == "q.send_task":
-            task_id = self.broker.send_task(
-                a["name"], a["args"], a.get("correlation_id"),
-                a.get("max_retries", DEFAULT_MAX_RETRIES), a.get("countdown", 0.0),
-            )
-            self._publish("tasks", self.broker.fetch_rows([task_id]))
-            return task_id
-        if op == "q.claim_many":
-            tasks = self.broker.claim_many(
-                a["worker_id"], a["limit"], a["visibility_timeout"]
-            )
-            self._publish("tasks", self.broker.fetch_rows([t.id for t in tasks]))
-            return [t.__dict__ for t in tasks]
-        if op == "q.ack":
-            self.broker.ack(a["task_id"])
-            self._publish("tasks", self.broker.fetch_rows([a["task_id"]]))
-            return None
-        if op == "q.nack":
-            will_retry = self.broker.nack(a["task_id"], a["countdown"], a.get("error", ""))
-            self._publish("tasks", self.broker.fetch_rows([a["task_id"]]))
-            return will_retry
+            t.start()
+            self._threads.append(t)
+            return {"role": self.role}
+        # Writes — primary only. Role check, write, row-image capture, and
+        # publish share one _pub_lock critical section: seq order == row-
+        # image order, and a concurrent demote can't interleave.
+        with self._pub_lock:
+            if self.role != PRIMARY:
+                raise _ReadOnly()
+            if op == "db.create_pending":
+                tx_id = self.db.create_pending(
+                    a.get("transaction_id"), a["input_data"], a.get("correlation_id")
+                )
+                self._publish("transaction_results", self.db.fetch_rows([tx_id]))
+                return tx_id
+            if op == "db.complete":
+                self.db.complete(
+                    a["transaction_id"], a["shap_values"], a["expected_value"],
+                    a["prediction_score"],
+                )
+                self._publish(
+                    "transaction_results", self.db.fetch_rows([a["transaction_id"]])
+                )
+                return None
+            if op == "db.fail":
+                self.db.fail(a["transaction_id"], a["error"])
+                self._publish(
+                    "transaction_results", self.db.fetch_rows([a["transaction_id"]])
+                )
+                return None
+            if op == "q.send_task":
+                task_id = self.broker.send_task(
+                    a["name"], a["args"], a.get("correlation_id"),
+                    a.get("max_retries", DEFAULT_MAX_RETRIES),
+                    a.get("countdown", 0.0),
+                    task_id=a.get("task_id"),
+                )
+                self._publish("tasks", self.broker.fetch_rows([task_id]))
+                return task_id
+            if op == "q.claim_many":
+                tasks = self.broker.claim_many(
+                    a["worker_id"], a["limit"], a["visibility_timeout"]
+                )
+                self._publish("tasks", self.broker.fetch_rows([t.id for t in tasks]))
+                return [t.__dict__ for t in tasks]
+            if op == "q.ack":
+                self.broker.ack(a["task_id"])
+                self._publish("tasks", self.broker.fetch_rows([a["task_id"]]))
+                return None
+            if op == "q.nack":
+                will_retry = self.broker.nack(
+                    a["task_id"], a["countdown"], a.get("error", ""),
+                    expected_attempts=a.get("expected_attempts"),
+                    claimed_by=a.get("claimed_by"),
+                )
+                self._publish("tasks", self.broker.fetch_rows([a["task_id"]]))
+                return will_retry
         raise ValueError(f"unknown op {op!r}")
 
     # -- replication (primary side) ----------------------------------------
@@ -283,36 +359,57 @@ class StoreServer:
                     self._subs.remove(sub)
 
     # -- replication (replica side) ----------------------------------------
-    def _replica_loop(self) -> None:
-        host, port = parse_hostport(self.replicate_from, 7600)
-        while not self._stop.is_set() and self.role == REPLICA:
+    def _gen_ok(self, gen: int) -> bool:
+        return self.role == REPLICA and self.repl_gen == gen
+
+    def _replica_loop(self, gen: int) -> None:
+        """Subscribe to the upstream and apply its stream, for as long as
+        this loop's spawn generation is current. Checked per frame (the
+        upstream heartbeats every second), so a re-point or promotion
+        retires this loop within ~1s even while its connection is healthy."""
+        while not self._stop.is_set() and self._gen_ok(gen):
+            host, port = parse_hostport(self.replicate_from, 7600)
             try:
                 with socket.create_connection((host, port), timeout=5.0) as s:
                     s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                     s.settimeout(3 * HEARTBEAT_INTERVAL)
-                    send_frame(s, {"op": "subscribe"})
-                    while not self._stop.is_set() and self.role == REPLICA:
+                    send_frame(s, attach_auth({"op": "subscribe"}, self.auth_token))
+                    while not self._stop.is_set() and self._gen_ok(gen):
                         msg = recv_frame(s)
                         if msg is None:
                             break
+                        if msg.get("kind") == "auth":
+                            log.error("primary rejected replica auth")
+                            self._stop.wait(5 * RESYNC_INTERVAL)
+                            break
                         if msg["t"] == "snapshot":
-                            self.db.apply_rows(msg["results"])
-                            self.broker.apply_rows(msg["tasks"])
-                            self.seq = msg["seq"]
+                            # Apply under _pub_lock with a generation
+                            # re-check: a promote/re-point racing this recv
+                            # must not let a stale frame from the old
+                            # upstream overwrite newer state.
+                            with self._pub_lock:
+                                if not self._gen_ok(gen):
+                                    break
+                                self.db.replace_rows(msg["results"])
+                                self.broker.replace_rows(msg["tasks"])
+                                self.seq = msg["seq"]
                             log.info(
                                 "replica synced: %d results, %d tasks (seq %d)",
                                 len(msg["results"]), len(msg["tasks"]), msg["seq"],
                             )
                         elif msg["t"] == "rows":
-                            if msg["table"] == "transaction_results":
-                                self.db.apply_rows(msg["rows"])
-                            else:
-                                self.broker.apply_rows(msg["rows"])
-                            self.seq = msg["seq"]
+                            with self._pub_lock:
+                                if not self._gen_ok(gen):
+                                    break
+                                if msg["table"] == "transaction_results":
+                                    self.db.apply_rows(msg["rows"])
+                                else:
+                                    self.broker.apply_rows(msg["rows"])
+                                self.seq = msg["seq"]
                         # "hb": keepalive only
             except OSError:
                 pass
-            if self.role == REPLICA:
+            if self._gen_ok(gen):
                 self._stop.wait(RESYNC_INTERVAL)
 
 
@@ -323,16 +420,24 @@ class _ReadOnly(Exception):
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument(
+        "--host", default="127.0.0.1",
+        help="bind address; container topologies pass 0.0.0.0 explicitly",
+    )
     ap.add_argument("--port", type=int, default=7600)
     ap.add_argument("--data-dir", default="./fraudstore")
     ap.add_argument(
         "--replicate-from", default=None,
         help="host:port of the primary; starts this server as a replica",
     )
+    ap.add_argument(
+        "--auth-token", default=None,
+        help="shared secret (default: FRAUD_STORE_TOKEN env)",
+    )
     args = ap.parse_args()
     StoreServer(
-        args.data_dir, args.host, args.port, replicate_from=args.replicate_from
+        args.data_dir, args.host, args.port,
+        replicate_from=args.replicate_from, auth_token=args.auth_token,
     ).serve_forever()
 
 

@@ -11,10 +11,13 @@ Semantics (matching Redis Sentinel's, and documented with the same
 honesty): replication is asynchronous, so a failover can lose writes the
 dead primary acked but never shipped; the task queue's visibility-timeout
 redelivery turns that loss into at-least-once re-execution, and the results
-table's idempotent upserts make re-execution safe. A failed-over old
-primary must be restarted with ``--replicate-from`` pointing at the new
-one (split-brain is prevented by clients resolving through sentinels, who
-answer with the *elected* primary only).
+table's idempotent upserts make re-execution safe. Split-brain recovery is
+active, like Redis Sentinel reconfiguring a rejoining master as replica:
+when a store that is not the elected primary reports ``role=primary``
+(a healed partition), the sentinel sends it ``demote`` pointing at the
+elected primary; the demoted server resyncs by snapshot-*replace*,
+discarding writes it accepted while partitioned, and its open clients get
+``kind=readonly`` on their next write and re-resolve.
 
 Run: ``python -m fraud_detection_tpu.service.sentinel --port 26379
 --master-name mymaster --stores h1:7600,h2:7600 [--peers h3:26379,...]
@@ -30,7 +33,15 @@ import threading
 import time
 from typing import Any
 
-from fraud_detection_tpu.service.wire import parse_hostport, recv_frame, send_frame
+from fraud_detection_tpu import config
+from fraud_detection_tpu.service.wire import (
+    AUTH_REJECTION,
+    attach_auth,
+    check_auth,
+    parse_hostport,
+    recv_frame,
+    send_frame,
+)
 
 log = logging.getLogger("fraud_detection_tpu.sentinel")
 
@@ -39,10 +50,11 @@ Endpoint = tuple[str, int]
 
 def _call(ep: Endpoint, op: str, timeout: float = 1.0, **kwargs: Any) -> Any:
     """One-shot request/response to a store or peer sentinel."""
+    req = attach_auth({"op": op, **kwargs}, config.store_token())
     with socket.create_connection(ep, timeout=timeout) as s:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         s.settimeout(timeout)
-        send_frame(s, {"op": op, **kwargs})
+        send_frame(s, req)
         resp = recv_frame(s)
     if resp is None or not resp.get("ok"):
         raise OSError(f"{op} to {ep} failed: {resp and resp.get('error')}")
@@ -69,6 +81,7 @@ class Sentinel:
         self.poll_interval = poll_interval
         self.host, self.port = host, port
         self.master: Endpoint | None = None
+        self._started = time.time()
         self._last_ok: dict[Endpoint, float] = {}
         self._last_info: dict[Endpoint, dict] = {}
         self._lock = threading.Lock()
@@ -122,8 +135,13 @@ class Sentinel:
                 self._last_info[ep] = info
 
     def _is_down(self, ep: Endpoint) -> bool:
+        # A never-probed store counts as down only after down_after has
+        # elapsed since THIS sentinel started — one lost first probe must
+        # not count as "down since epoch" (a fresh sentinel could otherwise
+        # promote a replica next to a healthy primary it simply hadn't
+        # reached yet, and then demote-and-wipe the real primary).
         with self._lock:
-            last = self._last_ok.get(ep, 0.0)
+            last = self._last_ok.get(ep, self._started)
         return time.time() - last > self.down_after
 
     def _elect_initial(self) -> Endpoint | None:
@@ -178,15 +196,135 @@ class Sentinel:
         )
         self.master = best
 
+    def _master_quorum(self) -> int:
+        """Votes (self + peers) naming OUR master as the current primary.
+        Guards demotion: a sentinel whose view diverged after a failover
+        must not unilaterally demote the primary its peers elected."""
+        votes = 1
+        for peer in self.peers:
+            try:
+                m = _call(peer, "s.get-master", name=self.master_name)
+            except OSError:
+                continue
+            if m and (m["host"], int(m["port"])) == self.master:
+                votes += 1
+        return votes
+
+    def _demote_stale(self) -> None:
+        """Active split-brain recovery: any healthy store that is NOT the
+        elected primary but still reports role=primary (a healed partition,
+        or a double-start) is told to become a replica of the elected one.
+        Mirrors Redis Sentinel reconfiguring a rejoining master as slave.
+
+        Two guards against demoting the wrong server from a divergent view:
+        the elected master must itself still report role=primary, and a
+        quorum of sentinels must agree that OUR master is the master."""
+        with self._lock:
+            infos = dict(self._last_info)
+        if infos.get(self.master, {}).get("role") != "primary":
+            return  # our view is stale; let the re-validation path handle it
+        stale: list[Endpoint] = []      # healthy non-masters claiming primary
+        mispointed: list[Endpoint] = []  # healthy replicas tracking ≠ master
+        for ep in self.stores:
+            if ep == self.master or self._is_down(ep):
+                continue
+            info = infos.get(ep, {})
+            if info.get("role") == "primary":
+                stale.append(ep)
+            elif info.get("role") == "replica":
+                # A replica still chained to the dead/old primary receives
+                # no writes but looks healthy — a later failover could
+                # promote it and lose everything since the last one. Re-
+                # point it at the elected master. (Endpoints must be named
+                # consistently across sentinel/store configs, as with Redis.)
+                upstream = info.get("replicate_from")
+                if upstream and parse_hostport(upstream, 7600) != self.master:
+                    mispointed.append(ep)
+        if not stale and not mispointed:
+            return
+        votes = self._master_quorum()
+        if votes < self.quorum:
+            log.warning(
+                "topology drift (stale=%s mispointed=%s) but peers don't "
+                "agree %s is master (%d/%d votes); not reconfiguring",
+                stale, mispointed, self.master, votes, self.quorum,
+            )
+            return
+        target = f"{self.master[0]}:{self.master[1]}"
+        for ep in stale:
+            try:
+                _call(ep, "demote", replicate_from=target)
+                log.warning(
+                    "demoted stale primary %s → replica of %s", ep, target
+                )
+            except OSError as e:
+                log.warning("demote of stale primary %s failed: %s", ep, e)
+        for ep in mispointed:
+            try:
+                _call(ep, "demote", replicate_from=target)
+                log.warning("re-pointed replica %s → %s", ep, target)
+            except OSError as e:
+                log.warning("re-point of replica %s failed: %s", ep, e)
+
+    def _revalidate_master(self) -> None:
+        """If the store we call master now reports role=replica (a peer
+        demoted it, or an operator re-pointed it), forget it and re-discover
+        — otherwise the loop would serve a read-only 'primary' forever."""
+        with self._lock:
+            info = self._last_info.get(self.master, {})
+        if info.get("role") == "replica":
+            log.warning(
+                "elected master %s now reports role=replica; re-discovering",
+                self.master,
+            )
+            self.master = None
+
+    def _promote_if_none(self) -> None:
+        """All healthy stores are replicas (e.g. every primary was demoted
+        from divergent views, or a cold start from replicated data dirs):
+        with quorum agreement that there is NO master, promote the highest-
+        seq healthy store so the cluster can't wedge read-only."""
+        healthy = [ep for ep in self.stores if not self._is_down(ep)]
+        if not healthy:
+            return
+        votes = 1
+        for peer in self.peers:
+            try:
+                if _call(peer, "s.get-master", name=self.master_name) is None:
+                    votes += 1
+            except OSError:
+                pass
+        if votes < self.quorum:
+            return
+        with self._lock:
+            infos = dict(self._last_info)
+        best = max(healthy, key=lambda ep: infos.get(ep, {}).get("seq", 0))
+        try:
+            _call(best, "promote")
+        except OSError as e:
+            log.error("promote of %s failed: %s", best, e)
+            return
+        log.warning(
+            "no primary among healthy stores; PROMOTED %s (quorum %d/%d)",
+            best, votes, self.quorum,
+        )
+        self.master = best
+
     def _monitor_loop(self) -> None:
         while not self._stop.is_set():
             self._probe_all()
+            if self.master is not None and not self._is_down(self.master):
+                self._revalidate_master()
             if self.master is None:
                 self.master = self._elect_initial()
                 if self.master:
                     log.info("discovered primary %s", self.master)
+                else:
+                    self._promote_if_none()
             elif self._is_down(self.master):
                 self._failover()
+            else:
+                self._demote_stale()
             self._stop.wait(self.poll_interval)
 
     # -- server ------------------------------------------------------------
@@ -199,11 +337,15 @@ class Sentinel:
             threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
 
     def _handle(self, conn: socket.socket) -> None:
+        token = config.store_token()
         try:
             while not self._stop.is_set():
                 req = recv_frame(conn)
                 if req is None:
                     return
+                if not check_auth(req, token):
+                    send_frame(conn, AUTH_REJECTION)
+                    continue
                 op = req.get("op")
                 if op == "ping":
                     send_frame(conn, {"ok": True, "result": {"role": "sentinel"}})
@@ -230,7 +372,10 @@ class Sentinel:
 def main() -> None:
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument(
+        "--host", default="127.0.0.1",
+        help="bind address; container topologies pass 0.0.0.0 explicitly",
+    )
     ap.add_argument("--port", type=int, default=26379)
     ap.add_argument("--master-name", default="mymaster")
     ap.add_argument("--stores", required=True, help="h1:p1,h2:p2 store servers")

@@ -98,15 +98,22 @@ class SqliteBroker:
         correlation_id: str | None = None,
         max_retries: int = DEFAULT_MAX_RETRIES,
         countdown: float = 0.0,
+        task_id: str | None = None,
     ) -> str:
-        """Celery ``send_task`` equivalent (api/app.py:244-245)."""
-        task_id = uuid.uuid4().hex
+        """Celery ``send_task`` equivalent (api/app.py:244-245).
+
+        ``task_id`` may be supplied by the caller (network clients generate
+        it client-side so an ambiguous retry — connection lost between send
+        and response — lands on DO NOTHING instead of enqueuing a duplicate).
+        """
+        task_id = task_id or uuid.uuid4().hex
         now = time.time()
         with self._lock, self._conn:
             self._conn.execute(
                 "INSERT INTO tasks (id, name, args, correlation_id, status, "
                 "max_retries, visible_at, created_at, updated_at) "
-                "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?) "
+                "ON CONFLICT(id) DO NOTHING",
                 (
                     task_id, name, json.dumps(args), correlation_id,
                     QUEUED, max_retries, now + countdown, now, now,
@@ -176,18 +183,45 @@ class SqliteBroker:
                 (DONE, time.time(), task_id),
             )
 
-    def nack(self, task_id: str, countdown: float, error: str = "") -> bool:
+    def nack(
+        self,
+        task_id: str,
+        countdown: float,
+        error: str = "",
+        expected_attempts: int | None = None,
+        claimed_by: str | None = None,
+    ) -> bool:
         """Failed attempt: requeue with backoff, or FAILED past max_retries.
 
-        Returns True when the task will be retried.
+        Returns True when the task will be retried. Two idempotency guards:
+
+        - ``claimed_by`` (the nacking worker's id): a worker whose claim
+          timed out and was redelivered to another worker must not requeue
+          a task that other worker currently holds (third delivery);
+        - ``expected_attempts`` (the count observed at claim time): a
+          duplicate of the SAME nack — a network client retrying after an
+          ambiguous failure — sees attempts already advanced.
+
+        Rejected duplicates report the task's liveness (True unless FAILED)
+        so callers don't mark the transaction FAILED over an in-flight or
+        finished attempt.
         """
         now = time.time()
         with self._lock, self._conn:
             row = self._conn.execute(
-                "SELECT attempts, max_retries FROM tasks WHERE id = ?", (task_id,)
+                "SELECT attempts, max_retries, status, claimed_by FROM tasks "
+                "WHERE id = ?",
+                (task_id,),
             ).fetchone()
             if row is None:
                 return False
+            if claimed_by is not None and row["claimed_by"] != claimed_by:
+                return row["status"] != FAILED
+            if (
+                expected_attempts is not None
+                and row["attempts"] != expected_attempts
+            ):
+                return row["status"] != FAILED
             attempts = row["attempts"] + 1
             if attempts > row["max_retries"]:
                 self._conn.execute(
@@ -260,6 +294,23 @@ class SqliteBroker:
         )
         with self._lock, self._conn:
             self._conn.executemany(sql, [[r[c] for c in cols] for r in rows])
+
+    def replace_rows(self, rows: list[dict]) -> None:
+        """Snapshot application: make local state exactly the primary's.
+
+        Unlike :meth:`apply_rows` (incremental upsert), this also deletes
+        rows the primary doesn't have — discarding writes a demoted
+        ex-primary accepted while partitioned (the split-brain resync path).
+        """
+        with self._lock, self._conn:
+            self._conn.execute("DELETE FROM tasks")
+            if rows:
+                cols = list(rows[0].keys())
+                self._conn.executemany(
+                    f"INSERT OR REPLACE INTO tasks ({','.join(cols)}) "
+                    f"VALUES ({','.join('?' * len(cols))})",
+                    [[r[c] for c in cols] for r in rows],
+                )
 
 
 def Broker(url: str | None = None):

@@ -12,6 +12,7 @@ protocol carrying both the queue and the results store.
 
 from __future__ import annotations
 
+import hmac
 import json
 import socket
 import struct
@@ -60,3 +61,27 @@ def recv_frame(sock: socket.socket) -> Any | None:
 def parse_hostport(s: str, default_port: int) -> tuple[str, int]:
     host, _, port = s.partition(":")
     return host or "127.0.0.1", int(port) if port else default_port
+
+
+# -- shared-secret auth (one implementation for every tier) ------------------
+
+AUTH_REJECTION = {"ok": False, "kind": "auth", "error": "authentication failed"}
+
+
+def attach_auth(req: dict, token: str) -> dict:
+    """Attach the shared secret to an outgoing request frame (no-op when
+    unconfigured)."""
+    if token:
+        req["auth"] = token
+    return req
+
+
+def check_auth(req: dict, token: str) -> bool:
+    """Pop and verify the frame's credential (constant-time). True when the
+    server has no token configured or the frame's token matches."""
+    tok = req.pop("auth", None)
+    if not token:
+        return True
+    return isinstance(tok, str) and hmac.compare_digest(
+        tok.encode(), token.encode()
+    )

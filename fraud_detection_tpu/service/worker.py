@@ -33,7 +33,11 @@ import numpy as np
 from fraud_detection_tpu import config
 from fraud_detection_tpu.service import metrics
 from fraud_detection_tpu.service.db import ResultsDB
-from fraud_detection_tpu.service.errors import DatabaseError
+from fraud_detection_tpu.service.errors import (
+    DatabaseError,
+    StoreAuthError,
+    StoreError,
+)
 from fraud_detection_tpu.service.loading import load_production_model
 from fraud_detection_tpu.service.taskq import Broker, Task
 from fraud_detection_tpu.service.tracing import setup_tracing, span
@@ -155,7 +159,14 @@ class XaiWorker:
             return
         is_db = isinstance(err, (sqlite3.Error, DatabaseError))
         countdown = DB_RETRY_COUNTDOWN if is_db else OTHER_RETRY_COUNTDOWN
-        will_retry = self.broker.nack(task.id, countdown, str(err))
+        # expected_attempts = the count observed at claim time (duplicate
+        # network retries can't double-increment toward FAILED); claimed_by
+        # = our id (a timed-out claim redelivered to another worker can't be
+        # requeued out from under it).
+        will_retry = self.broker.nack(
+            task.id, countdown, str(err),
+            expected_attempts=task.attempts, claimed_by=self.worker_id,
+        )
         metrics.xai_task_failures.inc()
         if will_retry:
             log.warning(
@@ -238,9 +249,26 @@ class XaiWorker:
             self.max_batch = max_batch
         self.warmup()
         log.info("worker %s consuming (broker %s)", self.worker_id, self.broker.url)
+        outage_backoff = max(5 * self.poll_interval, 1.0)
         while not self._stop.is_set():
-            metrics.queue_depth.set(self.broker.depth())
-            if not self.run_batch(max_batch):
+            # A store outage longer than the client's retry budget (e.g. a
+            # primary death while the sentinels are still deciding) must NOT
+            # crash the worker: acks_late means any claimed-but-unsettled
+            # task is redelivered after its visibility timeout, so the only
+            # correct response is to back off and poll again.
+            try:
+                metrics.queue_depth.set(self.broker.depth())
+                handled = self.run_batch(max_batch)
+            except StoreAuthError:
+                raise  # misconfigured credentials: crash loudly, don't spin
+            except (sqlite3.Error, StoreError) as e:
+                log.warning(
+                    "broker/store unavailable (%s); retrying in %.1fs",
+                    e, outage_backoff,
+                )
+                self._stop.wait(outage_backoff)
+                continue
+            if not handled:
                 self._stop.wait(self.poll_interval)
 
     def stop(self) -> None:

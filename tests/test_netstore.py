@@ -366,3 +366,191 @@ def test_predict_stays_up_with_dead_broker(tmp_path, monkeypatch):
         r = client.post("/predict", json={"features": [0.1] * 30})
         assert r.status_code == 200
         assert r.json()["explanation_status"] == "Queue failed"
+
+
+# ---------------------------------------------------------------------------
+# round-3 hardening: auth, split-brain demotion, idempotent retries, worker
+# resilience through a store outage (ADVICE r2 findings)
+# ---------------------------------------------------------------------------
+
+def test_auth_rejects_unauthenticated_and_accepts_token(tmp_path, monkeypatch):
+    from fraud_detection_tpu.service.errors import StoreAuthError
+
+    srv = StoreServer(str(tmp_path / "a"), port=0, auth_token="s3cret")
+    srv.start()
+    try:
+        # no token configured client-side → auth error, fails fast (no retry)
+        monkeypatch.delenv("FRAUD_STORE_TOKEN", raising=False)
+        bad = ResultsDB(f"fraud://127.0.0.1:{srv.port}")
+        t0 = time.time()
+        with pytest.raises(StoreAuthError, match="auth"):
+            bad.get("x")
+        assert time.time() - t0 < 2.0, "auth failure must not burn the retry budget"
+        # correct token → works
+        monkeypatch.setenv("FRAUD_STORE_TOKEN", "s3cret")
+        good = ResultsDB(f"fraud://127.0.0.1:{srv.port}")
+        assert good.ping()
+        tx = good.create_pending(None, {"a": 1.0}, None)
+        assert good.get(tx)["status"] == "PENDING"
+    finally:
+        srv.stop()
+
+
+def test_replica_auth_and_replication_with_token(tmp_path, monkeypatch):
+    monkeypatch.setenv("FRAUD_STORE_TOKEN", "tok")
+    p = StoreServer(str(tmp_path / "p"), port=0, auth_token="tok")
+    p.start()
+    r = StoreServer(
+        str(tmp_path / "r"), port=0,
+        replicate_from=f"127.0.0.1:{p.port}", auth_token="tok",
+    )
+    r.start()
+    try:
+        db = ResultsDB(f"fraud://127.0.0.1:{p.port}")
+        db.create_pending("tx1", {"a": 1.0}, None)
+        assert _wait(lambda: r.db.get("tx1") is not None)
+    finally:
+        r.stop()
+        p.stop()
+
+
+def test_sentinel_demotes_rejoining_stale_primary(tmp_path):
+    """Split-brain recovery: after a failover, a healed old primary is
+    actively demoted (role → replica of the elected primary) and its
+    partitioned writes are discarded by the snapshot-replace resync —
+    the Redis-Sentinel 'reconfigure rejoining master as slave' semantics
+    the r2 advisor found missing."""
+    p1 = StoreServer(str(tmp_path / "p1"), port=0)
+    p1.start()
+    p2 = StoreServer(
+        str(tmp_path / "p2"), port=0, replicate_from=f"127.0.0.1:{p1.port}"
+    )
+    p2.start()
+    s = Sentinel(
+        "m1",
+        stores=[("127.0.0.1", p1.port), ("127.0.0.1", p2.port)],
+        quorum=1, down_after=0.5, poll_interval=0.1,
+    )
+    s.start()
+    old_port = p1.port
+    try:
+        assert _wait(lambda: s.master == ("127.0.0.1", p1.port))
+        db = ResultsDB(f"fraud://127.0.0.1:{p1.port}")
+        db.create_pending("pre", {"a": 1.0}, None)
+        assert _wait(lambda: p2.db.get("pre") is not None)
+        p1.stop()
+        assert _wait(lambda: p2.role == "primary", timeout=15.0)
+
+        # old primary comes back (same data dir, same port, still thinks
+        # it's primary) carrying a write accepted while partitioned
+        back = StoreServer(str(tmp_path / "p1"), host="127.0.0.1", port=old_port)
+        back.db.create_pending("partitioned-write", {"x": 9.0}, None)
+        back.start()
+        try:
+            s.stores = [("127.0.0.1", old_port), ("127.0.0.1", p2.port)]
+            assert _wait(lambda: back.role == "replica", timeout=15.0), (
+                "sentinel never demoted the stale primary"
+            )
+            assert back.replicate_from == f"127.0.0.1:{p2.port}"
+            # resync replaced local state: the split-brain write is gone,
+            # the elected primary's row is present
+            assert _wait(lambda: back.db.get("partitioned-write") is None)
+            assert _wait(lambda: back.db.get("pre") is not None)
+        finally:
+            back.stop()
+    finally:
+        s.stop()
+        p2.stop()
+        if p1._listener is not None:
+            p1.stop()
+
+
+def test_nack_with_expected_attempts_is_idempotent(tmp_path):
+    from fraud_detection_tpu.service.taskq import SqliteBroker
+
+    b = SqliteBroker(f"sqlite:///{tmp_path}/q.db")
+    tid = b.send_task("t", [], max_retries=2)
+    task = b.claim("w")
+    assert task.attempts == 0
+    assert b.nack(tid, 0.0, "e", expected_attempts=0) is True
+    # duplicate delivery of the same nack: no double-increment
+    assert b.nack(tid, 0.0, "e", expected_attempts=0) is True
+    with b._lock:
+        row = b._conn.execute(
+            "SELECT attempts FROM tasks WHERE id = ?", (tid,)
+        ).fetchone()
+    assert row["attempts"] == 1
+
+
+def test_send_task_with_client_id_is_idempotent(primary):
+    q = Broker(f"fraud://127.0.0.1:{primary.port}")
+    tid = "fixed-id-123"
+    assert q.send_task("t", [1], task_id=tid) == tid
+    assert q.send_task("t", [1], task_id=tid) == tid  # ambiguous-retry replay
+    assert q.depth() == 1
+
+
+def test_worker_survives_store_outage_and_resumes(tmp_path, monkeypatch):
+    """A store outage longer than the client retry budget must not crash
+    run_forever: the worker backs off and resumes consuming when the store
+    returns (ADVICE r2: 'during a real failover every worker process
+    crashes')."""
+    import threading
+
+    import numpy as np
+
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+    from fraud_detection_tpu.ops.logistic import LogisticParams
+    from fraud_detection_tpu.ops.scaler import scaler_fit
+    from fraud_detection_tpu.service.worker import XaiWorker
+
+    rng = np.random.default_rng(0)
+    d = 30
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    model_dir = str(tmp_path / "models")
+    FraudLogisticModel(
+        LogisticParams(
+            coef=rng.standard_normal(d).astype(np.float32),
+            intercept=np.float32(0.0),
+        ),
+        scaler_fit(rng.standard_normal((50, d)).astype(np.float32)),
+        names,
+    ).save(model_dir, joblib_too=False)
+    monkeypatch.setenv("MODEL_PATH", os.path.join(model_dir, "logistic_model.joblib"))
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    # shrink the client retry budget so the outage outlives it quickly
+    monkeypatch.setattr(
+        "fraud_detection_tpu.service.netclient.RETRIES", 2, raising=True
+    )
+
+    srv = StoreServer(str(tmp_path / "s"), port=0)
+    srv.start()
+    port = srv.port
+    url = f"fraud://127.0.0.1:{port}"
+    w = XaiWorker(broker_url=url, database_url=url, poll_interval=0.05)
+    t = threading.Thread(target=w.run_forever, daemon=True)
+    t.start()
+    try:
+        srv.stop()          # outage begins; client retries exhaust
+        time.sleep(1.5)     # long enough for several failed poll cycles
+        assert t.is_alive(), "worker crashed during store outage"
+        srv2 = StoreServer(str(tmp_path / "s"), host="127.0.0.1", port=port)
+        srv2.start()
+        try:
+            db = ResultsDB(url)
+            q = Broker(url)
+            feats = {n: 0.1 for n in names}
+            db.create_pending("tx-after", feats, None)
+            q.send_task("xai_tasks.compute_shap", ["tx-after", feats, None])
+            assert _wait(
+                lambda: (db.get("tx-after") or {}).get("status") == "COMPLETED",
+                timeout=30.0,
+            ), "worker did not resume consuming after the store returned"
+        finally:
+            w.stop()
+            t.join(timeout=10)
+            srv2.stop()
+    finally:
+        if t.is_alive():
+            w.stop()
+            t.join(timeout=10)
