@@ -36,11 +36,13 @@ RUN pip install --no-cache-dir -U pip \
 COPY frontend ./frontend
 COPY models ./models
 
-# Non-root runtime user (reference Dockerfile:13-16 pattern). /data must be
-# created and owned here: fresh volumes inherit the image mountpoint's
-# ownership, and the sqlite DBs live there.
+# Non-root runtime user (reference Dockerfile:13-16 pattern). /data and
+# /var/lib/fraudstore must be created and owned here: fresh volumes inherit
+# the image mountpoint's ownership, and the sqlite DBs (service tier) and
+# store-server data dirs live there respectively.
 RUN useradd --create-home appuser && chown -R appuser /app \
-    && mkdir -p /data && chown appuser /data
+    && mkdir -p /data /var/lib/fraudstore \
+    && chown appuser /data /var/lib/fraudstore
 USER appuser
 
 ENV PYTHONUNBUFFERED=1 \

@@ -12,3 +12,13 @@ app.kubernetes.io/instance: {{ .Release.Name }}
 app.kubernetes.io/version: {{ .Chart.AppVersion }}
 app.kubernetes.io/managed-by: {{ .Release.Service }}
 {{- end -}}
+
+{{/* sentinel:// URL listing every sentinel pod's stable DNS name */}}
+{{- define "fraud.sentinelUrl" -}}
+{{- $fn := include "fraud.fullname" . -}}
+{{- $parts := list -}}
+{{- range $i := until (int .Values.sentinel.replicas) -}}
+{{- $parts = append $parts (printf "%s-sentinel-%d.%s-sentinel:26379" $fn $i $fn) -}}
+{{- end -}}
+sentinel://{{ join "," $parts }}/{{ .Values.store.masterName }}
+{{- end -}}

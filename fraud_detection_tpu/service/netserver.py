@@ -434,11 +434,42 @@ def main() -> None:
         "--auth-token", default=None,
         help="shared secret (default: FRAUD_STORE_TOKEN env)",
     )
+    ap.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="Prometheus exporter port (0 = off). Exposes queue depth and "
+        "role — the KEDA scaling signal must come from the store, not from "
+        "workers that scale to zero.",
+    )
     args = ap.parse_args()
-    StoreServer(
+    srv = StoreServer(
         args.data_dir, args.host, args.port,
         replicate_from=args.replicate_from, auth_token=args.auth_token,
-    ).serve_forever()
+    )
+    if args.metrics_port:
+        from prometheus_client import CollectorRegistry, Gauge, start_http_server
+
+        registry = CollectorRegistry()
+        depth = Gauge(
+            "fraud_store_queue_depth",
+            "Deliverable task backlog on this store server (KEDA signal)",
+            registry=registry,
+        )
+        depth.set_function(srv.broker.depth)
+        is_primary = Gauge(
+            "fraud_store_is_primary",
+            "1 when this server is the writable primary",
+            registry=registry,
+        )
+        is_primary.set_function(lambda: float(srv.role == PRIMARY))
+        seq = Gauge(
+            "fraud_store_replication_seq",
+            "Replication sequence number (replica lag = primary - replica)",
+            registry=registry,
+        )
+        seq.set_function(lambda: float(srv.seq))
+        start_http_server(args.metrics_port, registry=registry)
+        log.info("store metrics on :%d", args.metrics_port)
+    srv.serve_forever()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,175 @@
+"""Deployment artifacts deploy the store tier they claim to.
+
+Round-2 verdict: the HA store tier existed in code but compose/k8s/Helm all
+still pointed at single-host SQLite files — "built, tested, deployed
+nowhere". These tests pin every deployment surface to the sentinel://
+topology so the drift can't silently return. (No helm binary in the image,
+so chart checks parse values.yaml and statically cross-reference the
+``.Values.*`` paths used by the templates.)
+"""
+
+import glob
+import os
+import re
+
+import yaml
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(path):
+    with open(os.path.join(ROOT, path)) as f:
+        return yaml.safe_load(f)
+
+
+def _load_all(path):
+    with open(os.path.join(ROOT, path)) as f:
+        return list(yaml.safe_load_all(f))
+
+
+# ---------------------------------------------------------------------------
+# docker-compose
+# ---------------------------------------------------------------------------
+
+def test_compose_runs_the_store_tier():
+    compose = _load("docker-compose.yml")
+    services = compose["services"]
+    for name in ("store-primary", "store-replica", "store-sentinel"):
+        assert name in services, f"compose must run {name}"
+    # replica replicates from the primary by service name
+    replica_cmd = " ".join(services["store-replica"]["command"])
+    assert "--replicate-from store-primary:7600" in replica_cmd
+    # sentinel monitors both stores by service name
+    sentinel_cmd = " ".join(services["store-sentinel"]["command"])
+    assert "store-primary:7600,store-replica:7600" in sentinel_cmd
+
+
+def test_compose_api_and_worker_use_sentinel_urls():
+    services = _load("docker-compose.yml")["services"]
+    for svc in ("api", "xai-worker"):
+        env = services[svc]["environment"]
+        assert env["DATABASE_URL"].startswith("sentinel://store-sentinel"), (
+            f"{svc} DATABASE_URL must go through the sentinel, got "
+            f"{env['DATABASE_URL']!r}"
+        )
+        assert env["CELERY_BROKER_URL"].startswith("sentinel://store-sentinel")
+        assert "FRAUD_STORE_TOKEN" in env, f"{svc} must authenticate to the store"
+
+
+def test_compose_store_metrics_scraped():
+    services = _load("docker-compose.yml")["services"]
+    for name in ("store-primary", "store-replica"):
+        assert "--metrics-port" in services[name]["command"], (
+            f"{name} must export the KEDA queue-depth signal"
+        )
+    prom = _load("monitoring/prometheus.yml")
+    targets = [
+        t for job in prom["scrape_configs"]
+        for sc in job.get("static_configs", [])
+        for t in sc["targets"]
+    ]
+    assert "store-primary:7900" in targets and "store-replica:7900" in targets
+
+
+# ---------------------------------------------------------------------------
+# raw k8s manifests
+# ---------------------------------------------------------------------------
+
+def test_k8s_store_statefulsets_exist():
+    docs = _load_all("k8s/store-statefulset.yaml")
+    kinds = {(d["kind"], d["metadata"]["name"]) for d in docs}
+    assert ("StatefulSet", "fraud-store") in kinds
+    assert ("StatefulSet", "fraud-sentinel") in kinds
+    # headless services give pods the stable DNS names the URLs reference
+    for d in docs:
+        if d["kind"] == "Service":
+            assert d["spec"].get("clusterIP") is None or d["spec"]["clusterIP"] == "None"
+
+
+def test_k8s_secret_routes_through_sentinels():
+    secret = _load("k8s/secret.yaml")["stringData"]
+    for key in ("DATABASE_URL", "CELERY_BROKER_URL"):
+        assert secret[key].startswith("sentinel://"), (
+            f"k8s secret {key} still bypasses the store tier: {secret[key]!r}"
+        )
+        # every sentinel endpoint must resolve to the headless-service DNS
+        # names the sentinel StatefulSet actually creates
+        hosts = secret[key][len("sentinel://"):].split("/")[0].split(",")
+        for h in hosts:
+            assert re.match(r"fraud-sentinel-\d\.fraud-sentinel:26379", h), h
+    assert "FRAUD_STORE_TOKEN" in secret
+
+
+def test_k8s_keda_signal_comes_from_store_tier():
+    so = _load("k8s/xai-worker-scaledobject.yaml")
+    assert so["spec"]["minReplicaCount"] == 0  # reference scale-to-zero
+    trigger = so["spec"]["triggers"][0]["metadata"]
+    assert "fraud_store_queue_depth" in trigger["query"], (
+        "scale-to-zero needs the depth gauge from the always-up store tier, "
+        "not from workers that may all be scaled away"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Helm chart (static: no helm binary in the image)
+# ---------------------------------------------------------------------------
+
+def _values():
+    return _load("charts/fraud-detection-tpu/values.yaml")
+
+
+def test_helm_values_enable_store_tier():
+    v = _values()
+    assert v["store"]["enabled"] is True
+    assert v["store"]["replicas"] >= 2
+    assert v["sentinel"]["replicas"] >= 3
+    assert v["sentinel"]["quorum"] >= 2
+    assert v["env"]["REQUIRE_REGISTRY_MODEL"] == "1", (
+        "chart default must not silently serve the baked-in demo model"
+    )
+
+
+def test_helm_templates_reference_only_defined_values():
+    """Every .Values.a.b.c used in a template resolves in values.yaml —
+    the static analogue of `helm template` catching a typo'd value path."""
+    v = _values()
+    pattern = re.compile(r"\.Values\.([A-Za-z0-9_.]+)")
+    for path in glob.glob(
+        os.path.join(ROOT, "charts/fraud-detection-tpu/templates/*.yaml")
+    ):
+        text = open(path).read()
+        for ref in pattern.findall(text):
+            node = v
+            for part in ref.split("."):
+                assert isinstance(node, dict) and part in node, (
+                    f"{os.path.basename(path)} references .Values.{ref} "
+                    f"which is not defined in values.yaml"
+                )
+                node = node[part]
+
+
+def test_helm_store_template_guards_and_secret_override():
+    tpl = open(os.path.join(
+        ROOT, "charts/fraud-detection-tpu/templates/store-statefulset.yaml"
+    )).read()
+    assert tpl.startswith("{{- if .Values.store.enabled }}")
+    secret = open(os.path.join(
+        ROOT, "charts/fraud-detection-tpu/templates/secret.yaml"
+    )).read()
+    assert "fraud.sentinelUrl" in secret and "FRAUD_STORE_TOKEN" in secret
+    helpers = open(os.path.join(
+        ROOT, "charts/fraud-detection-tpu/templates/_helpers.tpl"
+    )).read()
+    assert "sentinel://" in helpers
+
+
+def test_env_file_defaults_to_store_tier():
+    env = {}
+    for line in open(os.path.join(ROOT, ".env")):
+        line = line.strip()
+        if line and not line.startswith("#") and "=" in line:
+            k, _, val = line.partition("=")
+            env[k] = val
+    assert env["DATABASE_URL"].startswith("sentinel://")
+    assert env["CELERY_BROKER_URL"].startswith("sentinel://")
+    assert "FRAUD_STORE_TOKEN" in env
