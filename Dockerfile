@@ -41,8 +41,8 @@ COPY models ./models
 # the image mountpoint's ownership, and the sqlite DBs (service tier) and
 # store-server data dirs live there respectively.
 RUN useradd --create-home appuser && chown -R appuser /app \
-    && mkdir -p /data /var/lib/fraudstore \
-    && chown appuser /data /var/lib/fraudstore
+    && mkdir -p /data /var/lib/fraudstore /var/lib/fraudtracking \
+    && chown appuser /data /var/lib/fraudstore /var/lib/fraudtracking
 USER appuser
 
 ENV PYTHONUNBUFFERED=1 \

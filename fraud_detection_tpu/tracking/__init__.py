@@ -6,11 +6,33 @@ with params/metrics/artifacts at train_model.py:117-150, alias-based registry
 serving ``models:/{name}@{stage}`` at api/app.py:34-44, and the AUC-gated
 registration at train_model.py:152-163).
 
-The store layout lives under the ``MLFLOW_TRACKING_URI`` path (``file:``
-URIs), so the env-var contract is unchanged. When the real mlflow package is
-installed, :func:`fraud_detection_tpu.tracking.mlflow_bridge.maybe_mirror`
-mirrors runs to it; the native store remains the source of truth.
+``MLFLOW_TRACKING_URI`` selects the transport, so the env-var contract is
+unchanged:
+
+- ``file:./mlruns`` (or a bare path) — direct filesystem store (store.py);
+- ``http://host:5000`` — the shared tracking server (server.py /
+  http_client.py), the reference's MLflow-service topology where trainer,
+  API, and workers share one registry with no shared volume.
+
+When the real mlflow package is installed,
+:func:`fraud_detection_tpu.tracking.mlflow_bridge.maybe_mirror` mirrors runs
+to it; the native store remains the source of truth.
 """
 
-from fraud_detection_tpu.tracking.store import Run, TrackingClient  # noqa: F401
+from fraud_detection_tpu.tracking.store import Run  # noqa: F401
+from fraud_detection_tpu.tracking.store import TrackingClient as FileTrackingClient  # noqa: F401
 from fraud_detection_tpu.tracking.registry import ModelRegistry  # noqa: F401
+
+
+def TrackingClient(uri: str | None = None):
+    """Open a tracking client for ``uri`` (default ``MLFLOW_TRACKING_URI``).
+    Scheme dispatch: ``http(s)://`` → HTTP client against the tracking
+    server; anything else → the file store."""
+    from fraud_detection_tpu import config
+
+    uri = uri or config.tracking_uri()
+    if uri.startswith(("http://", "https://")):
+        from fraud_detection_tpu.tracking.http_client import HttpTrackingClient
+
+        return HttpTrackingClient(uri)
+    return FileTrackingClient(uri)

@@ -1,0 +1,301 @@
+"""HTTP tracking/registry client.
+
+Mirrors the surface of the file-based :class:`~fraud_detection_tpu.tracking.
+store.TrackingClient` / :class:`~fraud_detection_tpu.tracking.registry.
+ModelRegistry` over the tracking server (tracking/server.py), selected by
+``MLFLOW_TRACKING_URI=http://host:5000`` — the MLflow-client role
+(reference train_model.py:117-163, api/app.py:30-44) with a shared server
+instead of a shared filesystem.
+
+Differences from the file client, by construction:
+
+- ``Run.artifact_path`` returns a LOCAL staging path; staged files upload
+  to the server when the run ends (one PUT per file). The trainer's
+  "write artifacts, then register the dir" flow is unchanged.
+- ``registry.register*`` uploads the artifact directory as one gzipped tar;
+  ``registry.resolve`` downloads the version bundle into a local cache
+  (``FRAUD_REGISTRY_CACHE`` or ``~/.cache/fraud-detection-tpu/registry``)
+  and returns that path, so model loading stays a local-directory read.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import urllib.error
+import urllib.request
+from typing import Any
+
+from fraud_detection_tpu.tracking.registry import _MODEL_URI
+
+TIMEOUT = 30.0
+
+
+class TrackingHTTPError(OSError):
+    pass
+
+
+def _call(
+    method: str,
+    url: str,
+    body: bytes | None = None,
+    headers: dict[str, str] | None = None,
+) -> bytes:
+    req = urllib.request.Request(url, data=body, method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=TIMEOUT) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors="replace")[:500]
+        raise TrackingHTTPError(
+            f"{method} {url} -> {e.code}: {detail}"
+        ) from e
+    except urllib.error.URLError as e:
+        raise TrackingHTTPError(f"{method} {url} failed: {e.reason}") from e
+
+
+def _call_json(method: str, url: str, obj: Any = None, **kw) -> Any:
+    body = None if obj is None else json.dumps(obj).encode()
+    return json.loads(_call(method, url, body, **kw) or b"null")
+
+
+class HttpRun:
+    """Active run on a remote tracking server (context-manager like
+    store.Run; ends FAILED on exception)."""
+
+    def __init__(self, base: str, experiment: str, run_id: str):
+        self.base = base
+        self.experiment = experiment
+        self.run_id = run_id
+        self._staging = tempfile.mkdtemp(prefix="fraud-run-artifacts-")
+
+    @property
+    def _url(self) -> str:
+        return f"{self.base}/api/experiments/{self.experiment}/runs/{self.run_id}"
+
+    def log_param(self, key: str, value) -> None:
+        _call_json("POST", f"{self._url}/params", {key: str(value)})
+
+    def log_params(self, params: dict) -> None:
+        _call_json("POST", f"{self._url}/params", {k: str(v) for k, v in params.items()})
+
+    def log_metric(self, key: str, value: float, step: int | None = None) -> None:
+        _call_json(
+            "POST", f"{self._url}/metrics",
+            [{"key": key, "value": float(value), "step": step}],
+        )
+
+    def log_metrics(self, metrics: dict, step: int | None = None) -> None:
+        _call_json(
+            "POST", f"{self._url}/metrics",
+            [{"key": k, "value": float(v), "step": step} for k, v in metrics.items()],
+        )
+
+    def set_tag(self, key: str, value) -> None:
+        _call_json("POST", f"{self._url}/tags", {key: str(value)})
+
+    # -- artifacts (staged locally, shipped at end) -------------------------
+    @property
+    def artifacts_dir(self) -> str:
+        return self._staging
+
+    def artifact_path(self, *parts: str) -> str:
+        p = os.path.join(self._staging, *parts)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        return p
+
+    def log_artifact(self, local_path: str, artifact_subdir: str = "") -> str:
+        dest_dir = os.path.join(self._staging, artifact_subdir)
+        os.makedirs(dest_dir, exist_ok=True)
+        dest = os.path.join(dest_dir, os.path.basename(local_path))
+        shutil.copy2(local_path, dest)
+        return dest
+
+    def _upload_staged(self) -> None:
+        for root, _dirs, files in os.walk(self._staging):
+            for fn in files:
+                full = os.path.join(root, fn)
+                rel = os.path.relpath(full, self._staging)
+                with open(full, "rb") as f:
+                    _call(
+                        "PUT", f"{self._url}/artifact", f.read(),
+                        headers={"x-artifact-path": rel},
+                    )
+
+    # -- reads (round-trip through the server) ------------------------------
+    def _fetch(self) -> dict:
+        return _call_json("GET", self._url)
+
+    @property
+    def params(self) -> dict:
+        return self._fetch()["params"]
+
+    @property
+    def metrics(self) -> dict:
+        return self._fetch()["metrics"]
+
+    @property
+    def tags(self) -> dict:
+        return self._fetch()["tags"]
+
+    def latest_metric(self, key: str) -> float | None:
+        hist = self.metrics.get(key)
+        return hist[-1]["value"] if hist else None
+
+    def end(self, status: str = "FINISHED") -> None:
+        self._upload_staged()
+        _call_json("POST", f"{self._url}/end", {"status": status})
+        shutil.rmtree(self._staging, ignore_errors=True)
+
+    def __enter__(self) -> "HttpRun":
+        return self
+
+    def __exit__(self, exc_type, *_):
+        self.end("FAILED" if exc_type else "FINISHED")
+        return False
+
+
+class HttpModelRegistry:
+    def __init__(self, base: str):
+        self.base = base
+        cache_root = os.environ.get(
+            "FRAUD_REGISTRY_CACHE",
+            os.path.join(
+                os.path.expanduser("~"), ".cache", "fraud-detection-tpu", "registry"
+            ),
+        )
+        host_key = base.split("//", 1)[-1].replace(":", "_").replace("/", "_")
+        self.cache = os.path.join(cache_root, host_key)
+
+    def register(
+        self,
+        name: str,
+        artifact_dir: str,
+        run_id: str | None = None,
+        metrics: dict | None = None,
+    ) -> int:
+        from fraud_detection_tpu.tracking.server import tar_bytes
+
+        headers = {"x-metrics": json.dumps(metrics or {})}
+        if run_id:
+            headers["x-run-id"] = run_id
+        resp = json.loads(
+            _call(
+                "POST", f"{self.base}/api/registry/{name}/versions",
+                tar_bytes(artifact_dir), headers=headers,
+            )
+        )
+        return int(resp["version"])
+
+    def set_alias(self, name: str, alias: str, version: int) -> None:
+        _call_json(
+            "POST", f"{self.base}/api/registry/{name}/aliases",
+            {"alias": alias, "version": int(version)},
+        )
+
+    def get_version_by_alias(self, name: str, alias: str) -> int | None:
+        v = _call_json("GET", f"{self.base}/api/registry/{name}/aliases").get(alias)
+        return int(v) if v is not None else None
+
+    def latest_version(self, name: str) -> int | None:
+        v = _call_json("GET", f"{self.base}/api/registry/{name}/latest")["version"]
+        return int(v) if v is not None else None
+
+    def artifact_dir(self, name: str, version: int) -> str:
+        """Local cache path for a version, downloading it if absent."""
+        from fraud_detection_tpu.tracking.server import untar_bytes
+
+        dest = os.path.join(self.cache, name, str(version))
+        if os.path.isdir(dest) and os.listdir(dest):
+            return dest
+        data = _call("GET", f"{self.base}/api/registry/{name}/versions/{version}")
+        tmp = f"{dest}.tmp-{os.getpid()}"
+        untar_bytes(data, tmp)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        try:
+            os.replace(tmp, dest)  # atomic: concurrent loaders race safely
+        except OSError:
+            shutil.rmtree(tmp, ignore_errors=True)
+            if not os.path.isdir(dest):
+                raise
+        return dest
+
+    def resolve(self, model_uri: str) -> str:
+        """models:/ URI → local artifact directory (download-through cache).
+        Raises FileNotFoundError on unknown model/alias like the file
+        registry, so the serving fallback behaves identically."""
+        m = _MODEL_URI.match(model_uri)
+        if not m:
+            raise ValueError(f"not a models:/ URI: {model_uri}")
+        name = m.group("name")
+        try:
+            if m.group("version"):
+                version: int | None = int(m.group("version"))
+            elif m.group("alias"):
+                version = self.get_version_by_alias(name, m.group("alias"))
+            else:
+                version = self.latest_version(name)
+        except TrackingHTTPError as e:
+            raise FileNotFoundError(f"registry unreachable: {e}") from e
+        if version is None:
+            raise FileNotFoundError(f"no registered version for {model_uri}")
+        try:
+            return self.artifact_dir(name, version)
+        except TrackingHTTPError as e:
+            raise FileNotFoundError(str(e)) from e
+
+    def register_if_gate(
+        self,
+        name: str,
+        artifact_dir: str,
+        auc: float,
+        threshold: float,
+        alias: str | None = None,
+        run_id: str | None = None,
+    ) -> int | None:
+        """AUC promotion gate, same NaN-fails semantics as the file
+        registry (registry.py:107-125)."""
+        if not (auc >= threshold):
+            return None
+        version = self.register(name, artifact_dir, run_id, {"auc": auc})
+        if alias:
+            self.set_alias(name, alias, version)
+        return version
+
+
+class HttpTrackingClient:
+    def __init__(self, uri: str):
+        self.base = uri.rstrip("/")
+
+    def start_run(self, experiment: str | None = None) -> HttpRun:
+        from fraud_detection_tpu import config
+
+        exp = experiment or config.experiment_name()
+        resp = _call_json(
+            "POST", f"{self.base}/api/experiments/{exp}/runs", {}
+        )
+        return HttpRun(self.base, exp, resp["run_id"])
+
+    def get_run(self, experiment: str, run_id: str) -> HttpRun:
+        # existence check (404 → FileNotFoundError like the file client)
+        try:
+            _call_json(
+                "GET",
+                f"{self.base}/api/experiments/{experiment}/runs/{run_id}",
+            )
+        except TrackingHTTPError as e:
+            raise FileNotFoundError(str(e)) from e
+        return HttpRun(self.base, experiment, run_id)
+
+    def list_runs(self, experiment: str) -> list[str]:
+        return _call_json(
+            "GET", f"{self.base}/api/experiments/{experiment}/runs"
+        )["runs"]
+
+    @property
+    def registry(self) -> HttpModelRegistry:
+        return HttpModelRegistry(self.base)

@@ -1,0 +1,225 @@
+"""Tracking/registry HTTP server.
+
+Serves the file-based tracking store (store.py) and model registry
+(registry.py) over the in-house HTTP framework (service/http.py) — the role
+the reference fills with a shared MLflow server container
+(/root/reference/docker-compose.yml:114-128): one process that the trainer,
+the API pods, and the worker pods all talk to over the network, so the
+registry needs NO shared filesystem.
+
+``MLFLOW_TRACKING_URI=http://host:5000`` switches every client in this
+build to the HTTP transport (tracking/http_client.py); ``file:`` URIs keep
+the direct-filesystem store. Like the reference's MLflow service, the
+server is unauthenticated — deploy it on the service network, not the
+internet.
+
+API (JSON unless noted):
+
+- ``POST /api/experiments/{experiment}/runs``                → ``{run_id}``
+- ``POST .../runs/{run_id}/params|metrics|tags``             → merge/append
+- ``POST .../runs/{run_id}/end``                             → set status
+- ``GET  .../runs``                                          → ``{runs: [...]}``
+- ``GET  .../runs/{run_id}``                  → meta+params+metrics+tags
+- ``PUT  .../runs/{run_id}/artifact`` (raw body, relpath in
+  ``x-artifact-path`` header)                                → store a file
+- ``POST /api/registry/{name}/versions`` (gzipped tar body, optional
+  ``x-run-id``/``x-metrics`` headers)         → ``{version}``
+- ``GET  /api/registry/{name}/versions/{version}``  → gzipped tar of the
+  artifact dir (the client extracts into a local cache)
+- ``POST /api/registry/{name}/aliases``       → ``{alias, version}``
+- ``GET  /api/registry/{name}/aliases``       → alias map
+- ``GET  /api/registry/{name}/latest``        → ``{version | null}``
+- ``GET  /health``                            → liveness for compose/k8s
+
+Run: ``python -m fraud_detection_tpu.tracking.server --port 5000
+--root /var/lib/fraudtracking``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import logging
+import os
+import tarfile
+
+from fraud_detection_tpu.service.http import App, HTTPError, Request, Response
+from fraud_detection_tpu.tracking.registry import ModelRegistry
+from fraud_detection_tpu.tracking.store import Run, TrackingClient
+
+log = logging.getLogger("fraud_detection_tpu.tracking.server")
+
+MAX_BUNDLE = 256 << 20  # 256 MiB artifact bundle ceiling
+
+
+def _safe_members(tar: tarfile.TarFile):
+    """Reject path traversal (absolute paths, ..) in uploaded bundles."""
+    for m in tar.getmembers():
+        name = os.path.normpath(m.name)
+        if name.startswith(("/", "..")) or os.path.isabs(name):
+            raise HTTPError(400, f"unsafe path in bundle: {m.name!r}")
+        if not (m.isfile() or m.isdir()):
+            raise HTTPError(400, f"unsupported member type: {m.name!r}")
+        yield m
+
+
+def tar_bytes(directory: str) -> bytes:
+    """Gzipped tar of ``directory``'s contents (paths relative to it)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for root, _dirs, files in os.walk(directory):
+            for fn in sorted(files):
+                full = os.path.join(root, fn)
+                tar.add(full, arcname=os.path.relpath(full, directory))
+    return buf.getvalue()
+
+
+def untar_bytes(data: bytes, dest: str) -> None:
+    os.makedirs(dest, exist_ok=True)
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+        # filter="data" (3.12+) strips setuid/devices/links on top of our
+        # own path-traversal member check
+        tar.extractall(dest, members=_safe_members(tar), filter="data")
+
+
+def create_app(root: str) -> App:
+    store = TrackingClient(f"file:{root}")
+    registry = ModelRegistry(store.root)
+    app = App(title="fraud-tracking")
+
+    def _run(req: Request, create: bool = False) -> Run:
+        exp = req.path_params["experiment"]
+        run_id = req.path_params["run_id"]
+        try:
+            return Run(store.root, exp, run_id, create=create)
+        except FileNotFoundError as e:
+            raise HTTPError(404, str(e)) from e
+
+    @app.get("/health")
+    async def health(req: Request) -> Response:
+        return Response({"status": "healthy", "root": root})
+
+    # -- runs ---------------------------------------------------------------
+    @app.post("/api/experiments/{experiment}/runs")
+    async def create_run(req: Request) -> Response:
+        run = store.start_run(req.path_params["experiment"])
+        return Response({"run_id": run.run_id})
+
+    @app.get("/api/experiments/{experiment}/runs")
+    async def list_runs(req: Request) -> Response:
+        return Response({"runs": store.list_runs(req.path_params["experiment"])})
+
+    @app.get("/api/experiments/{experiment}/runs/{run_id}")
+    async def get_run(req: Request) -> Response:
+        run = _run(req)
+        meta = json.load(open(os.path.join(run.path, "meta.json")))
+        return Response(
+            {
+                "meta": meta,
+                "params": run.params,
+                "metrics": run.metrics,
+                "tags": run.tags,
+            }
+        )
+
+    @app.post("/api/experiments/{experiment}/runs/{run_id}/params")
+    async def log_params(req: Request) -> Response:
+        _run(req).log_params(req.json())
+        return Response({"ok": True})
+
+    @app.post("/api/experiments/{experiment}/runs/{run_id}/metrics")
+    async def log_metrics(req: Request) -> Response:
+        run = _run(req)
+        for m in req.json():
+            run.log_metric(m["key"], m["value"], m.get("step"))
+        return Response({"ok": True})
+
+    @app.post("/api/experiments/{experiment}/runs/{run_id}/tags")
+    async def set_tags(req: Request) -> Response:
+        run = _run(req)
+        for k, v in req.json().items():
+            run.set_tag(k, v)
+        return Response({"ok": True})
+
+    @app.post("/api/experiments/{experiment}/runs/{run_id}/end")
+    async def end_run(req: Request) -> Response:
+        _run(req).end((req.json() or {}).get("status", "FINISHED"))
+        return Response({"ok": True})
+
+    @app.route("PUT", "/api/experiments/{experiment}/runs/{run_id}/artifact")
+    async def put_artifact(req: Request) -> Response:
+        rel = req.headers.get("x-artifact-path", "")
+        norm = os.path.normpath(rel)
+        if not rel or norm.startswith(("/", "..")):
+            raise HTTPError(400, f"bad x-artifact-path {rel!r}")
+        run = _run(req)
+        dest = os.path.join(run.artifacts_dir, norm)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with open(dest, "wb") as f:
+            f.write(req.body)
+        return Response({"ok": True, "bytes": len(req.body)})
+
+    # -- registry -----------------------------------------------------------
+    @app.post("/api/registry/{name}/versions")
+    async def register_version(req: Request) -> Response:
+        if len(req.body) > MAX_BUNDLE:
+            raise HTTPError(413, "bundle too large")
+        import tempfile
+
+        metrics = json.loads(req.headers.get("x-metrics", "{}") or "{}")
+        with tempfile.TemporaryDirectory() as tmp:
+            untar_bytes(req.body, tmp)
+            version = registry.register(
+                req.path_params["name"], tmp,
+                run_id=req.headers.get("x-run-id"), metrics=metrics,
+            )
+        return Response({"version": version})
+
+    @app.get("/api/registry/{name}/versions/{version}")
+    async def get_version(req: Request) -> Response:
+        d = registry.artifact_dir(
+            req.path_params["name"], int(req.path_params["version"])
+        )
+        if not os.path.isdir(d):
+            raise HTTPError(404, f"no version {req.path_params['version']}")
+        return Response(tar_bytes(d), media_type="application/gzip")
+
+    @app.post("/api/registry/{name}/aliases")
+    async def set_alias(req: Request) -> Response:
+        body = req.json()
+        registry.set_alias(
+            req.path_params["name"], body["alias"], int(body["version"])
+        )
+        return Response({"ok": True})
+
+    @app.get("/api/registry/{name}/aliases")
+    async def get_aliases(req: Request) -> Response:
+        from fraud_detection_tpu.tracking.store import _read_json
+
+        return Response(
+            _read_json(registry._aliases_path(req.path_params["name"]), {})
+        )
+
+    @app.get("/api/registry/{name}/latest")
+    async def latest(req: Request) -> Response:
+        return Response({"version": registry.latest_version(req.path_params["name"])})
+
+    return app
+
+
+def main() -> None:
+    from fraud_detection_tpu.service.http import run
+
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=5000)
+    ap.add_argument("--root", default="./mlruns")
+    args = ap.parse_args()
+    log.info("tracking server on %s:%d (root %s)", args.host, args.port, args.root)
+    run(create_app(args.root), host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -70,3 +70,69 @@ def test_bf16_io_parity(rng):
 
     with pytest.raises(ValueError):
         BatchScorer(params, scaler, io_dtype="float16")
+
+
+def test_int8_io_parity(rng):
+    """int8 wire format: dequant scale folded into the weights gives scores
+    within quantization tolerance (~1e-2) of f32, with the identical device
+    kernel."""
+    from fraud_detection_tpu.ops.scaler import scaler_fit
+
+    d = 30
+    x = rng.standard_normal((512, d)).astype(np.float32) * 2.0 + 0.5
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32) * 0.3,
+        intercept=np.float32(-1.0),
+    )
+    sp = scaler_fit(x)
+    f32 = BatchScorer(params, sp).predict_proba(x)
+    q8 = BatchScorer(params, sp, io_dtype="int8").predict_proba(x)
+    assert q8.dtype == np.float32
+    np.testing.assert_allclose(q8, f32, atol=5e-2)
+    assert np.abs(q8 - f32).mean() < 1e-2
+
+
+def test_int8_requires_scaler(rng):
+    params = LogisticParams(
+        coef=rng.standard_normal(4).astype(np.float32), intercept=np.float32(0)
+    )
+    with pytest.raises(ValueError, match="calibration"):
+        BatchScorer(params, None, io_dtype="int8")
+
+
+def test_stream_matches_sync(rng):
+    """predict_proba_stream (overlapped h2d, single readback) returns exactly
+    what the synchronous per-batch path returns, across uneven chunking."""
+    from fraud_detection_tpu.ops.scaler import scaler_fit
+
+    d = 30
+    x = rng.standard_normal((1000, d)).astype(np.float32)
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32), intercept=np.float32(0.2)
+    )
+    sp = scaler_fit(x)
+    for io in ("float32", "bfloat16"):
+        s = BatchScorer(params, sp, io_dtype=io)
+        sync = s.predict_proba(x)
+        stream = s.predict_proba_stream(x, chunk=96, inflight=3)
+        assert stream.shape == (1000,)
+        np.testing.assert_allclose(stream, sync, rtol=1e-5, atol=1e-6)
+
+
+def test_stream_out_dtypes(rng):
+    """Narrow score wire formats decode to f32 within their quantization
+    tolerance (f16 ~1e-3, uint8 1/255)."""
+    from fraud_detection_tpu.ops.scaler import scaler_fit
+
+    d = 30
+    x = rng.standard_normal((777, d)).astype(np.float32)
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32), intercept=np.float32(-1)
+    )
+    s = BatchScorer(params, scaler_fit(x))
+    ref = s.predict_proba(x)
+    f16 = s.predict_proba_stream(x, chunk=128, out_dtype="float16")
+    u8 = s.predict_proba_stream(x, chunk=128, out_dtype="uint8")
+    assert f16.dtype == np.float32 and u8.dtype == np.float32
+    np.testing.assert_allclose(f16, ref, atol=2e-3)
+    np.testing.assert_allclose(u8, ref, atol=1.0 / 255 + 1e-6)

@@ -1,0 +1,155 @@
+"""Tracking/registry HTTP server: the shared-registry topology (reference's
+MLflow service, docker-compose.yml:114-128) — trainer, API, and worker share
+one registry over HTTP with no shared filesystem."""
+
+import asyncio
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.service.http import _handle_connection
+from fraud_detection_tpu.tracking import TrackingClient
+from fraud_detection_tpu.tracking.http_client import HttpTrackingClient
+from fraud_detection_tpu.tracking.server import create_app
+
+
+class _ThreadedServer:
+    """Run the asyncio HTTP server in a daemon thread, port 0."""
+
+    def __init__(self, app):
+        self.app = app
+        self.loop = asyncio.new_event_loop()
+        self.port = None
+        self._ready = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+
+        async def start():
+            self._server = await asyncio.start_server(
+                lambda r, w: _handle_connection(self.app, r, w), "127.0.0.1", 0
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+            self._ready.set()
+
+        self.loop.run_until_complete(start())
+        self.loop.run_forever()
+
+    def __enter__(self):
+        self._thread.start()
+        assert self._ready.wait(10), "server never came up"
+        return self
+
+    def __exit__(self, *exc):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=10)
+
+
+@pytest.fixture()
+def server(tmp_path):
+    with _ThreadedServer(create_app(str(tmp_path / "trackroot"))) as s:
+        yield s
+
+
+def test_uri_dispatch(tmp_path):
+    from fraud_detection_tpu.tracking.store import TrackingClient as FileClient
+
+    assert isinstance(TrackingClient(f"file:{tmp_path}"), FileClient)
+    assert isinstance(TrackingClient("http://localhost:1"), HttpTrackingClient)
+
+
+def test_run_lifecycle_over_http(server, tmp_path):
+    client = TrackingClient(f"http://127.0.0.1:{server.port}")
+    with client.start_run("exp1") as run:
+        run.log_params({"lr": 0.1, "solver": "lbfgs"})
+        run.log_metric("auc", 0.97, step=1)
+        run.log_metric("auc", 0.975, step=2)
+        run.set_tag("registered", "no")
+        with open(run.artifact_path("plots", "roc.txt"), "w") as f:
+            f.write("fake plot")
+        run_id = run.run_id
+    # reads round-trip through the server
+    reopened = client.get_run("exp1", run_id)
+    assert reopened.params == {"lr": "0.1", "solver": "lbfgs"}
+    assert reopened.latest_metric("auc") == pytest.approx(0.975)
+    assert reopened.tags == {"registered": "no"}
+    assert client.list_runs("exp1") == [run_id]
+    # artifact landed server-side (no shared volume with the client)
+    art = os.path.join(
+        str(tmp_path / "trackroot"), "experiments", "exp1", "runs",
+        run_id, "artifacts", "plots", "roc.txt",
+    )
+    assert open(art).read() == "fake plot"
+    with pytest.raises(FileNotFoundError):
+        client.get_run("exp1", "nope")
+
+
+def test_registry_gate_and_resolve_over_http(server, tmp_path, monkeypatch):
+    monkeypatch.setenv("FRAUD_REGISTRY_CACHE", str(tmp_path / "cache"))
+    client = TrackingClient(f"http://127.0.0.1:{server.port}")
+    art = tmp_path / "model"
+    os.makedirs(art / "sub")
+    (art / "model.npz").write_bytes(b"weights" * 100)
+    (art / "sub" / "names.json").write_text('["Time"]')
+
+    # below threshold: gate refuses
+    assert client.registry.register_if_gate("fraud", str(art), 0.5, 0.9) is None
+    assert client.registry.register_if_gate(
+        "fraud", str(art), 0.97, 0.9, alias="prod", run_id="r1"
+    ) == 1
+    # a DIFFERENT client (fresh cache) resolves through the server
+    resolved = client.registry.resolve("models:/fraud@prod")
+    assert open(os.path.join(resolved, "model.npz"), "rb").read() == b"weights" * 100
+    assert open(os.path.join(resolved, "sub", "names.json")).read() == '["Time"]'
+    # version bump + alias move
+    assert client.registry.register(
+        "fraud", str(art), metrics={"auc": 0.99}
+    ) == 2
+    client.registry.set_alias("fraud", "prod", 2)
+    assert client.registry.resolve("models:/fraud@prod").endswith(
+        os.path.join("fraud", "2")
+    )
+    assert client.registry.resolve("models:/fraud/1").endswith(
+        os.path.join("fraud", "1")
+    )
+    with pytest.raises(FileNotFoundError):
+        client.registry.resolve("models:/nope@prod")
+
+
+def test_serving_loads_model_from_http_registry(server, tmp_path, monkeypatch, rng):
+    """The no-shared-volume topology end-to-end: trainer registers over
+    HTTP; a 'pod' with only MLFLOW_TRACKING_URI=http://... serves it."""
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+    from fraud_detection_tpu.ops.logistic import LogisticParams
+    from fraud_detection_tpu.ops.scaler import scaler_fit
+    from fraud_detection_tpu.service.loading import load_production_model
+
+    d = 30
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    x = rng.standard_normal((64, d)).astype(np.float32)
+    model = FraudLogisticModel(
+        LogisticParams(
+            coef=rng.standard_normal(d).astype(np.float32),
+            intercept=np.float32(-1.0),
+        ),
+        scaler_fit(x),
+        names,
+    )
+    art = str(tmp_path / "trained-model")
+    model.save(art, joblib_too=False)
+
+    uri = f"http://127.0.0.1:{server.port}"
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", uri)
+    monkeypatch.setenv("FRAUD_REGISTRY_CACHE", str(tmp_path / "pod-cache"))
+    monkeypatch.setenv("REQUIRE_REGISTRY_MODEL", "1")  # no silent fallback
+    TrackingClient(uri).registry.register_if_gate(
+        "fraud", art, 0.97, 0.9, alias="prod"
+    )
+    loaded, source = load_production_model()
+    assert source.startswith("registry:models:/fraud@prod")
+    got = loaded.scorer.predict_proba(x[:8])
+    want = model.scorer.predict_proba(x[:8])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
