@@ -75,6 +75,7 @@ def _encode_val(v) -> bytes | None:
 
 
 _DOLLAR = re.compile(r"\$\d+")
+_BEGIN = re.compile(r"^\s*BEGIN\b", re.IGNORECASE)
 
 
 class PgEmulator:
@@ -230,6 +231,20 @@ class PgEmulator:
         return _msg(b"E", body)
 
     @staticmethod
+    def _pg_sql(sql: str) -> str:
+        """PG-semantics shim for transactions: sqlite's DEFERRED BEGIN errors
+        with "database is locked" when a read txn upgrades to write under a
+        concurrent writer (SQLITE_BUSY_SNAPSHOT bypasses busy_timeout), but
+        PostgreSQL just blocks on the row lock. BEGIN IMMEDIATE takes the
+        write lock up front, reproducing PG's writer-blocks-writer behavior
+        — this was the suite's long-standing unhandled-thread-exception
+        warning (two workers racing one broker). Keyword-only rewrite:
+        trailing statements/modifiers (e.g. a compound "BEGIN; UPDATE …")
+        must survive, and sqlite ignores the isolation modifiers it
+        doesn't know."""
+        return _BEGIN.sub("BEGIN IMMEDIATE", sql, count=1)
+
+    @staticmethod
     def _tag(sql: str, cur) -> str:
         head = sql.lstrip().split(None, 1)[0].upper() if sql.strip() else ""
         if head == "SELECT":
@@ -273,7 +288,7 @@ class PgEmulator:
             elif t == b"D":  # Describe → defer row description to Execute
                 sock.sendall(_msg(b"n"))
             elif t == b"E":  # Execute
-                sql = _DOLLAR.sub("?", stmt_sql)
+                sql = self._pg_sql(_DOLLAR.sub("?", stmt_sql))
                 try:
                     cur = db.execute(sql, params)
                     rows = cur.fetchall() if cur.description else []
@@ -309,7 +324,7 @@ class PgEmulator:
             elif t == b"S":  # Sync
                 sock.sendall(_msg(b"Z", b"I"))
             elif t == b"Q":  # simple query
-                sql = body[:-1].decode()
+                sql = self._pg_sql(body[:-1].decode())
                 try:
                     cur = db.execute(sql)
                     sock.sendall(_msg(b"C", self._tag(sql, cur).encode() + b"\x00"))

@@ -15,27 +15,32 @@ from fraud_detection_tpu.service.taskq import (
 )
 
 
-@pytest.fixture(params=["sqlite", "net"])
-def _srv(request, tmp_path):
-    """None for the sqlite backend, an in-process StoreServer for net —
-    every queue-semantics test runs against both."""
+@pytest.fixture(params=["sqlite", "net", "pg"])
+def _broker_url(request, tmp_path):
+    """A broker URL over every storage backend — sqlite files (single-host),
+    the network store server (multi-node), and postgresql:// through the
+    wire client (real PostgreSQL in CI via FRAUD_TEST_PG_DSN, the protocol
+    emulator elsewhere) — every queue-semantics test runs against all."""
     if request.param == "sqlite":
-        yield None
+        yield f"sqlite:///{tmp_path}/q.db"
+    elif request.param == "pg":
+        from tests.pg_backend import pg_dsn
+
+        with pg_dsn() as dsn:
+            yield dsn
     else:
         from fraud_detection_tpu.service.netserver import StoreServer
 
         srv = StoreServer(str(tmp_path / "store"), port=0)
         srv.start()
-        yield srv
+        yield f"fraud://127.0.0.1:{srv.port}"
         srv.stop()
 
 
 @pytest.fixture()
-def make_broker(_srv, tmp_path):
+def make_broker(_broker_url):
     def _make():
-        if _srv is None:
-            return Broker(f"sqlite:///{tmp_path}/q.db")
-        return Broker(f"fraud://127.0.0.1:{_srv.port}")
+        return Broker(_broker_url)
 
     return _make
 
