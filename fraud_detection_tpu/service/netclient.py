@@ -52,9 +52,10 @@ from fraud_detection_tpu.service.wire import (
 CONNECT_TIMEOUT = 3.0
 CALL_TIMEOUT = 15.0
 # Total attempts per call across reconnect/re-resolve. The backoff sum
-# (~7s with the 2s cap) must exceed the sentinel's down_after (3s default)
-# plus promotion time, so a call issued the instant the primary dies
-# survives into the post-failover world instead of crashing its caller.
+# (7 sleeps of 0.05·2^k capped at 2 s ≈ 5.2 s) must exceed the sentinel's
+# down_after (3 s default) plus promotion time, so a call issued the instant
+# the primary dies survives into the post-failover world instead of
+# crashing its caller.
 RETRIES = 8
 BACKOFF_BASE = 0.05  # seconds; doubles per attempt, capped at 2s
 BACKOFF_CAP = 2.0

@@ -24,6 +24,15 @@ Design:
   primary is sent ``demote`` by the sentinels (split-brain recovery): it
   becomes a replica of the elected primary and *replaces* its local state
   with the primary's snapshot, discarding partitioned writes.
+- **Durable role/epoch** (``state.json`` in the data dir): role, upstream,
+  and a failover epoch (bumped on every promote) survive restarts and are
+  honored OVER the ordinal/argv bootstrap — the Redis-Sentinel
+  config-rewrite analogue. Without it, a full tier restart after a
+  failover would resurrect stale pod-0 as primary and the snapshot resync
+  would permanently delete every post-failover write. Snapshots carry the
+  primary's epoch; a replica REFUSES snapshot-replace from a lower-epoch
+  upstream (a stale pre-failover primary) and keeps retrying until the
+  sentinels demote it.
 - **Auth**: when ``FRAUD_STORE_TOKEN`` is set, every frame must carry the
   shared secret (constant-time compare) — the credential-equivalent of the
   reference's Postgres password. The listener binds loopback by default;
@@ -61,6 +70,11 @@ log = logging.getLogger("fraud_detection_tpu.netserver")
 
 HEARTBEAT_INTERVAL = 1.0
 RESYNC_INTERVAL = 0.5
+# Per-subscriber replication buffer: a replica that stops draining (hung
+# process, dead TCP peer) would otherwise grow its queue without bound on
+# the primary. On overflow the subscriber is dropped; it reconnects and
+# resyncs from a fresh snapshot — same recovery as any disconnect.
+REPL_QUEUE_MAX = 1024
 
 PRIMARY = "primary"
 REPLICA = "replica"
@@ -76,6 +90,7 @@ class StoreServer:
         auth_token: str | None = None,
     ):
         os.makedirs(data_dir, exist_ok=True)
+        self.data_dir = data_dir
         self.db = SqliteResultsDB(f"sqlite:///{os.path.join(data_dir, 'results.db')}")
         self.broker = SqliteBroker(f"sqlite:///{os.path.join(data_dir, 'queue.db')}")
         self.host, self.port = host, port
@@ -83,6 +98,24 @@ class StoreServer:
         self.replicate_from = replicate_from
         self.auth_token = config.store_token() if auth_token is None else auth_token
         self.seq = 0
+        self.epoch = 0  # failover counter; bumped on every promote
+        st = self._load_state()
+        if st is not None:
+            # Durable role beats ordinal/argv bootstrap: after a failover,
+            # a restarted stale pod-0 must come back as a REPLICA of the
+            # promoted node, not as the primary its StatefulSet args say.
+            self.role = st.get("role", self.role)
+            self.epoch = int(st.get("epoch", 0))
+            self.seq = int(st.get("seq", 0))
+            if self.role == REPLICA:
+                self.replicate_from = st.get("replicate_from", self.replicate_from)
+            else:
+                self.replicate_from = None
+            log.info(
+                "restored durable state: role=%s upstream=%s epoch=%d seq=%d",
+                self.role, self.replicate_from, self.epoch, self.seq,
+            )
+        self._save_state()
         # Bumped on every role/upstream change (promote, demote/re-point):
         # a replica loop only applies frames while its spawn generation is
         # current, so a re-point or promote↔demote flap can't leave an old
@@ -93,11 +126,61 @@ class StoreServer:
         # publish an older row image with a newer seq (replica staleness).
         self._pub_lock = threading.RLock()
         self._subs: list[queue.Queue] = []
+        self._last_state_save = 0.0
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._listener: socket.socket | None = None
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
+
+    # -- durable state -----------------------------------------------------
+    def _state_path(self) -> str:
+        return os.path.join(self.data_dir, "state.json")
+
+    def _load_state(self) -> dict | None:
+        import json
+
+        try:
+            with open(self._state_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _save_state(self, fsync: bool = True) -> None:
+        """Atomically persist role/upstream/epoch/seq. Called on every role
+        transition (and epoch adoption), mirroring Redis Sentinel's config
+        rewrite — the restart bootstrap honors this file over argv.
+        ``fsync=False`` for the throttled seq refresh on the write path."""
+        import json
+
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "role": self.role,
+                    "replicate_from": self.replicate_from,
+                    "epoch": self.epoch,
+                    "seq": self.seq,
+                },
+                f,
+            )
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, self._state_path())
+
+    def _maybe_save_seq(self) -> None:
+        """Keep the durable seq within ~0.5 s of reality (call with
+        _pub_lock held). Without this, a crash-restarted node restores the
+        seq last written at its previous role transition — possibly 0 —
+        and the sentinel's (epoch, seq) election can crown a LESS caught-up
+        replica over it, snapshot-replacing away rows only the stale-seq
+        node had. Sub-second staleness is on par with async replication
+        lag; total staleness was the bug."""
+        now = time.monotonic()
+        if now - self._last_state_save >= 0.5:
+            self._last_state_save = now
+            self._save_state(fsync=False)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -119,6 +202,10 @@ class StoreServer:
 
     def stop(self) -> None:
         self._stop.set()
+        try:
+            self._save_state()  # carry seq across clean restarts
+        except OSError:
+            pass
         if self._listener is not None:
             # shutdown() wakes the thread blocked in accept(); close() alone
             # leaves the open file description (and the LISTEN port) alive
@@ -133,7 +220,14 @@ class StoreServer:
                 pass
         with self._pub_lock:
             for q in self._subs:
-                q.put(None)
+                try:
+                    # never block while holding _pub_lock: a stalled
+                    # subscriber's queue may be full (bounded since r5) and
+                    # its consumer wedged — the conn close below (and the
+                    # serve loop's heartbeat-timeout _stop check) unblocks it
+                    q.put_nowait(None)
+                except queue.Full:
+                    pass
         with self._conns_lock:
             for c in list(self._conns):
                 try:
@@ -205,13 +299,14 @@ class StoreServer:
         # reads — allowed on any role (replicas serve monitoring/readbacks)
         if op == "ping":
             return {
-                "role": self.role, "seq": self.seq,
+                "role": self.role, "seq": self.seq, "epoch": self.epoch,
                 "replicate_from": self.replicate_from,
             }
         if op == "info":
             return {
                 "role": self.role,
                 "seq": self.seq,
+                "epoch": self.epoch,
                 "replicate_from": self.replicate_from,
                 "replicas": len(self._subs),
                 "depth": self.broker.depth(),
@@ -235,7 +330,13 @@ class StoreServer:
                 self.role = PRIMARY
                 self.replicate_from = None
                 self.repl_gen += 1
-            log.warning("PROMOTED to primary (seq %d)", self.seq)
+                # New reign: replicas use this to refuse snapshot-replace
+                # from any still-running lower-epoch (pre-failover) primary,
+                # and the durable write makes the promotion survive a full
+                # tier restart.
+                self.epoch += 1
+                self._save_state()
+            log.warning("PROMOTED to primary (seq %d, epoch %d)", self.seq, self.epoch)
             return {"role": self.role}
         if op == "demote":
             # Sentinel found us running as a stale primary after a failover,
@@ -252,6 +353,7 @@ class StoreServer:
                 self.role = REPLICA
                 self.repl_gen += 1
                 gen = self.repl_gen
+                self._save_state()
             log.warning(
                 "DEMOTED/re-pointed to replica of %s (was %s, seq %d)",
                 self.replicate_from, was, self.seq,
@@ -324,19 +426,48 @@ class StoreServer:
             return
         with self._pub_lock:
             self.seq += 1
+            self._maybe_save_seq()
             msg = {"t": "rows", "table": table, "rows": rows, "seq": self.seq}
+            stalled = []
             for q in self._subs:
-                q.put(msg)
+                try:
+                    q.put_nowait(msg)
+                except queue.Full:
+                    stalled.append(q)
+            for q in stalled:
+                # Drop the laggard: make room for the poison pill. Its
+                # serve thread picks it up at the next sub.get() — or, if
+                # wedged mid-send to a dead peer, times out on the socket
+                # (settimeout in _serve_subscriber) — closes the conn, and
+                # the replica resyncs via snapshot on reconnect.
+                self._subs.remove(q)
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                q.put_nowait(None)
+                log.warning(
+                    "replication subscriber overflowed %d-message buffer; "
+                    "dropped (will resync on reconnect)", REPL_QUEUE_MAX,
+                )
 
     def _serve_subscriber(self, conn: socket.socket) -> None:
         """Snapshot + live row stream + heartbeats, until disconnect."""
-        sub: queue.Queue = queue.Queue()
+        # A silently-dead peer (power loss, partition — no RST) wedges
+        # send_frame once the TCP buffer fills; without a timeout this
+        # thread would never consume its poison pill after an overflow
+        # drop, leaking the thread+socket until TCP retransmission gives
+        # up (~15 min). The timeout is per-send() progress, so a slow but
+        # live replica draining a large snapshot is fine.
+        conn.settimeout(10 * HEARTBEAT_INTERVAL)
+        sub: queue.Queue = queue.Queue(maxsize=REPL_QUEUE_MAX)
         with self._pub_lock:
             # snapshot under the publish lock so no row-batch is lost between
             # the dump and the subscription becoming live
             snapshot = {
                 "t": "snapshot",
                 "seq": self.seq,
+                "epoch": self.epoch,
                 "results": self.db.dump_rows(),
                 "tasks": self.broker.dump_rows(),
             }
@@ -383,6 +514,21 @@ class StoreServer:
                             self._stop.wait(5 * RESYNC_INTERVAL)
                             break
                         if msg["t"] == "snapshot":
+                            up_epoch = int(msg.get("epoch", 0))
+                            if up_epoch < self.epoch:
+                                # Stale pre-failover primary (e.g. the whole
+                                # tier restarted and pod-0's argv resurrected
+                                # it before the sentinels demote it):
+                                # replacing our state with its snapshot would
+                                # permanently delete every post-failover
+                                # write. Refuse, drop the link, retry — the
+                                # sentinels will demote/re-point one of us.
+                                log.error(
+                                    "REFUSING snapshot from lower-epoch "
+                                    "upstream %s (epoch %d < ours %d)",
+                                    self.replicate_from, up_epoch, self.epoch,
+                                )
+                                break
                             # Apply under _pub_lock with a generation
                             # re-check: a promote/re-point racing this recv
                             # must not let a stale frame from the old
@@ -393,9 +539,14 @@ class StoreServer:
                                 self.db.replace_rows(msg["results"])
                                 self.broker.replace_rows(msg["tasks"])
                                 self.seq = msg["seq"]
+                                if up_epoch != self.epoch:
+                                    self.epoch = up_epoch
+                                self._save_state()
                             log.info(
-                                "replica synced: %d results, %d tasks (seq %d)",
-                                len(msg["results"]), len(msg["tasks"]), msg["seq"],
+                                "replica synced: %d results, %d tasks "
+                                "(seq %d, epoch %d)",
+                                len(msg["results"]), len(msg["tasks"]),
+                                msg["seq"], self.epoch,
                             )
                         elif msg["t"] == "rows":
                             with self._pub_lock:
@@ -406,6 +557,7 @@ class StoreServer:
                                 else:
                                     self.broker.apply_rows(msg["rows"])
                                 self.seq = msg["seq"]
+                                self._maybe_save_seq()
                         # "hb": keepalive only
             except OSError:
                 pass

@@ -48,6 +48,16 @@ log = logging.getLogger("fraud_detection_tpu.sentinel")
 Endpoint = tuple[str, int]
 
 
+
+def _election_key(info: dict) -> tuple[int, int]:
+    """Rank candidates by (epoch, seq). A higher epoch is a LATER REIGN —
+    its writes supersede any lower-epoch node's regardless of seq — and seq
+    breaks ties within a reign. Electing by seq alone can crown a stale
+    pre-failover primary whose snapshot every higher-epoch replica then
+    (rightly) refuses (netserver epoch guard), wedging replication with no
+    resolution path."""
+    return int(info.get("epoch", 0)), int(info.get("seq", 0))
+
 def _call(ep: Endpoint, op: str, timeout: float = 1.0, **kwargs: Any) -> Any:
     """One-shot request/response to a store or peer sentinel."""
     req = attach_auth({"op": op, **kwargs}, config.store_token())
@@ -154,7 +164,28 @@ class Sentinel:
         ]
         if not primaries:
             return None
-        return max(primaries, key=lambda ep: infos[ep].get("seq", 0))
+        best = max(primaries, key=lambda ep: _election_key(infos[ep]))
+        top_epoch = max(
+            (
+                int(infos.get(ep, {}).get("epoch", 0))
+                for ep in self.stores
+                if not self._is_down(ep)
+            ),
+            default=0,
+        )
+        if int(infos[best].get("epoch", 0)) < top_epoch:
+            # A healthy store carries a LATER REIGN than every visible
+            # primary (stale-primary cold start): discovering the stale
+            # primary would wedge the higher-epoch node's resync (netserver
+            # epoch guard). Return None → the monitor loop falls through to
+            # quorum promotion of the highest-(epoch, seq) store instead.
+            log.warning(
+                "visible primary %s has epoch %s < top epoch %d among "
+                "healthy stores; refusing discovery, awaiting promotion",
+                best, infos[best].get("epoch", 0), top_epoch,
+            )
+            return None
+        return best
 
     def _failover(self) -> None:
         """Master is down for us; with quorum agreement, promote a replica."""
@@ -184,7 +215,7 @@ class Sentinel:
         if not candidates:
             log.error("master %s down and no live replica to promote", self.master)
             return
-        best = max(candidates, key=lambda ep: infos.get(ep, {}).get("seq", 0))
+        best = max(candidates, key=lambda ep: _election_key(infos.get(ep, {})))
         try:
             _call(best, "promote")
         except OSError as e:
@@ -298,7 +329,7 @@ class Sentinel:
             return
         with self._lock:
             infos = dict(self._last_info)
-        best = max(healthy, key=lambda ep: infos.get(ep, {}).get("seq", 0))
+        best = max(healthy, key=lambda ep: _election_key(infos.get(ep, {})))
         try:
             _call(best, "promote")
         except OSError as e:

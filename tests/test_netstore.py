@@ -554,3 +554,180 @@ def test_worker_survives_store_outage_and_resumes(tmp_path, monkeypatch):
         if t.is_alive():
             w.stop()
             t.join(timeout=10)
+
+
+def test_stalled_subscriber_dropped_healthy_replica_unaffected(primary, replica):
+    """A subscriber that stops draining must not grow an unbounded buffer on
+    the primary (advisor r3 finding): on overflow it is dropped with a
+    poison pill (its serve thread closes the conn; a real replica then
+    reconnects and resyncs via snapshot), while healthy subscribers keep
+    replicating."""
+    import queue as queue_mod
+
+    db = ResultsDB(f"fraud://127.0.0.1:{primary.port}")
+    tx0 = db.create_pending(None, {"a": 1.0}, None)
+    assert _wait(lambda: replica.db.get(tx0) is not None)
+
+    stuck: queue_mod.Queue = queue_mod.Queue(maxsize=2)
+    stuck.put({"t": "rows"})
+    stuck.put({"t": "rows"})  # full: emulates a subscriber that stopped draining
+    primary._subs.append(stuck)
+
+    tx1 = db.create_pending(None, {"a": 2.0}, None)  # publish overflows `stuck`
+    assert stuck not in primary._subs
+    drained = []
+    while True:
+        try:
+            drained.append(stuck.get_nowait())
+        except queue_mod.Empty:
+            break
+    assert drained[-1] is None, "dropped subscriber must get the poison pill"
+    # the healthy replica saw the write that overflowed the laggard
+    assert _wait(lambda: replica.db.get(tx1) is not None)
+    # and the stream stays live for subsequent writes
+    tx2 = db.create_pending(None, {"a": 3.0}, None)
+    assert _wait(lambda: replica.db.get(tx2) is not None)
+
+
+def test_full_tier_restart_after_failover_preserves_writes(tmp_path):
+    """The advisor-medium data-loss scenario, end to end: failover promotes
+    pod-1, writes land on it, then the WHOLE tier restarts with its original
+    StatefulSet bootstrap args (pod-0 primary, pod-1 replica-of-pod-0).
+    Durable state.json must override the stale argv — pod-0 comes back as a
+    replica of pod-1 and every post-failover write survives the restart."""
+    dir0, dir1 = str(tmp_path / "p0"), str(tmp_path / "p1")
+    pod0 = StoreServer(dir0, port=0)
+    pod0.start()
+    pod1 = StoreServer(dir1, port=0, replicate_from=f"127.0.0.1:{pod0.port}")
+    pod1.start()
+    db = ResultsDB(f"fraud://127.0.0.1:{pod0.port}")
+    tx_pre = db.create_pending(None, {"a": 1.0}, None)
+    assert _wait(lambda: pod1.db.get(tx_pre) is not None)
+
+    # failover: pod-0 dies, pod-1 is promoted (what the sentinels do)
+    pod0.stop()
+    _call(("127.0.0.1", pod1.port), "promote")
+    assert pod1.role == "primary" and pod1.epoch == 1
+    db1 = ResultsDB(f"fraud://127.0.0.1:{pod1.port}")
+    tx_post = db1.create_pending(None, {"a": 2.0}, None)  # post-failover write
+
+    # pod-0 restarts (StatefulSet) as a stale primary; the sentinels'
+    # split-brain recovery demotes it toward the promoted node, which
+    # persists role=replica + the adopted epoch in its state.json
+    pod0a = StoreServer(dir0, port=0)
+    pod0a.start()
+    assert pod0a.role == "primary", "un-demoted crash restores stale primary"
+    _call(
+        ("127.0.0.1", pod0a.port), "demote",
+        replicate_from=f"127.0.0.1:{pod1.port}",
+    )
+    assert _wait(lambda: pod0a.db.get(tx_post) is not None)  # resynced
+    # epoch adoption is atomic with the snapshot under _pub_lock, but this
+    # test reads the attr from outside that lock — poll, don't sample
+    assert _wait(lambda: pod0a.epoch == 1)  # adopted the promoted epoch
+
+    # FULL tier restart with ORIGINAL bootstrap args (fresh ports to prove
+    # nothing depends on the old processes)
+    pod0a.stop()
+    pod1.stop()
+    pod0b = StoreServer(dir0, port=0)  # argv says "primary"
+    pod0b.start()
+    pod1b = StoreServer(
+        dir1, port=0, replicate_from=f"127.0.0.1:{pod0b.port}"
+    )  # argv says "replica of pod-0"
+    pod1b.start()
+    try:
+        # durable state wins over argv on both pods
+        assert pod1b.role == "primary" and pod1b.epoch == 1
+        assert pod0b.role == "replica" and pod0b.epoch == 1
+        # THE criterion: post-failover writes survived the full tier restart
+        assert pod1b.db.get(tx_post) is not None
+        assert pod1b.db.get(tx_pre) is not None
+        assert pod0b.db.get(tx_post) is not None
+    finally:
+        pod0b.stop()
+        pod1b.stop()
+
+
+def test_replica_refuses_snapshot_from_lower_epoch_upstream(tmp_path):
+    """A promoted node pointed (by stale config) at a pre-failover primary
+    must refuse the snapshot-replace — applying it would permanently delete
+    post-failover writes."""
+    stale = StoreServer(str(tmp_path / "stale"), port=0)  # epoch 0
+    stale.start()
+    promoted = StoreServer(str(tmp_path / "promoted"), port=0)
+    promoted.start()
+    _call(("127.0.0.1", promoted.port), "promote")  # epoch 1
+    db = ResultsDB(f"fraud://127.0.0.1:{promoted.port}")
+    tx = db.create_pending(None, {"a": 3.0}, None)
+    try:
+        # stale config demotes the promoted node toward the stale primary
+        _call(
+            ("127.0.0.1", promoted.port), "demote",
+            replicate_from=f"127.0.0.1:{stale.port}",
+        )
+        # give the replica loop time to connect and (refuse to) sync
+        time.sleep(1.5)
+        assert promoted.db.get(tx) is not None, (
+            "lower-epoch snapshot must not replace post-failover state"
+        )
+        assert promoted.epoch == 1  # never adopted the stale epoch
+    finally:
+        stale.stop()
+        promoted.stop()
+
+
+def test_sentinel_elects_higher_epoch_over_higher_seq(tmp_path):
+    """The election must rank by (epoch, seq), not seq alone: a stale
+    pre-failover primary with a long write history must lose to a
+    later-reign node — electing the stale one would wedge every
+    higher-epoch replica's resync behind the epoch guard forever."""
+    stale = StoreServer(str(tmp_path / "stale"), port=0)   # epoch 0
+    stale.start()
+    later = StoreServer(str(tmp_path / "later"), port=0)
+    later.start()
+    _call(("127.0.0.1", later.port), "promote")            # epoch 1
+    db = ResultsDB(f"fraud://127.0.0.1:{stale.port}")
+    for i in range(6):                                     # stale seq = 6
+        db.create_pending(f"s{i}", {"a": float(i)}, None)
+    ResultsDB(f"fraud://127.0.0.1:{later.port}").create_pending(
+        "p0", {"a": 9.0}, None
+    )                                                      # later seq = 1
+    assert stale.seq > later.seq and later.epoch > stale.epoch
+    s = Sentinel(
+        "m1",
+        stores=[("127.0.0.1", stale.port), ("127.0.0.1", later.port)],
+        quorum=1, down_after=0.5, poll_interval=0.05,
+    )
+    s.start()
+    try:
+        assert _wait(lambda: s.master == ("127.0.0.1", later.port), timeout=10)
+        # split-brain recovery follows: the stale primary is demoted toward
+        # the later reign and adopts its epoch
+        assert _wait(lambda: stale.role == "replica", timeout=10)
+        assert _wait(lambda: stale.epoch >= later.epoch, timeout=10)
+    finally:
+        s.stop()
+        stale.stop()
+        later.stop()
+
+
+def test_seq_persisted_within_throttle_window(tmp_path):
+    """The durable seq must track the live seq (throttled ~0.5 s), not just
+    role transitions: a crash-restarted node restoring seq=0 would lose
+    (epoch, seq) elections to LESS caught-up replicas and have its extra
+    rows snapshot-replaced away."""
+    import json as _json
+
+    srv = StoreServer(str(tmp_path / "s"), port=0)
+    srv.start()
+    db = ResultsDB(f"fraud://127.0.0.1:{srv.port}")
+    try:
+        for i in range(5):
+            db.create_pending(f"a{i}", {"v": float(i)}, None)
+        time.sleep(0.6)  # pass the save throttle
+        db.create_pending("trigger", {"v": 9.0}, None)  # saves seq en route
+        state = _json.load(open(f"{tmp_path}/s/state.json"))
+        assert state["seq"] == srv.seq == 6
+    finally:
+        srv.stop()
