@@ -42,6 +42,7 @@ import io
 import json
 import logging
 import os
+import re
 import tarfile
 
 from fraud_detection_tpu.service.http import App, HTTPError, Request, Response
@@ -51,6 +52,7 @@ from fraud_detection_tpu.tracking.store import Run, TrackingClient
 log = logging.getLogger("fraud_detection_tpu.tracking.server")
 
 MAX_BUNDLE = 256 << 20  # 256 MiB artifact bundle ceiling
+_SAFE_SEGMENT = re.compile(r"^[A-Za-z0-9._-]+$")
 
 
 def _safe_members(tar: tarfile.TarFile):
@@ -88,9 +90,19 @@ def create_app(root: str) -> App:
     registry = ModelRegistry(store.root)
     app = App(title="fraud-tracking")
 
+    def _seg(req: Request, key: str) -> str:
+        """Path params become filesystem path segments (experiment/run/model
+        dirs under the store root) — reject anything that could traverse out:
+        one [A-Za-z0-9._-]+ segment, and never '.'/'..' (which the character
+        class alone would admit)."""
+        v = req.path_params[key]
+        if not _SAFE_SEGMENT.match(v) or v in (".", ".."):
+            raise HTTPError(400, f"invalid {key} {v!r}")
+        return v
+
     def _run(req: Request, create: bool = False) -> Run:
-        exp = req.path_params["experiment"]
-        run_id = req.path_params["run_id"]
+        exp = _seg(req, "experiment")
+        run_id = _seg(req, "run_id")
         try:
             return Run(store.root, exp, run_id, create=create)
         except FileNotFoundError as e:
@@ -103,12 +115,12 @@ def create_app(root: str) -> App:
     # -- runs ---------------------------------------------------------------
     @app.post("/api/experiments/{experiment}/runs")
     async def create_run(req: Request) -> Response:
-        run = store.start_run(req.path_params["experiment"])
+        run = store.start_run(_seg(req, "experiment"))
         return Response({"run_id": run.run_id})
 
     @app.get("/api/experiments/{experiment}/runs")
     async def list_runs(req: Request) -> Response:
-        return Response({"runs": store.list_runs(req.path_params["experiment"])})
+        return Response({"runs": store.list_runs(_seg(req, "experiment"))})
 
     @app.get("/api/experiments/{experiment}/runs/{run_id}")
     async def get_run(req: Request) -> Response:
@@ -171,7 +183,7 @@ def create_app(root: str) -> App:
         with tempfile.TemporaryDirectory() as tmp:
             untar_bytes(req.body, tmp)
             version = registry.register(
-                req.path_params["name"], tmp,
+                _seg(req, "name"), tmp,
                 run_id=req.headers.get("x-run-id"), metrics=metrics,
             )
         return Response({"version": version})
@@ -179,7 +191,7 @@ def create_app(root: str) -> App:
     @app.get("/api/registry/{name}/versions/{version}")
     async def get_version(req: Request) -> Response:
         d = registry.artifact_dir(
-            req.path_params["name"], int(req.path_params["version"])
+            _seg(req, "name"), int(req.path_params["version"])
         )
         if not os.path.isdir(d):
             raise HTTPError(404, f"no version {req.path_params['version']}")
@@ -189,7 +201,7 @@ def create_app(root: str) -> App:
     async def set_alias(req: Request) -> Response:
         body = req.json()
         registry.set_alias(
-            req.path_params["name"], body["alias"], int(body["version"])
+            _seg(req, "name"), body["alias"], int(body["version"])
         )
         return Response({"ok": True})
 
@@ -198,12 +210,12 @@ def create_app(root: str) -> App:
         from fraud_detection_tpu.tracking.store import _read_json
 
         return Response(
-            _read_json(registry._aliases_path(req.path_params["name"]), {})
+            _read_json(registry._aliases_path(_seg(req, "name")), {})
         )
 
     @app.get("/api/registry/{name}/latest")
     async def latest(req: Request) -> Response:
-        return Response({"version": registry.latest_version(req.path_params["name"])})
+        return Response({"version": registry.latest_version(_seg(req, "name"))})
 
     return app
 

@@ -153,3 +153,30 @@ def test_serving_loads_model_from_http_registry(server, tmp_path, monkeypatch, r
     got = loaded.scorer.predict_proba(x[:8])
     want = model.scorer.predict_proba(x[:8])
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_path_traversal_segments_rejected(server, tmp_path):
+    """Path params are filesystem segments under the store root — '..'
+    (or separator-bearing) values must 400, never touch the filesystem
+    (advisor r3 finding: tracking/server.py path joins)."""
+    import http.client
+
+    def req(method, path):
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+        try:
+            conn.request(method, path, body=b"{}",
+                         headers={"content-type": "application/json"})
+            return conn.getresponse().status
+        finally:
+            conn.close()
+
+    assert req("POST", "/api/experiments/../runs") == 400
+    assert req("POST", "/api/experiments/.%2e/runs") in (400, 404)
+    assert req("GET", "/api/experiments/ok/runs/..") == 400
+    assert req("GET", "/api/registry/../aliases") == 400
+    assert req("GET", "/api/registry/./latest") == 400
+    # escape attempt never created anything above the store root
+    root = tmp_path / "trackroot"
+    assert not (root.parent / "runs").exists()
+    # sane names still work end-to-end
+    assert req("POST", "/api/experiments/exp-1.ok/runs") == 200
