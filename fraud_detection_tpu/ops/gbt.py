@@ -15,11 +15,19 @@ XLA's static-shape compilation model:
   pass-through (all rows to the left child, which inherits its statistics),
   so "early stopping" a branch needs no dynamic shapes. Empty nodes produce
   0-valued unreachable leaves.
-- **Level-wise growth** (xgboost's ``depth_wise``): one fori step per level;
-  per-(node, feature, bin) gradient/hessian histograms via ``segment_sum``
-  keyed on ``node_id * n_bins + bin``; split gain from cumulative sums —
-  the standard second-order gain
+- **Level-wise growth** (xgboost's ``depth_wise``), statically unrolled over
+  the (static) depth so level L only pays for its 2^L live nodes; split gain
+  from cumulative sums — the standard second-order gain
   ``½[G_L²/(H_L+λ) + G_R²/(H_R+λ) − G²/(H+λ)] − γ``.
+- **Histograms on the MXU, not the scatter unit.** The per-(node, feature,
+  bin) gradient/hessian histograms are computed as one-hot matmuls —
+  ``[A∘g, A∘h]ᵀ @ B`` with ``A`` the row→node one-hot and ``B`` the
+  row→(feature·bin) one-hot, bf16 operands with f32 accumulation, blocked
+  over rows so the one-hots live in VMEM — instead of ``segment_sum``
+  scatter-adds. Scatter on TPU retires ~1 update/cycle; the systolic array
+  does the same reduction as a dense contraction at hundreds of GFLOP/s,
+  which is an order-of-magnitude train-throughput win at the bench shape
+  (VERDICT r4 ask #4).
 - **Newton leaf values** ``−G/(H+λ)`` scaled by the learning rate; logits
   updated in-place from the row→leaf index so trees are never re-traversed
   during training.
@@ -127,6 +135,71 @@ def bin_features(x: jax.Array, bin_edges: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+# Rows per one-hot block. The (block, d·n_bins) bf16 one-hot is ~63 MB at
+# the Kaggle shape — larger than VMEM (~16 MB), so it only stays on-chip if
+# XLA fuses the cheap eq-broadcast producer into the dot's operand loads
+# (the usual outcome for compare+select feeding a dot_general). The block
+# size instead optimizes the term we control either way: fewer scan steps →
+# fewer f32 accumulator round-trips (the (2·nodes, d·n_bins) carry is
+# re-read/written every step). If profiling shows the one-hot spilling,
+# shrink toward 1024 (≈16 MB) to trade accumulator traffic for residency.
+_HIST_BLOCK = 4096
+
+
+def _hist_matmul(binned, local, g, h, n_nodes: int, n_bins: int):
+    """(d, n_nodes, n_bins, 2) grad/hess histograms as MXU contractions.
+
+    ``hist[f, m, b, 0] = Σ_r 1[local_r = m]·1[binned_rf = b]·g_r`` factors
+    into ``(A∘g)ᵀ @ B`` with ``A`` (rows × nodes) and ``B`` (rows ×
+    features·bins) one-hots — a dense matmul the systolic array executes at
+    full rate, vs one scatter-update per (row, feature) for segment_sum.
+    Blocked over rows (lax.scan) so the transient one-hots never hit HBM;
+    bf16 operands (one-hots are exact in bf16; g/h lose 0.4% mantissa,
+    noise-level for sums over thousands of rows), f32 accumulation.
+    """
+    n, d = binned.shape
+    bs = min(_HIST_BLOCK, n)
+    pad = (-n) % bs
+    if pad:
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+        local = jnp.pad(local, (0, pad))  # pad rows carry g = h = 0: inert
+        g = jnp.pad(g, (0, pad))
+        h = jnp.pad(h, (0, pad))
+    nb = binned.shape[0] // bs
+    nodes = jnp.arange(n_nodes, dtype=local.dtype)
+    bins = jnp.arange(n_bins, dtype=binned.dtype)
+
+    def block(acc, xs):
+        bb, lb, gb, hb = xs
+        a = lb[:, None] == nodes[None, :]  # (bs, n_nodes)
+        aw = jnp.concatenate(
+            [jnp.where(a, gb[:, None], 0.0), jnp.where(a, hb[:, None], 0.0)],
+            axis=1,
+        ).astype(jnp.bfloat16)  # (bs, 2·n_nodes)
+        b1 = (bb[:, :, None] == bins).astype(jnp.bfloat16)  # (bs, d, n_bins)
+        acc = acc + jax.lax.dot_general(
+            aw,
+            b1.reshape(bs, d * n_bins),
+            (((0,), (0,)), ((), ())),  # contract over rows
+            preferred_element_type=jnp.float32,
+        )
+        return acc, None
+
+    acc0 = jnp.zeros((2 * n_nodes, d * n_bins), jnp.float32)
+    acc, _ = jax.lax.scan(
+        block,
+        acc0,
+        (
+            binned.reshape(nb, bs, d),
+            local.reshape(nb, bs),
+            g.reshape(nb, bs),
+            h.reshape(nb, bs),
+        ),
+    )
+    acc = acc.reshape(2, n_nodes, d, n_bins)
+    return jnp.transpose(acc, (2, 1, 3, 0))  # (d, n_nodes, n_bins, 2)
+
+
 def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None):
     """Grow one static-depth tree; returns (split_feature, split_bin,
     leaf_value, row_leaf) with ``row_leaf`` the bottom-level leaf index of
@@ -134,7 +207,10 @@ def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None):
 
     ``binned``: (n, d) int32; ``g``/``h``: (n,) f32 (0 for padding rows).
     With ``axis_name`` set (inside shard_map), histograms are psum'd so all
-    shards grow identical trees from global statistics.
+    shards grow identical trees from global statistics. The level loop is a
+    Python loop (depth is static): level L's histograms/one-hots are sized
+    to its 2^L live nodes instead of a 2^depth static bound, a 5× FLOP
+    saving at depth 5.
     """
     n, d = binned.shape
     n_bins = cfg.n_bins
@@ -142,24 +218,18 @@ def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None):
     n_internal = 2**depth - 1
     lam, gamma, mcw = cfg.reg_lambda, cfg.gamma, cfg.min_child_weight
 
-    def level_step(level, state):
-        node, feat, thresh = state
-        # node ids at this level occupy [2^level - 1, 2^(level+1) - 1); index
-        # histograms by the level-local id so the segment space stays 2^level.
+    node = jnp.zeros((n,), jnp.int32)
+    feat = jnp.zeros((n_internal,), jnp.int32)
+    thresh = jnp.full((n_internal,), n_bins - 1, jnp.int32)
+    rows = jnp.arange(n)
+    for level in range(depth):
+        # node ids at this level occupy [2^level - 1, 2^(level+1) - 1);
+        # histograms are indexed by the level-local id.
         level_base = 2**level - 1
-        n_nodes = 2**depth  # static upper bound ≥ 2^level, keeps shapes fixed
+        n_nodes = 2**level
         local = node - level_base
 
-        seg = local[:, None] * n_bins + binned  # (n, d) segment ids per feature
-        n_seg = n_nodes * n_bins
-
-        def hist_one_feature(seg_f):
-            gh = jnp.stack([g, h], axis=1)  # (n, 2)
-            return jax.ops.segment_sum(gh, seg_f, num_segments=n_seg)
-
-        # (d, n_seg, 2) → (d, n_nodes, n_bins, 2)
-        hist = jax.vmap(hist_one_feature, in_axes=1)(seg)
-        hist = hist.reshape(d, n_nodes, n_bins, 2)
+        hist = _hist_matmul(binned, local, g, h, n_nodes, n_bins)
         if axis_name is not None:
             hist = jax.lax.psum(hist, axis_name)
 
@@ -193,32 +263,29 @@ def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None):
         best_bin = jnp.where(no_split, n_bins - 1, best_bin).astype(jnp.int32)
 
         # Write this level's decisions into the heap arrays.
-        level_ids = level_base + jnp.arange(n_nodes)  # may exceed the level's
-        in_level = jnp.arange(n_nodes) < 2**level     # true width; mask extras
-        write_ids = jnp.where(in_level, level_ids, n_internal)  # OOB drops
-        feat = feat.at[write_ids].set(best_f, mode="drop")
-        thresh = thresh.at[write_ids].set(best_bin, mode="drop")
+        write_ids = level_base + jnp.arange(n_nodes)
+        feat = feat.at[write_ids].set(best_f)
+        thresh = thresh.at[write_ids].set(best_bin)
 
         # Route rows to children.
         row_f = best_f[local]
         row_b = best_bin[local]
-        go_right = binned[jnp.arange(n), row_f] > row_b
+        go_right = binned[rows, row_f] > row_b
         node = 2 * node + 1 + go_right.astype(jnp.int32)
-        return node, feat, thresh
 
-    node0 = jnp.zeros((n,), jnp.int32)
-    feat0 = jnp.zeros((n_internal + 1,), jnp.int32)
-    thresh0 = jnp.full((n_internal + 1,), n_bins - 1, jnp.int32)
-    node, feat, thresh = jax.lax.fori_loop(
-        0, depth, level_step, (node0, feat0, thresh0)
-    )
-
-    # Leaf values from bottom-level statistics: -G/(H+λ), Newton step.
+    # Leaf values from bottom-level statistics: -G/(H+λ), Newton step. Same
+    # one-hot contraction as the histograms (32 columns — trivial work).
     leaf_base = 2**depth - 1
     row_leaf = node - leaf_base
     n_leaves = 2**depth
+    a = (row_leaf[:, None] == jnp.arange(n_leaves)[None, :])
     gh = jnp.stack([g, h], axis=1)
-    leaf_gh = jax.ops.segment_sum(gh, row_leaf, num_segments=n_leaves)
+    leaf_gh = jax.lax.dot_general(
+        a.astype(jnp.bfloat16),
+        gh.astype(jnp.bfloat16),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (n_leaves, 2)
     if axis_name is not None:
         leaf_gh = jax.lax.psum(leaf_gh, axis_name)
     leaf_value = jnp.where(
@@ -226,7 +293,7 @@ def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None):
         -leaf_gh[:, 0] / (leaf_gh[:, 1] + lam),
         0.0,
     ) * cfg.learning_rate
-    return feat[:n_internal], thresh[:n_internal], leaf_value, row_leaf
+    return feat, thresh, leaf_value, row_leaf
 
 
 def _boost(binned, y, w, base_logit, cfg: GBTConfig, axis_name=None):
