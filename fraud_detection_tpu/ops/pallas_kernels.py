@@ -68,11 +68,12 @@ def _flag_state() -> str:
 
 def knn_pallas_enabled(backend: str | None = None) -> bool:
     """Gate for the blocked k-NN kernel — ``auto`` resolves to ON for the
-    TPU backend: measured on a v5e chip against the XLA blockwise path it
-    is at parity to ~16k minority rows and ahead at scale (40k: 103 ms vs
-    118 ms; 100k: 273 ms vs 368 ms — 26% faster), with index parity (ties
-    broken by ascending global index, like ``lax.top_k``). ``USE_PALLAS=0``
-    forces it off."""
+    TPU backend: measured on a v5e chip against the XLA blockwise path (the
+    pre-r5 sweep kernel) it was at parity to ~16k minority rows and ahead at
+    scale (40k: 103 ms vs 118 ms; 100k: 273 ms vs 368 ms), with index parity
+    (ties broken by ascending global index, like ``lax.top_k``). The r5
+    group-fold redesign removes most cross-lane reduction work on top of
+    that. ``USE_PALLAS=0`` forces it off."""
     if _flag_state() == "off":
         return False
     return (backend or jax.default_backend()) == "tpu"
@@ -205,22 +206,45 @@ def _knn_kernel(
     cols = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1) + j * block_k
     d2 = jnp.where(rows == cols, jnp.inf, d2)
 
+    # -- stage 1: fold the BK-lane tile to per-lane k-candidates ------------
+    # Cross-lane (axis-1) reductions over thousands of lanes are the VPU's
+    # weak spot (log-depth lane shuffles). Reshape to (BQ, G, LANE) and take
+    # the k best per (row, lane) over the GROUP axis — vector-friendly
+    # strided mins, no lane crossings. Exact: any lane holds ≤ k of the
+    # tile's global k best, and candidates are ranked by the same
+    # (distance, lowest-global-index) order as the final extraction.
+    lane_w = min(LANE, block_k)  # sub-LANE blocks only occur in tests
+    g_blocks = block_k // lane_w
+    d2g = d2.reshape(block_q, g_blocks, lane_w)
+    colsg = cols.reshape(block_q, g_blocks, lane_w)
+    cand_d, cand_i = [], []
+    for _ in range(k):
+        m = jnp.min(d2g, axis=1)                              # (BQ, LANE)
+        marg = jnp.min(
+            jnp.where(d2g == m[:, None, :], colsg, _BIG_ID), axis=1
+        )                                                      # (BQ, LANE)
+        cand_d.append(m)
+        cand_i.append(marg)
+        d2g = jnp.where(colsg == marg[:, None, :], jnp.inf, d2g)
+    cd = jnp.concatenate(cand_d, axis=1)                       # (BQ, k·LANE)
+    ci = jnp.concatenate(cand_i, axis=1)
+
+    # -- stage 2: insert the candidate strip into the running slots ---------
+    # k masked row-min passes, now over k·LANE lanes instead of BK.
     slot_ids = jax.lax.broadcasted_iota(jnp.int32, bestd_ref.shape, 1)
     bd, bi = bestd_ref[:], besti_ref[:]
-    # k masked row-min passes over the tile (k is tiny; cheaper than a full
-    # sort), each winner inserted into the running slots.
     for _ in range(k):
-        tile_best = jnp.min(d2, axis=1, keepdims=True)      # (BQ, 1)
+        strip_best = jnp.min(cd, axis=1, keepdims=True)       # (BQ, 1)
         bcol = jnp.min(
-            jnp.where(d2 == tile_best, cols, _BIG_ID), axis=1, keepdims=True
-        )                                                    # (BQ, 1) global id
-        d2 = jnp.where(cols == bcol, jnp.inf, d2)
-        worst = jnp.max(bd, axis=1, keepdims=True)           # (BQ, 1)
+            jnp.where(cd == strip_best, ci, _BIG_ID), axis=1, keepdims=True
+        )                                                      # (BQ, 1)
+        cd = jnp.where(ci == bcol, jnp.inf, cd)
+        worst = jnp.max(bd, axis=1, keepdims=True)             # (BQ, 1)
         wslot = jnp.max(
             jnp.where(bd == worst, slot_ids, -1), axis=1, keepdims=True
         )
-        take = (slot_ids == wslot) & (tile_best < worst)
-        bd = jnp.where(take, tile_best, bd)
+        take = (slot_ids == wslot) & (strip_best < worst)
+        bd = jnp.where(take, strip_best, bd)
         bi = jnp.where(take, bcol, bi)
     bestd_ref[:], besti_ref[:] = bd, bi
 
@@ -292,12 +316,24 @@ def _knn_jit(x, k: int, block_q: int, block_k: int, interpret: bool):
 
 
 def knn_topk(
-    x_min, k: int, block_q: int = 256, block_k: int = 1024,
+    x_min, k: int, block_q: int = 256, block_k: int = 4096,
     interpret: bool = False,
 ):
     """Indices (m, k) of each row's k nearest neighbors (self excluded),
     euclidean; drop-in for ops/smote._knn_indices. Blocked over both query
-    and key axes — any minority-set size (the set streams from HBM)."""
+    and key axes — any minority-set size (the set streams from HBM).
+
+    Default blocks: (256, 4096) keeps the d2 tile + key block ≈ 6 MB of
+    ~16 MB VMEM while quartering the grid steps and slot-merge rounds of the
+    old (256, 1024) blocking. For small minority sets the key block shrinks
+    to the padded set size so tiny inputs don't pay 4096-wide tiles."""
+    m = int(np.shape(x_min)[0])
+    # shrink blocks for small sets: smallest power-of-two ≥ m, floor LANE
+    fit = LANE
+    while fit < min(m, block_k):
+        fit *= 2
+    block_k = min(block_k, fit)
+    block_q = min(block_q, block_k)
     big, small = max(block_q, block_k), min(block_q, block_k)
     if big % small != 0:
         # Rows are padded to max(block_q, block_k); non-commensurate blocks
@@ -307,4 +343,6 @@ def knn_topk(
             f"block_q ({block_q}) and block_k ({block_k}) must divide one "
             "another"
         )
+    if block_k % min(LANE, block_k) != 0:
+        raise ValueError(f"block_k ({block_k}) must be a multiple of {LANE}")
     return _knn_jit(jnp.asarray(x_min, jnp.float32), k, block_q, block_k, interpret)
