@@ -42,6 +42,24 @@ def device_backend() -> str:
     return _get("DEVICE", "tpu")
 
 
+def apply_device_backend() -> None:
+    """Make ``DEVICE=cpu`` actually pin the JAX platform.
+
+    A site PJRT plugin (e.g. the tunneled TPU registration) force-updates
+    ``jax_platforms`` at import, so the env var alone cannot keep a service
+    off the accelerator. Entrypoints call this BEFORE first backend use —
+    the operational escape hatch for serving through a wedged/absent TPU
+    tunnel (seen in round 4: backend attach hung forever). No-op for the
+    default ``tpu`` and once the backend is initialized."""
+    if device_backend().lower() == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already up; too late to re-pin
+
+
 def mesh_data_axis() -> int:
     """Number of devices on the data axis; 0 = all available."""
     return _get_int("MESH_DATA", 0)

@@ -299,6 +299,7 @@ def main():
     import argparse
 
     logging.basicConfig(level=logging.INFO)
+    config.apply_device_backend()  # DEVICE=cpu serves without the TPU tunnel
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8000)

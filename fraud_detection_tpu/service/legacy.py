@@ -68,6 +68,9 @@ def main():
     import argparse
 
     logging.basicConfig(level=logging.INFO)
+    from fraud_detection_tpu import config
+
+    config.apply_device_backend()  # DEVICE=cpu serves without the TPU tunnel
     ap = argparse.ArgumentParser()
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=5000)  # deploy.py:54

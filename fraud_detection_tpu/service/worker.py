@@ -281,6 +281,7 @@ def main():
     import argparse
 
     logging.basicConfig(level=logging.INFO)
+    config.apply_device_backend()  # DEVICE=cpu runs without the TPU tunnel
     ap = argparse.ArgumentParser()
     ap.add_argument("--metrics-port", type=int, default=config.worker_metrics_port())
     ap.add_argument("--poll-interval", type=float, default=0.2)

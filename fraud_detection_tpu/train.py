@@ -275,6 +275,7 @@ def train(
 
 def main(argv=None):
     logging.basicConfig(level=logging.INFO)
+    config.apply_device_backend()  # DEVICE=cpu trains without the TPU tunnel
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data", default=None)
     ap.add_argument("--folds", type=int, default=5)
