@@ -7,11 +7,15 @@ reference's actual persistence topology: one Postgres server shared by API
 pods and worker pods over the network (db/db.py:6-14,
 docker-compose.yml:38-57).
 
-The adapter translates the two real dialect differences:
+The adapter translates the three real dialect differences:
 
 - ``?`` placeholders → ``$n`` (done in pgwire);
 - ``REAL`` columns → ``DOUBLE PRECISION`` in DDL (PG's REAL is float4 —
-  too coarse for epoch-seconds timestamps like ``visible_at``).
+  too coarse for epoch-seconds timestamps like ``visible_at``);
+- ``INSERT OR REPLACE INTO t`` (the replication row surfaces
+  apply_rows/replace_rows) → ``INSERT ... ON CONFLICT (pk) DO UPDATE``,
+  keyed by a per-table primary-key map. Unknown tables raise rather than
+  ship sqlite-only SQL to a real server.
 
 Claim-loop concurrency note: the broker's claim uses the same guarded
 ``UPDATE ... WHERE id = ? AND status = ? AND visible_at <= ?`` as SQLite —
@@ -21,11 +25,25 @@ return rowcount 0, which claim_many already treats as "another worker won".
 
 from __future__ import annotations
 
+import re
 import threading
 
 from fraud_detection_tpu.service import db as _db
 from fraud_detection_tpu.service import taskq as _taskq
 from fraud_detection_tpu.service.pgwire import PgConnection, Result
+
+
+# Primary keys of the replicated tables, for the INSERT OR REPLACE →
+# ON CONFLICT upsert translation. sqlite accepts the translated form too,
+# so the emulator and real PG execute identical statements.
+_UPSERT_PK = {
+    "transaction_results": "transaction_id",
+    "tasks": "id",
+    "schema_migrations": "id",
+}
+_INSERT_OR_REPLACE = re.compile(
+    r"^\s*INSERT\s+OR\s+REPLACE\s+INTO\s+(\w+)\s*\(([^)]*)\)", re.IGNORECASE
+)
 
 
 class _PgAdapter:
@@ -38,7 +56,29 @@ class _PgAdapter:
 
     @staticmethod
     def _ddl(sql: str) -> str:
-        return sql.replace(" REAL", " DOUBLE PRECISION")
+        sql = sql.replace(" REAL", " DOUBLE PRECISION")
+        m = _INSERT_OR_REPLACE.match(sql)
+        if m:
+            table = m.group(1)
+            cols = [c.strip() for c in m.group(2).split(",")]
+            pk = _UPSERT_PK.get(table)
+            if pk is None:
+                raise ValueError(
+                    f"INSERT OR REPLACE into unmapped table {table!r}: add "
+                    "its primary key to pgclient._UPSERT_PK"
+                )
+            sets = ", ".join(f"{c} = EXCLUDED.{c}" for c in cols if c != pk)
+            sql = _INSERT_OR_REPLACE.sub(
+                f"INSERT INTO {table} ({', '.join(cols)})", sql, count=1
+            )
+            clause = f"DO UPDATE SET {sets}" if sets else "DO NOTHING"
+            sql += f" ON CONFLICT ({pk}) {clause}"
+        if re.search(r"INSERT\s+OR\s+REPLACE", sql, re.IGNORECASE):
+            # a shape the rewrite regex didn't match (no column list, quoted
+            # table, …): the emulator's sqlite would accept it and hide the
+            # bug until a real server rejects it — fail loudly instead
+            raise ValueError(f"untranslatable sqlite-only SQL: {sql[:120]!r}")
+        return sql
 
     def execute(self, sql: str, params: tuple | list = ()) -> Result:
         return self._pg.execute(self._ddl(sql), params)
@@ -47,8 +87,9 @@ class _PgAdapter:
         self._pg.execute_simple(self._ddl(sql))
 
     def executemany(self, sql: str, seq) -> None:
+        sql = self._ddl(sql)  # translate once, not per row
         for params in seq:
-            self.execute(sql, params)
+            self._pg.execute(sql, params)
 
     def __enter__(self):
         self._pg.execute_simple("BEGIN")

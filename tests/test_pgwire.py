@@ -163,3 +163,47 @@ def test_pg_broker_full_surface(pg):
     assert q.get_status(tid) == "DONE"
     assert q.depth() == 0
     q.close()
+
+
+def test_replication_row_surfaces_translate_upsert(pg):
+    """apply_rows/replace_rows use sqlite's INSERT OR REPLACE; over the PG
+    backend the adapter must rewrite it to INSERT ... ON CONFLICT DO UPDATE
+    (both dialects execute the translated form) instead of shipping
+    sqlite-only SQL to a real server."""
+    db = ResultsDB(pg)
+    tx = db.create_pending("r1", {"a": 1.0}, "c")
+    rows = db.dump_rows()
+    rows[0]["status"] = "COMPLETED"
+    db.apply_rows(rows)                      # upsert over existing pk
+    assert db.get("r1")["status"] == "COMPLETED"
+    db.replace_rows(rows)                    # delete-then-apply snapshot
+    assert db.count() == 1 and db.get("r1")["status"] == "COMPLETED"
+
+    q = Broker(pg)
+    q.send_task("t", [1], correlation_id="x")
+    trows = q.dump_rows()
+    q.apply_rows(trows)
+    assert q.depth() == 1
+    q.replace_rows([])                       # snapshot from an empty primary
+    assert q.depth() == 0
+
+
+def test_insert_or_replace_unmapped_table_raises():
+    from fraud_detection_tpu.service.pgclient import _PgAdapter
+
+    with pytest.raises(ValueError, match="unmapped table"):
+        _PgAdapter._ddl("INSERT OR REPLACE INTO mystery (id, v) VALUES (?, ?)")
+
+
+def test_untranslatable_insert_or_replace_raises():
+    from fraud_detection_tpu.service.pgclient import _PgAdapter
+
+    # shapes the rewrite regex doesn't cover must fail loudly, not ship
+    # sqlite-only SQL that only a real server would reject
+    with pytest.raises(ValueError, match="untranslatable"):
+        _PgAdapter._ddl("INSERT OR REPLACE INTO tasks VALUES (?, ?)")
+    # pk-only column list degrades to DO NOTHING, not an empty SET clause
+    out = _PgAdapter._ddl(
+        "INSERT OR REPLACE INTO schema_migrations (id) VALUES (?)"
+    )
+    assert out.endswith("ON CONFLICT (id) DO NOTHING")
