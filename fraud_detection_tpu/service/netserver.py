@@ -619,6 +619,13 @@ def main() -> None:
             registry=registry,
         )
         seq.set_function(lambda: float(srv.seq))
+        epoch = Gauge(
+            "fraud_store_failover_epoch",
+            "Failover epoch (bumps on every promote; divergence across the "
+            "tier means a stale reign is still serving)",
+            registry=registry,
+        )
+        epoch.set_function(lambda: float(srv.epoch))
         start_http_server(args.metrics_port, registry=registry)
         log.info("store metrics on :%d", args.metrics_port)
     srv.serve_forever()
