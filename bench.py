@@ -680,10 +680,20 @@ def main() -> None:
     h.update(device=platform)
     h.emit()
 
-    # ---- device-resident sections first: a tunneled chip serializes
-    # dispatch after the first blocking d2h readback, so sync sections last.
+    # ---- CPU denominators FIRST: they never touch the device (can't
+    # poison the tunnel's async dispatch) and a device section hanging
+    # later must not cost the round its CPU evidence floor.
+    cpu_rate, shap_cpu, gbt_cpu = _run_cpu_denominators(
+        h, x, coef, intercept, mean, scale
+    )
+
+    # ---- device-resident sections before any synchronous d2h section:
+    # a tunneled chip serializes dispatch after the first blocking
+    # readback, so sync sections go last.
     dev_rate = h.section("dev_scoring", bench_dev_scoring, x, coef, intercept,
                          mean, scale)
+    if dev_rate and cpu_rate:
+        h.update(vs_baseline=round(dev_rate / cpu_rate, 2))
     if dev_rate:
         scoring_hbm = dev_rate * (d + 1) * 4.0  # X read + scores written
         h.update(
@@ -696,6 +706,8 @@ def main() -> None:
                          mean)
     if shap_dev:
         h.update(shap_values_per_sec=round(shap_dev))
+        if shap_cpu:
+            h.update(shap_vs_cpu=round(shap_dev / shap_cpu, 2))
     gbt_res = h.section("gbt", bench_gbt, x, mean, scale)
     if gbt_res:
         gbt_train, gbt_score, gbt_shap = gbt_res
@@ -704,6 +716,8 @@ def main() -> None:
             gbt_score_rows_per_sec=round(gbt_score),
             gbt_tree_shap_rows_per_sec=round(gbt_shap),
         )
+        if gbt_cpu:
+            h.update(gbt_train_vs_cpu=round(gbt_train / gbt_cpu, 2))
     smote_res = h.section("smote", bench_smote)
     if smote_res:
         smote_rate, smote_flops, smote_hbm = smote_res
@@ -713,17 +727,6 @@ def main() -> None:
             smote_mfu=round(smote_flops / peak_flops, 4),
             smote_hbm_gbytes_per_sec=round(smote_hbm / 1e9, 1),
         )
-
-    # ---- host-only denominators (shared with the no-device path)
-    cpu_rate, shap_cpu, gbt_cpu = _run_cpu_denominators(
-        h, x, coef, intercept, mean, scale
-    )
-    if cpu_rate and dev_rate:
-        h.update(vs_baseline=round(dev_rate / cpu_rate, 2))
-    if shap_cpu and shap_dev:
-        h.update(shap_vs_cpu=round(shap_dev / shap_cpu, 2))
-    if gbt_cpu and gbt_res:
-        h.update(gbt_train_vs_cpu=round(gbt_res[0] / gbt_cpu, 2))
 
     # ---- link-bound sections (h2d-inclusive paths)
     bw = h.section("link_bandwidth", bench_link_bandwidth, x)
