@@ -567,9 +567,19 @@ def bench_gbt(x, mean, scale) -> tuple[float, float, float]:
     xt, yt = _gbt_train_data()
     n_train = xt.shape[0]
     cfg = GBTConfig(n_trees=50, max_depth=5, learning_rate=0.2)
-    model = gbt_fit(xt[: 1 << 14], yt[: 1 << 14], cfg)  # compile warmup
+    # Warm at the TIMED shape: the boosting program is jit-cached at module
+    # level (ops/gbt._boost_jit), so CV folds / refits at one shape compile
+    # once — the steady-state rate below is what the train pipeline pays
+    # per fold. (The pre-r5 bench warmed at a different shape while gbt_fit
+    # re-jitted per call, so the timed fit re-compiled the whole 50-tree
+    # program and the reported rate was mostly XLA compile time.)
+    gbt_fit(xt, yt, cfg).split_feature.block_until_ready()
     t0 = time.perf_counter()
     model = gbt_fit(xt, yt, cfg)
+    # the cached program dispatches asynchronously — wait for the full
+    # boost to finish or the timer only measures enqueue
+    model.split_feature.block_until_ready()
+    model.leaf_value.block_until_ready()
     train_rate = n_train / (time.perf_counter() - t0)
 
     batches = [jnp.asarray(x[i * BATCH : (i + 1) * BATCH]) for i in range(4)]

@@ -44,6 +44,7 @@ multiplying the minority-class sample weight (train_model.py:52-54).
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from functools import partial
 from typing import NamedTuple
@@ -300,6 +301,12 @@ def _boost(binned, y, w, base_logit, cfg: GBTConfig, axis_name=None):
     """Scan over boosting rounds; returns stacked tree arrays.
 
     ``w`` carries both padding validity (0 ⇒ inert) and scale_pos_weight.
+    Callers go through the module-level jit caches below (``_boost_jit`` /
+    ``_sharded_boost``) so repeated fits at one shape — CV folds, the
+    final refit, bench steady state — compile ONCE. A per-call
+    ``jax.jit(partial(...))`` (the pre-r5 shape of this code) defeats
+    jit's cache entirely: every fold recompiled the whole n_trees-round
+    program, which dominated wall-clock at CV scale.
     """
 
     def round_step(logits, _):
@@ -316,6 +323,24 @@ def _boost(binned, y, w, base_logit, cfg: GBTConfig, axis_name=None):
         round_step, logits0, None, length=cfg.n_trees
     )
     return feats, threshs, leaves
+
+
+_boost_jit = jax.jit(_boost, static_argnames=("cfg", "axis_name"))
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_boost(mesh, cfg: GBTConfig):
+    """Jitted shard_map boosting step for (mesh, cfg) — cached so repeated
+    sharded fits (CV folds, dryrun equality checks) compile once."""
+    return jax.jit(
+        shard_map(
+            partial(_boost, cfg=cfg, axis_name=DATA_AXIS),
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )
 
 
 def gbt_fit(
@@ -346,8 +371,8 @@ def gbt_fit(
 
     if not sharded:
         binned = bin_features(jnp.asarray(x_np), edges_dev)
-        feats, threshs, leaves = jax.jit(partial(_boost, cfg=cfg))(
-            binned, jnp.asarray(y_np), jnp.asarray(w), base_logit
+        feats, threshs, leaves = _boost_jit(
+            binned, jnp.asarray(y_np), jnp.asarray(w), base_logit, cfg=cfg
         )
     else:
         from fraud_detection_tpu.parallel.mesh import default_mesh
@@ -362,14 +387,7 @@ def gbt_fit(
         y_dev, _ = shard_batch(y_pad, mesh)
         w_dev, _ = shard_batch(w_pad, mesh)
 
-        boost_sharded = shard_map(
-            partial(_boost, cfg=cfg, axis_name=DATA_AXIS),
-            mesh=mesh,
-            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
-            out_specs=(P(), P(), P()),
-            check_vma=False,
-        )
-        feats, threshs, leaves = jax.jit(boost_sharded)(
+        feats, threshs, leaves = _sharded_boost(mesh, cfg)(
             x_dev, y_dev, w_dev, base_logit
         )
 

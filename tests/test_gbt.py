@@ -133,3 +133,24 @@ def test_pass_through_on_pure_node():
     p = np.asarray(gbt_predict_proba(model, x))
     assert (p > 0.5).all()
     assert p.std() < 1e-3  # no split on noise → near-constant output
+
+
+def test_repeated_fits_reuse_compiled_program(rng):
+    """CV folds / refits at one shape must hit the module-level jit cache
+    (ops/gbt._boost_jit) — the pre-r5 per-call jax.jit(partial(...))
+    recompiled the whole n_trees-round program on every fit, which
+    dominated wall-clock at CV scale."""
+    from fraud_detection_tpu.ops.gbt import GBTConfig, _boost_jit, gbt_fit
+
+    x = rng.standard_normal((256, 6)).astype(np.float32)
+    y = (rng.random(256) < 0.3).astype(np.int32)
+    cfg = GBTConfig(n_trees=3, max_depth=3, learning_rate=0.5)
+    before = _boost_jit._cache_size()
+    m1 = gbt_fit(x, y, cfg)
+    after_first = _boost_jit._cache_size()
+    assert after_first == before + 1  # this (shape, cfg) is new → one entry
+    m2 = gbt_fit(x, y, cfg)
+    assert _boost_jit._cache_size() == after_first  # second fit: cache hit
+    np.testing.assert_array_equal(
+        np.asarray(m1.split_feature), np.asarray(m2.split_feature)
+    )
