@@ -21,6 +21,7 @@ artifact export) is solver-agnostic.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import NamedTuple
 
@@ -167,6 +168,26 @@ def logistic_fit_lbfgs(
 # ---------------------------------------------------------------------------
 
 
+@functools.lru_cache(maxsize=16)
+def _sharded_epoch(mesh, c: float, n_total: int, ndev: int, momentum: float,
+                   batch: int):
+    """Jitted shard_map SGD epoch for these hyperparameters — cached at
+    module level so repeated fits (bench warmup→timed, back-to-back
+    training jobs in one process) compile the epoch program ONCE. A
+    per-call jax.jit(shard_map(...)) (the pre-r5 shape) recompiled on
+    every logistic_fit_sgd invocation."""
+    return jax.jit(
+        shard_map(
+            _sgd_epoch_fn(c, n_total, ndev, momentum, batch),
+            mesh=mesh,
+            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS), P(), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+
+
 def _sgd_epoch_fn(
     c: float, n_total: int, n_devices: int, momentum: float, batch: int
 ):
@@ -283,16 +304,9 @@ def logistic_fit_sgd(
     valid_dev, _ = shard_batch(valid, mesh)
 
     n_local = x_pad.shape[0] // ndev
-    epoch_fn = _sgd_epoch_fn(float(c), n, ndev, momentum, batch_size)
-
-    sharded_epoch = shard_map(
-        epoch_fn,
-        mesh=mesh,
-        in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
-        out_specs=(P(), P()),
-        check_vma=False,
+    sharded_epoch = _sharded_epoch(
+        mesh, float(c), n, ndev, momentum, batch_size
     )
-    sharded_epoch = jax.jit(sharded_epoch)
 
     d = x_pad.shape[1]
     params = LogisticParams(coef=jnp.zeros((d,), jnp.float32), intercept=jnp.zeros(()))

@@ -62,3 +62,20 @@ def test_sgd_reaches_lbfgs_auc(imbalanced_data):
     auc_l = roc_auc_score(y, np.asarray(predict_proba(p_lbfgs, x)))
     auc_s = roc_auc_score(y, np.asarray(predict_proba(p_sgd, x)))
     assert auc_s > auc_l - 5e-3
+
+
+def test_repeated_sgd_fits_reuse_compiled_epoch(rng):
+    """Back-to-back SGD fits with one hyperparameter set must reuse the
+    module-level jitted epoch program (ops/logistic._sharded_epoch) — the
+    pre-r5 per-call jax.jit(shard_map(...)) recompiled every fit."""
+    from fraud_detection_tpu.ops.logistic import _sharded_epoch, logistic_fit_sgd
+
+    x = rng.standard_normal((256, 8)).astype(np.float32)
+    y = (rng.random(256) < 0.3).astype(np.int32)
+    _sharded_epoch.cache_clear()
+    logistic_fit_sgd(x, y, epochs=1, batch_size=32, seed=0)
+    info = _sharded_epoch.cache_info()
+    assert info.misses == 1
+    logistic_fit_sgd(x, y, epochs=2, batch_size=32, seed=1)
+    info = _sharded_epoch.cache_info()
+    assert info.hits >= 1 and info.misses == 1  # second fit: cache hit
