@@ -169,16 +169,17 @@ def logistic_fit_lbfgs(
 
 
 @functools.lru_cache(maxsize=16)
-def _sharded_epoch(mesh, c: float, n_total: int, ndev: int, momentum: float,
-                   batch: int):
+def _sharded_epoch(mesh, c: float, n_total: int, momentum: float, batch: int):
     """Jitted shard_map SGD epoch for these hyperparameters — cached at
     module level so repeated fits (bench warmup→timed, back-to-back
     training jobs in one process) compile the epoch program ONCE. A
     per-call jax.jit(shard_map(...)) (the pre-r5 shape) recompiled on
-    every logistic_fit_sgd invocation."""
+    every logistic_fit_sgd invocation. The device count comes from the
+    mesh (already in the key) so the psum-scaled reg term can never see
+    a mismatched ndev."""
     return jax.jit(
         shard_map(
-            _sgd_epoch_fn(c, n_total, ndev, momentum, batch),
+            _sgd_epoch_fn(c, n_total, mesh.shape[DATA_AXIS], momentum, batch),
             mesh=mesh,
             in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                       P(DATA_AXIS), P(), P()),
@@ -304,9 +305,7 @@ def logistic_fit_sgd(
     valid_dev, _ = shard_batch(valid, mesh)
 
     n_local = x_pad.shape[0] // ndev
-    sharded_epoch = _sharded_epoch(
-        mesh, float(c), n, ndev, momentum, batch_size
-    )
+    sharded_epoch = _sharded_epoch(mesh, float(c), n, momentum, batch_size)
 
     d = x_pad.shape[1]
     params = LogisticParams(coef=jnp.zeros((d,), jnp.float32), intercept=jnp.zeros(()))
