@@ -45,6 +45,7 @@ multiplying the minority-class sample weight (train_model.py:52-54).
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from functools import partial
 from typing import NamedTuple
@@ -147,6 +148,36 @@ def bin_features(x: jax.Array, bin_edges: jax.Array) -> jax.Array:
 _HIST_BLOCK = 4096
 
 
+def _use_matmul_hist(platform: str | None = None) -> bool:
+    """Histogram impl dispatch: one-hot MXU matmuls on TPU (the systolic
+    array does the dense contraction at full rate; scatter retires ~1
+    update/cycle), segment_sum scatter-adds elsewhere (on CPU the matmul's
+    32× dense FLOPs plus emulated bf16 lose badly to cheap scatter —
+    measured ~10× slower end-to-end on the 20k-row train CLI).
+    ``platform`` is the platform of the devices the fit actually runs on
+    (a sharded fit's mesh may not be on the default backend); default
+    backend otherwise. ``GBT_MATMUL_HIST=0|1`` overrides."""
+    env = os.environ.get("GBT_MATMUL_HIST")
+    if env is not None:
+        return env.lower() not in ("0", "false", "no", "off")
+    return (platform or jax.default_backend()) == "tpu"
+
+
+def _hist_segment(binned, local, g, h, n_nodes: int, n_bins: int):
+    """(d, n_nodes, n_bins, 2) grad/hess histograms via segment_sum
+    scatter-adds keyed on ``local·n_bins + bin`` — the CPU-friendly path
+    (and the numerical reference: no bf16 rounding of g/h)."""
+    seg = local[:, None] * n_bins + binned  # (n, d) segment ids per feature
+    n_seg = n_nodes * n_bins
+    gh = jnp.stack([g, h], axis=1)  # (n, 2)
+
+    def hist_one_feature(seg_f):
+        return jax.ops.segment_sum(gh, seg_f, num_segments=n_seg)
+
+    hist = jax.vmap(hist_one_feature, in_axes=1)(seg)  # (d, n_seg, 2)
+    return hist.reshape(binned.shape[1], n_nodes, n_bins, 2)
+
+
 def _hist_matmul(binned, local, g, h, n_nodes: int, n_bins: int):
     """(d, n_nodes, n_bins, 2) grad/hess histograms as MXU contractions.
 
@@ -201,7 +232,8 @@ def _hist_matmul(binned, local, g, h, n_nodes: int, n_bins: int):
     return jnp.transpose(acc, (2, 1, 3, 0))  # (d, n_nodes, n_bins, 2)
 
 
-def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None):
+def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None,
+               matmul_hist: bool = True):
     """Grow one static-depth tree; returns (split_feature, split_bin,
     leaf_value, row_leaf) with ``row_leaf`` the bottom-level leaf index of
     every row (used to update logits without re-traversal).
@@ -230,7 +262,8 @@ def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None):
         n_nodes = 2**level
         local = node - level_base
 
-        hist = _hist_matmul(binned, local, g, h, n_nodes, n_bins)
+        hist_fn = _hist_matmul if matmul_hist else _hist_segment
+        hist = hist_fn(binned, local, g, h, n_nodes, n_bins)
         if axis_name is not None:
             hist = jax.lax.psum(hist, axis_name)
 
@@ -297,7 +330,8 @@ def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None):
     return feat, thresh, leaf_value, row_leaf
 
 
-def _boost(binned, y, w, base_logit, cfg: GBTConfig, axis_name=None):
+def _boost(binned, y, w, base_logit, cfg: GBTConfig, axis_name=None,
+           matmul_hist: bool = True):
     """Scan over boosting rounds; returns stacked tree arrays.
 
     ``w`` carries both padding validity (0 ⇒ inert) and scale_pos_weight.
@@ -313,7 +347,9 @@ def _boost(binned, y, w, base_logit, cfg: GBTConfig, axis_name=None):
         p = jax.nn.sigmoid(logits)
         g = w * (p - y)
         h = jnp.maximum(w * p * (1.0 - p), 1e-16) * jnp.sign(w)
-        feat, thresh, leaf, row_leaf = _grow_tree(binned, g, h, cfg, axis_name)
+        feat, thresh, leaf, row_leaf = _grow_tree(
+            binned, g, h, cfg, axis_name, matmul_hist
+        )
         logits = logits + leaf[row_leaf]
         return logits, (feat, thresh, leaf)
 
@@ -325,16 +361,19 @@ def _boost(binned, y, w, base_logit, cfg: GBTConfig, axis_name=None):
     return feats, threshs, leaves
 
 
-_boost_jit = jax.jit(_boost, static_argnames=("cfg", "axis_name"))
+_boost_jit = jax.jit(
+    _boost, static_argnames=("cfg", "axis_name", "matmul_hist")
+)
 
 
 @functools.lru_cache(maxsize=8)
-def _sharded_boost(mesh, cfg: GBTConfig):
+def _sharded_boost(mesh, cfg: GBTConfig, matmul_hist: bool):
     """Jitted shard_map boosting step for (mesh, cfg) — cached so repeated
     sharded fits (CV folds, dryrun equality checks) compile once."""
     return jax.jit(
         shard_map(
-            partial(_boost, cfg=cfg, axis_name=DATA_AXIS),
+            partial(_boost, cfg=cfg, axis_name=DATA_AXIS,
+                    matmul_hist=matmul_hist),
             mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
             out_specs=(P(), P(), P()),
@@ -370,14 +409,17 @@ def gbt_fit(
     base_logit = jnp.float32(np.log(cfg.base_score / (1.0 - cfg.base_score)))
 
     if not sharded:
+        matmul_hist = _use_matmul_hist()
         binned = bin_features(jnp.asarray(x_np), edges_dev)
         feats, threshs, leaves = _boost_jit(
-            binned, jnp.asarray(y_np), jnp.asarray(w), base_logit, cfg=cfg
+            binned, jnp.asarray(y_np), jnp.asarray(w), base_logit, cfg=cfg,
+            matmul_hist=matmul_hist,
         )
     else:
         from fraud_detection_tpu.parallel.mesh import default_mesh
 
         mesh = mesh or default_mesh()
+        matmul_hist = _use_matmul_hist(mesh.devices.flat[0].platform)
         ndev = mesh.shape[DATA_AXIS]
         x_pad, _ = pad_to_multiple(x_np, ndev)
         y_pad, _ = pad_to_multiple(y_np, ndev)
@@ -387,10 +429,16 @@ def gbt_fit(
         y_dev, _ = shard_batch(y_pad, mesh)
         w_dev, _ = shard_batch(w_pad, mesh)
 
-        feats, threshs, leaves = _sharded_boost(mesh, cfg)(
+        feats, threshs, leaves = _sharded_boost(mesh, cfg, matmul_hist)(
             x_dev, y_dev, w_dev, base_logit
         )
 
+    # fit() is a synchronous API (sklearn/XGBoost contract): block before
+    # returning. Beyond semantics this is a hard requirement — a process
+    # exiting while the (cached, async-dispatched) boost program is still
+    # executing segfaults in XLA teardown (reproduced 5/6 on the CPU
+    # backend; blocked runs 6/6 clean).
+    feats, threshs, leaves = jax.block_until_ready((feats, threshs, leaves))
     return GBTModel(
         split_feature=feats,
         split_bin=threshs,

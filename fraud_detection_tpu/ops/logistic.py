@@ -358,4 +358,7 @@ def logistic_fit_sgd(
         )
         if epoch_callback is not None:
             epoch_callback(e, params, velocity, rng, fingerprint)
-    return params
+    # fit() is synchronous (sklearn contract) — and exiting a process while
+    # the cached shard_map epoch program is still executing asynchronously
+    # segfaults in XLA teardown (see gbt_fit's matching note).
+    return jax.block_until_ready(params)
