@@ -71,6 +71,7 @@ def eda(data_csv: str | None = None, plots_dir: str = "plots",
 
 
 def main(argv=None):
+    config.apply_device_backend()  # DEVICE=cpu runs without the TPU tunnel
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data", default=None)
     ap.add_argument("--plots-dir", default="plots")

@@ -114,6 +114,7 @@ def _render_plots(cm, scores, y_test, auc, plots_dir: str) -> None:
 
 
 def main(argv=None):
+    config.apply_device_backend()  # DEVICE=cpu runs without the TPU tunnel
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data", default=None)

@@ -90,6 +90,7 @@ def _render(phi, x_test, names, order, plots_dir: str) -> None:
 
 
 def main(argv=None):
+    config.apply_device_backend()  # DEVICE=cpu runs without the TPU tunnel
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data", default=None)

@@ -61,6 +61,7 @@ _DEMO_ROW = {
 
 
 def main(argv=None):
+    config.apply_device_backend()  # DEVICE=cpu runs without the TPU tunnel
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--json", default=None, help="JSON object of features")

@@ -71,6 +71,7 @@ def preprocess(
 
 
 def main(argv=None):
+    config.apply_device_backend()  # DEVICE=cpu runs without the TPU tunnel
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--data", default=None)

@@ -52,6 +52,7 @@ def validate_auc(
 
 
 def main(argv=None):
+    config.apply_device_backend()  # DEVICE=cpu runs without the TPU tunnel
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model-uri", default=None)
