@@ -157,3 +157,42 @@ def test_repeated_fits_reuse_compiled_program(rng):
     np.testing.assert_array_equal(
         np.asarray(m1.split_feature), np.asarray(m2.split_feature)
     )
+
+
+def test_matmul_and_segment_histograms_agree(rng, monkeypatch):
+    """The MXU one-hot matmul histogram path (TPU dispatch) must be
+    QUALITY-equivalent to the segment_sum path (CPU dispatch). The two are
+    not bit-identical by design: bf16 rounding of g/h in the matmul
+    operands (~0.4% per element) flips near-tie split choices, which then
+    cascade — so the invariant is histogram agreement to bf16 tolerance
+    and matching model quality, not identical trees. The backend dispatch
+    means CPU suites would otherwise never execute the matmul path."""
+    from fraud_detection_tpu.ops.gbt import _hist_matmul, _hist_segment
+
+    # histogram cells agree to bf16 tolerance (the direct kernel contract)
+    import jax.numpy as jnp
+
+    n, d, n_bins, n_nodes = 2048, 10, 64, 4
+    binned = jnp.asarray(rng.integers(0, n_bins, (n, d)), jnp.int32)
+    local = jnp.asarray(rng.integers(0, n_nodes, (n,)), jnp.int32)
+    g = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    h = jnp.asarray(rng.random(n).astype(np.float32) * 0.25)
+    hs = np.asarray(_hist_segment(binned, local, g, h, n_nodes, n_bins))
+    hm = np.asarray(_hist_matmul(binned, local, g, h, n_nodes, n_bins))
+    np.testing.assert_allclose(hm, hs, atol=0.05)
+
+    # end-to-end: both paths learn the same signal to the same quality
+    x = rng.standard_normal((2048, 10)).astype(np.float32)
+    w = rng.standard_normal(10).astype(np.float32)
+    y = (x @ w + 0.3 * rng.standard_normal(2048) > 0.8).astype(np.int32)
+    cfg = GBTConfig(n_trees=8, max_depth=4, learning_rate=0.3)
+    monkeypatch.setenv("GBT_MATMUL_HIST", "0")
+    m_seg = gbt_fit(x, y, cfg)
+    monkeypatch.setenv("GBT_MATMUL_HIST", "1")
+    m_mm = gbt_fit(x, y, cfg)
+    p_seg = np.asarray(gbt_predict_proba(m_seg, x))
+    p_mm = np.asarray(gbt_predict_proba(m_mm, x))
+    auc_seg = roc_auc_score(y, p_seg)
+    auc_mm = roc_auc_score(y, p_mm)
+    assert abs(auc_seg - auc_mm) < 0.01, (auc_seg, auc_mm)
+    assert np.corrcoef(p_seg, p_mm)[0, 1] > 0.98
