@@ -307,19 +307,23 @@ def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None,
         go_right = binned[rows, row_f] > row_b
         node = 2 * node + 1 + go_right.astype(jnp.int32)
 
-    # Leaf values from bottom-level statistics: -G/(H+λ), Newton step. Same
-    # one-hot contraction as the histograms (32 columns — trivial work).
+    # Leaf values from bottom-level statistics: -G/(H+λ), Newton step —
+    # same impl dispatch as the histograms, so the segment path stays the
+    # exact-f32 numerical reference end to end.
     leaf_base = 2**depth - 1
     row_leaf = node - leaf_base
     n_leaves = 2**depth
-    a = (row_leaf[:, None] == jnp.arange(n_leaves)[None, :])
     gh = jnp.stack([g, h], axis=1)
-    leaf_gh = jax.lax.dot_general(
-        a.astype(jnp.bfloat16),
-        gh.astype(jnp.bfloat16),
-        (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # (n_leaves, 2)
+    if matmul_hist:
+        a = (row_leaf[:, None] == jnp.arange(n_leaves)[None, :])
+        leaf_gh = jax.lax.dot_general(
+            a.astype(jnp.bfloat16),
+            gh.astype(jnp.bfloat16),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (n_leaves, 2)
+    else:
+        leaf_gh = jax.ops.segment_sum(gh, row_leaf, num_segments=n_leaves)
     if axis_name is not None:
         leaf_gh = jax.lax.psum(leaf_gh, axis_name)
     leaf_value = jnp.where(

@@ -328,12 +328,16 @@ def knn_topk(
     old (256, 1024) blocking. For small minority sets the key block shrinks
     to the padded set size so tiny inputs don't pay 4096-wide tiles."""
     m = int(np.shape(x_min)[0])
-    # shrink blocks for small sets: smallest power-of-two ≥ m, floor LANE
+    # shrink blocks for small sets: smallest power-of-two ≥ m, floor LANE.
+    # block_q is clamped only when the auto-shrink actually reduced
+    # block_k below it — an explicitly-passed block_q > block_k is a valid
+    # configuration (the divisibility check below covers it).
     fit = LANE
     while fit < min(m, block_k):
         fit *= 2
-    block_k = min(block_k, fit)
-    block_q = min(block_q, block_k)
+    if fit < block_k:
+        block_k = fit
+        block_q = min(block_q, block_k)
     big, small = max(block_q, block_k), min(block_q, block_k)
     if big % small != 0:
         # Rows are padded to max(block_q, block_k); non-commensurate blocks

@@ -528,6 +528,12 @@ class StoreServer:
                                     "upstream %s (epoch %d < ours %d)",
                                     self.replicate_from, up_epoch, self.epoch,
                                 )
+                                # back off: every resubscribe makes the
+                                # stale upstream serialize a full DB dump
+                                # under its publish lock — don't hammer it
+                                # at RESYNC_INTERVAL while the sentinels
+                                # converge
+                                self._stop.wait(5 * RESYNC_INTERVAL)
                                 break
                             # Apply under _pub_lock with a generation
                             # re-check: a promote/re-point racing this recv

@@ -136,3 +136,29 @@ def test_stream_out_dtypes(rng):
     assert f16.dtype == np.float32 and u8.dtype == np.float32
     np.testing.assert_allclose(f16, ref, atol=2e-3)
     np.testing.assert_allclose(u8, ref, atol=1.0 / 255 + 1e-6)
+
+
+def test_stream_many_chunks_many_threads(rng):
+    """Thread-per-chunk stress: many more chunks than workers, odd tail,
+    int8 wire (host-side quantization runs concurrently in the pool) —
+    order and values must match the synchronous path exactly."""
+    from fraud_detection_tpu.ops.scaler import scaler_fit
+
+    d = 30
+    x = rng.standard_normal((20_137, d)).astype(np.float32)
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32), intercept=np.float32(0.1)
+    )
+    s = BatchScorer(params, scaler_fit(x), io_dtype="int8")
+    sync = s.predict_proba(x)
+    stream = s.predict_proba_stream(x, chunk=256, inflight=16, out_dtype="uint8")
+    assert stream.shape == sync.shape
+    # int8-in/uint8-out wire: quantization tolerance, but ORDER must be exact
+    np.testing.assert_allclose(stream, sync, atol=1.0 / 255 + 2e-2)
+    # spot-check order with a distinctive monotone pattern
+    xm = np.tile(np.linspace(-2, 2, 64, dtype=np.float32)[:, None], (40, d))
+    sm = BatchScorer(params, scaler_fit(x))
+    np.testing.assert_allclose(
+        sm.predict_proba_stream(xm, chunk=100, inflight=8),
+        sm.predict_proba(xm), rtol=1e-5, atol=1e-6,
+    )
