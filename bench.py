@@ -520,12 +520,31 @@ def bench_stream_scoring(x, coef, intercept, mean, scale) -> dict[str, float]:
 
 
 def bench_smote(d: int = 30) -> tuple[float, float, float]:
-    """SMOTE oversampling throughput (synthetic rows/s) + roofline estimates
-    for its k-NN core (the blocked distance matmul dominates: 2*n_min^2*d
-    FLOPs, n_min*d + blockwise distance traffic)."""
-    import jax
+    """SMOTE oversampling throughput (synthetic rows/s) + honest roofline
+    numbers for its k-NN core.
 
-    from fraud_detection_tpu.ops.smote import smote
+    Two separate measurements, because they answer different questions:
+
+    - ``smote_rows_per_sec``: the whole ``smote()`` call at the r3-comparable
+      shape (4096 minority / 65536 majority) — what a CV fold pays,
+      including label upload and host shape logic.
+    - k-NN core flops/traffic: the kernel alone at 32768 minority rows —
+      same order as the 10M-row config's CV folds (data/synthetic.py's 1%
+      fraud on 10M rows ≈ 100k minority, ~80k per 5-fold train fold; 32768
+      is the largest same-order size that fits the section budget).
+
+    Both are timed with a FORCED-FETCH barrier: N calls whose results all
+    feed one scalar fetch at the end. On a tunneled chip
+    ``block_until_ready`` can report ready before the device finishes
+    (measured r5: 0.08 ms for a 69-GFLOP kernel — impossible), so it cannot
+    be the timing barrier; a per-call fetch instead pays the full ~70 ms
+    tunnel RTT. The chain makes the final fetch a true completion barrier
+    over all N executions and amortizes the RTT to RTT/N."""
+    import jax
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.ops.pallas_kernels import knn_pallas_enabled, knn_topk
+    from fraud_detection_tpu.ops.smote import _knn_indices, smote
 
     rng = np.random.default_rng(3)
     n_min, n_maj = 4096, 65536
@@ -535,22 +554,54 @@ def bench_smote(d: int = 30) -> tuple[float, float, float]:
     # Device-resident input: train.py applies SMOTE inside CV folds on fold
     # data that already lives on device — re-uploading x per call would
     # charge the k-NN kernel for ~5 ms of tunnel h2d it never causes.
-    xd = jax.numpy.asarray(x)
+    xd = jnp.asarray(x)
+    fetch = jax.jit(lambda r: jnp.sum(r))
     xr, yr = smote(xd, y, key)  # compile + warm
-    xr.block_until_ready()
+    float(fetch(xr))
     n_out = int(xr.shape[0])
-    times = []
+    n_calls = 5
+    rates = []
     for _ in range(3):  # median-of-3 damps tunnel/dispatch jitter
         t0 = time.perf_counter()
-        xr, _ = smote(xd, y, key)
-        xr.block_until_ready()
-        times.append(time.perf_counter() - t0)
-    dt = float(np.median(times))
-    rows_per_sec = n_out / dt
-    knn_flops = 2.0 * n_min * n_min * d / dt
-    # k-NN traffic: minority set read per block-pass + the n_min^2 distance
-    # tile writes/reads through HBM (upper-bound estimate).
-    hbm_bytes = (n_min * d * 4 + n_min * n_min * 4 * 2) / dt
+        acc = None
+        for _ in range(n_calls):
+            xr, _ = smote(xd, y, key)
+            s = fetch(xr)
+            acc = s if acc is None else acc + s
+        float(acc)  # true barrier: depends on every call's output
+        rates.append(n_calls * n_out / (time.perf_counter() - t0))
+    rows_per_sec = float(np.median(rates))
+
+    # ---- k-NN core at CV-fold minority scale, chained + forced fetch
+    use_pallas = knn_pallas_enabled()
+    # The XLA fallback at 32768² is minutes on CPU — shrink so a
+    # USE_PALLAS=0 / DEVICE=cpu run can't blow the section budget and
+    # watchdog-kill the remaining sections.
+    m_core, n_chain, k = (32768, 10, 5) if use_pallas else (8192, 4, 5)
+    xm = jnp.asarray(rng.standard_normal((m_core, d)).astype(np.float32))
+    xm.block_until_ready()
+    core = knn_topk if use_pallas else _knn_indices
+    float(fetch(core(xm, k)))  # warm
+    per_call = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        acc = None
+        for _ in range(n_chain):
+            s = fetch(core(xm, k))
+            acc = s if acc is None else acc + s
+        float(acc)  # true barrier: depends on every chained execution
+        per_call.append((time.perf_counter() - t0) / n_chain)
+    dt = float(np.median(per_call))
+    knn_flops = 2.0 * m_core * m_core * d / dt
+    if use_pallas:
+        # Key set streams from HBM once per 256-row query block (the
+        # kernel's block_q) at lane-padded width, plus one query-set read.
+        keystream = (m_core / 256 + 1) * (m_core * 128 * 4)
+    else:
+        # _knn_indices scans 1024-row query blocks against the unpadded
+        # (m, d) key set.
+        keystream = (m_core / 1024 + 1) * (m_core * d * 4)
+    hbm_bytes = keystream / dt
     return rows_per_sec, knn_flops, hbm_bytes
 
 
