@@ -212,11 +212,27 @@ def _scorer(coef, intercept, mean, scale, **kw):
     )
 
 
+def _window_barrier(last_out) -> None:
+    """True completion barrier for an async dispatch window: fetch one
+    element of the LAST output. The device executes enqueued programs in
+    order (verified on this platform: a cheap program's scalar fetch,
+    dispatched after an expensive program, waits for both), so the last
+    program's completion proves the whole window drained.
+    ``block_until_ready`` is NOT a barrier on tunneled PJRT platforms — it
+    can report ready before the device finishes (measured r5: 0.27 s
+    "ready" for a 5 s boost program) — so every device-side rate in this
+    file ends in a real fetch. The fetch costs one tunnel RTT (~80 ms);
+    rep counts are sized so the dispatch window amortizes it."""
+    import jax.numpy as jnp
+
+    float(jnp.reshape(last_out, (-1,))[0])
+
+
 def bench_dev_scoring(x, coef, intercept, mean, scale) -> float:
     """Device-resident throughput: pre-staged batches (one executable for the
-    (BATCH, d) shape), async-queued, one sync at the end — the steady-state
-    pipeline rate the micro-batching server sustains. Runs before any
-    synchronous d2h section (see bench_shap_device note)."""
+    (BATCH, d) shape), async-queued, one true fetch barrier at the end — the
+    steady-state pipeline rate the micro-batching server sustains. Runs
+    before any synchronous d2h section (see bench_shap_device note)."""
     import jax.numpy as jnp
 
     from fraud_detection_tpu.ops.scorer import _score
@@ -225,17 +241,17 @@ def bench_dev_scoring(x, coef, intercept, mean, scale) -> float:
     batches = [
         jnp.asarray(x[i * BATCH : (i + 1) * BATCH]) for i in range(N_ROWS // BATCH)
     ]
-    _score(scorer.coef, scorer.intercept, batches[0]).block_until_ready()
+    reps = 8 * DEV_REPEATS  # 2048: ~0.16 s dispatch window vs ~0.08 s RTT
+    _window_barrier(_score(scorer.coef, scorer.intercept, batches[0]))
     rates = []
     for _trial in range(3):  # median-of-3 damps tunnel hiccups
         t0 = time.perf_counter()
         outs = [
             _score(scorer.coef, scorer.intercept, batches[i % len(batches)])
-            for i in range(DEV_REPEATS)
+            for i in range(reps)
         ]
-        for o in outs:
-            o.block_until_ready()
-        rates.append(DEV_REPEATS * BATCH / (time.perf_counter() - t0))
+        _window_barrier(outs[-1])
+        rates.append(reps * BATCH / (time.perf_counter() - t0))
     return float(np.median(rates))
 
 
@@ -270,17 +286,20 @@ def bench_shap_device(x, coef, intercept, mean) -> float:
     from fraud_detection_tpu.ops.linear_shap import linear_shap, make_explainer
 
     expl = make_explainer(coef, intercept, background_mean=mean)
+    # 16k-row batches: small enough that the queued outputs of a 1024-rep
+    # window hold ~2 GB HBM, large enough to stay compute-shaped.
+    sb = BATCH // 4
     batches = [
-        jnp.asarray(x[i * BATCH : (i + 1) * BATCH]) for i in range(4)
+        jnp.asarray(x[i * sb : (i + 1) * sb]) for i in range(16)
     ]
-    linear_shap(expl, batches[0]).block_until_ready()
+    reps = 4 * DEV_REPEATS
+    _window_barrier(linear_shap(expl, batches[0]))
     rates = []
     for _trial in range(3):
         t0 = time.perf_counter()
-        outs = [linear_shap(expl, batches[i % 4]) for i in range(DEV_REPEATS)]
-        for o in outs:
-            o.block_until_ready()
-        rates.append(DEV_REPEATS * BATCH / (time.perf_counter() - t0))
+        outs = [linear_shap(expl, batches[i % 16]) for i in range(reps)]
+        _window_barrier(outs[-1])
+        rates.append(reps * sb / (time.perf_counter() - t0))
     return float(np.median(rates))
 
 
@@ -630,26 +649,26 @@ def bench_gbt(x, mean, scale) -> tuple[float, float, float]:
     # program and the reported rate was mostly XLA compile time.)
     gbt_fit(xt, yt, cfg)  # warm: populates the jit cache at this shape
     t0 = time.perf_counter()
-    model = gbt_fit(xt, yt, cfg)  # synchronous: blocks before returning
+    # synchronous with a true d2h fetch barrier inside (ops/gbt)
+    model = gbt_fit(xt, yt, cfg)
     train_rate = n_train / (time.perf_counter() - t0)
 
     batches = [jnp.asarray(x[i * BATCH : (i + 1) * BATCH]) for i in range(4)]
-    gbt_predict_proba(model, batches[0]).block_until_ready()
-    reps = 64
+    _window_barrier(gbt_predict_proba(model, batches[0]))
+    reps = 512
     t0 = time.perf_counter()
     outs = [gbt_predict_proba(model, batches[i % 4]) for i in range(reps)]
-    for o in outs:
-        o.block_until_ready()
+    _window_barrier(outs[-1])
     score_rate = reps * BATCH / (time.perf_counter() - t0)
 
     expl = build_tree_explainer(model, xt[:128])
     shap_batch = 1 << 12
-    tree_shap(expl, batches[0][:shap_batch]).block_until_ready()
+    _window_barrier(tree_shap(expl, batches[0][:shap_batch]))
+    reps = 256
     t0 = time.perf_counter()
-    outs = [tree_shap(expl, batches[i % 4][:shap_batch]) for i in range(16)]
-    for o in outs:
-        o.block_until_ready()
-    shap_rate = 16 * shap_batch / (time.perf_counter() - t0)
+    outs = [tree_shap(expl, batches[i % 4][:shap_batch]) for i in range(reps)]
+    _window_barrier(outs[-1])
+    shap_rate = reps * shap_batch / (time.perf_counter() - t0)
     return train_rate, score_rate, shap_rate
 
 

@@ -54,6 +54,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from fraud_detection_tpu.parallel.mesh import DATA_AXIS
@@ -148,19 +150,39 @@ def bin_features(x: jax.Array, bin_edges: jax.Array) -> jax.Array:
 _HIST_BLOCK = 4096
 
 
-def _use_matmul_hist(platform: str | None = None) -> bool:
-    """Histogram impl dispatch: one-hot MXU matmuls on TPU (the systolic
-    array does the dense contraction at full rate; scatter retires ~1
-    update/cycle), segment_sum scatter-adds elsewhere (on CPU the matmul's
-    32× dense FLOPs plus emulated bf16 lose badly to cheap scatter —
-    measured ~10× slower end-to-end on the 20k-row train CLI).
-    ``platform`` is the platform of the devices the fit actually runs on
-    (a sharded fit's mesh may not be on the default backend); default
-    backend otherwise. ``GBT_MATMUL_HIST=0|1`` overrides."""
+def _hist_impl(platform: str | None = None) -> str:
+    """Histogram impl dispatch → ``pallas`` | ``matmul`` | ``segment``.
+
+    - ``pallas``: hand-blocked kernel (:func:`_hist_pallas`) — the row block
+      and both one-hots stay in VMEM, honest-barrier measured 2.2× the XLA
+      matmul path on a v5e chip (8.0 vs 17.9 ms/level at the bench shape).
+      TPU default.
+    - ``matmul``: XLA one-hot matmuls (`_hist_matmul`) — the TPU fallback
+      (``USE_PALLAS=0``) and the sharded path (pallas under ``shard_map``
+      is not exercised; the XLA path shards cleanly).
+    - ``segment``: ``segment_sum`` scatter-adds — CPU (the matmul's 32×
+      dense FLOPs plus emulated bf16 lose badly to cheap scatter; measured
+      ~10× slower end-to-end on the 20k-row train CLI), and the exact-f32
+      numerical reference.
+
+    ``platform`` is the platform of the devices the fit actually runs on (a
+    sharded fit's mesh may not be on the default backend); default backend
+    otherwise. Overrides: ``GBT_HIST=pallas|matmul|segment`` picks directly;
+    the older ``GBT_MATMUL_HIST=0|1`` still forces segment/matmul."""
+    env = os.environ.get("GBT_HIST")
+    if env in ("pallas", "matmul", "segment"):
+        return env
     env = os.environ.get("GBT_MATMUL_HIST")
     if env is not None:
-        return env.lower() not in ("0", "false", "no", "off")
-    return (platform or jax.default_backend()) == "tpu"
+        return (
+            "matmul" if env.lower() not in ("0", "false", "no", "off")
+            else "segment"
+        )
+    if (platform or jax.default_backend()) != "tpu":
+        return "segment"
+    from fraud_detection_tpu.ops.pallas_kernels import _flag_state
+
+    return "matmul" if _flag_state() == "off" else "pallas"
 
 
 def _hist_segment(binned, local, g, h, n_nodes: int, n_bins: int):
@@ -232,8 +254,76 @@ def _hist_matmul(binned, local, g, h, n_nodes: int, n_bins: int):
     return jnp.transpose(acc, (2, 1, 3, 0))  # (d, n_nodes, n_bins, 2)
 
 
+# Rows per Pallas grid step. At 8192 the int32 bin block, the (bs, 2·nodes)
+# weight strip, and the per-feature one-hot all fit VMEM double-buffered with
+# the (2·nodes, d·n_bins) f32 accumulator (≤2 MB at depth 6); 8192 measured
+# fastest of {2048, 4096, 8192} on a v5e chip.
+_HIST_PALLAS_BLOCK = 8192
+
+
+def _hist_pallas_kernel(bb_ref, aw_ref, out_ref, *, d: int, n_bins: int):
+    """One row-block step: out += awᵀ @ onehot(bins), one matmul per feature.
+
+    The bin one-hot is rebuilt in VMEM per block (never hits HBM), so the
+    kernel streams only the int32 bin ids + the bf16 node/grad strip —
+    ~24 MB/level at the bench shape vs ~2 GB for a materialized one-hot.
+    Feature-tiled variants (one matmul per FT features) trip a Mosaic
+    lowering bug on 3-D iota+reshape; the per-feature loop is what ships.
+    """
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _zero():
+        out_ref[:] = jnp.zeros_like(out_ref[:])
+
+    bb = bb_ref[:]          # (bs, d) int32 bin ids
+    aw = aw_ref[:]          # (bs, 2·n_nodes) bf16 node-masked [g, h]
+    bins = jax.lax.broadcasted_iota(jnp.int32, (bb.shape[0], n_bins), 1)
+    for f in range(d):
+        onehot = (bb[:, f][:, None] == bins).astype(jnp.bfloat16)
+        out_ref[:, f * n_bins : (f + 1) * n_bins] += jax.lax.dot_general(
+            aw, onehot, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+def _hist_pallas(binned, local, g, h, n_nodes: int, n_bins: int,
+                 interpret: bool = False):
+    """(d, n_nodes, n_bins, 2) grad/hess histograms via the hand-blocked
+    Pallas kernel — same contraction as :func:`_hist_matmul`, same bf16
+    rounding of g/h, but the row block and both one-hots pinned in VMEM."""
+    n, d = binned.shape
+    bs = min(_HIST_PALLAS_BLOCK, max(256, n))
+    pad = (-n) % bs
+    nodes = jnp.arange(n_nodes, dtype=local.dtype)
+    a = local[:, None] == nodes
+    aw = jnp.concatenate(
+        [jnp.where(a, g[:, None], 0.0), jnp.where(a, h[:, None], 0.0)],
+        axis=1,
+    ).astype(jnp.bfloat16)  # (n, 2·n_nodes)
+    if pad:
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+        aw = jnp.pad(aw, ((0, pad), (0, 0)))  # zero weight ⇒ inert rows
+    m = 2 * n_nodes
+    acc = pl.pallas_call(
+        partial(_hist_pallas_kernel, d=d, n_bins=n_bins),
+        grid=(binned.shape[0] // bs,),
+        in_specs=[
+            pl.BlockSpec((bs, d), lambda j: (j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bs, m), lambda j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (m, d * n_bins), lambda j: (0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, d * n_bins), jnp.float32),
+        interpret=interpret,
+    )(binned, aw)
+    acc = acc.reshape(2, n_nodes, d, n_bins)
+    return jnp.transpose(acc, (2, 1, 3, 0))  # (d, n_nodes, n_bins, 2)
+
+
 def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None,
-               matmul_hist: bool = True):
+               hist_impl: str = "matmul", hist_interpret: bool = False):
     """Grow one static-depth tree; returns (split_feature, split_bin,
     leaf_value, row_leaf) with ``row_leaf`` the bottom-level leaf index of
     every row (used to update logits without re-traversal).
@@ -243,7 +333,9 @@ def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None,
     shards grow identical trees from global statistics. The level loop is a
     Python loop (depth is static): level L's histograms/one-hots are sized
     to its 2^L live nodes instead of a 2^depth static bound, a 5× FLOP
-    saving at depth 5.
+    saving at depth 5. ``hist_impl`` picks the histogram kernel (see
+    :func:`_hist_impl`); ``hist_interpret`` runs the Pallas kernel in
+    interpreter mode (CPU tests).
     """
     n, d = binned.shape
     n_bins = cfg.n_bins
@@ -262,8 +354,14 @@ def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None,
         n_nodes = 2**level
         local = node - level_base
 
-        hist_fn = _hist_matmul if matmul_hist else _hist_segment
-        hist = hist_fn(binned, local, g, h, n_nodes, n_bins)
+        if hist_impl == "pallas":
+            hist = _hist_pallas(
+                binned, local, g, h, n_nodes, n_bins, interpret=hist_interpret
+            )
+        elif hist_impl == "matmul":
+            hist = _hist_matmul(binned, local, g, h, n_nodes, n_bins)
+        else:
+            hist = _hist_segment(binned, local, g, h, n_nodes, n_bins)
         if axis_name is not None:
             hist = jax.lax.psum(hist, axis_name)
 
@@ -314,7 +412,7 @@ def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None,
     row_leaf = node - leaf_base
     n_leaves = 2**depth
     gh = jnp.stack([g, h], axis=1)
-    if matmul_hist:
+    if hist_impl != "segment":
         a = (row_leaf[:, None] == jnp.arange(n_leaves)[None, :])
         leaf_gh = jax.lax.dot_general(
             a.astype(jnp.bfloat16),
@@ -335,7 +433,7 @@ def _grow_tree(binned, g, h, cfg: GBTConfig, axis_name: str | None,
 
 
 def _boost(binned, y, w, base_logit, cfg: GBTConfig, axis_name=None,
-           matmul_hist: bool = True):
+           hist_impl: str = "matmul", hist_interpret: bool = False):
     """Scan over boosting rounds; returns stacked tree arrays.
 
     ``w`` carries both padding validity (0 ⇒ inert) and scale_pos_weight.
@@ -347,12 +445,16 @@ def _boost(binned, y, w, base_logit, cfg: GBTConfig, axis_name=None,
     program, which dominated wall-clock at CV scale.
     """
 
+    # Bin ids ship over the wire in their narrow dtype (uint8 for ≤256
+    # bins); widen on device so the gather/compare kernels see int32.
+    binned = binned.astype(jnp.int32)
+
     def round_step(logits, _):
         p = jax.nn.sigmoid(logits)
         g = w * (p - y)
         h = jnp.maximum(w * p * (1.0 - p), 1e-16) * jnp.sign(w)
         feat, thresh, leaf, row_leaf = _grow_tree(
-            binned, g, h, cfg, axis_name, matmul_hist
+            binned, g, h, cfg, axis_name, hist_impl, hist_interpret
         )
         logits = logits + leaf[row_leaf]
         return logits, (feat, thresh, leaf)
@@ -366,18 +468,18 @@ def _boost(binned, y, w, base_logit, cfg: GBTConfig, axis_name=None,
 
 
 _boost_jit = jax.jit(
-    _boost, static_argnames=("cfg", "axis_name", "matmul_hist")
+    _boost, static_argnames=("cfg", "axis_name", "hist_impl", "hist_interpret")
 )
 
 
 @functools.lru_cache(maxsize=8)
-def _sharded_boost(mesh, cfg: GBTConfig, matmul_hist: bool):
+def _sharded_boost(mesh, cfg: GBTConfig, hist_impl: str):
     """Jitted shard_map boosting step for (mesh, cfg) — cached so repeated
     sharded fits (CV folds, dryrun equality checks) compile once."""
     return jax.jit(
         shard_map(
             partial(_boost, cfg=cfg, axis_name=DATA_AXIS,
-                    matmul_hist=matmul_hist),
+                    hist_impl=hist_impl),
             mesh=mesh,
             in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
             out_specs=(P(), P(), P()),
@@ -412,28 +514,42 @@ def gbt_fit(
     edges_dev = jnp.asarray(edges)
     base_logit = jnp.float32(np.log(cfg.base_score / (1.0 - cfg.base_score)))
 
+    # Bin on HOST and ship bin ids over the wire — uint8 for ≤256 bins is
+    # 4× (vs int32) / 16× (vs raw f32 rows) fewer h2d bytes, and the boost
+    # program needs only bins + labels + weights, never the float matrix.
+    # np.searchsorted(side='left') matches bin_features exactly (same f32
+    # edges, same rule).
+    bin_dtype = np.uint8 if cfg.n_bins <= 256 else np.int32
+    binned_np = np.empty(x_np.shape, dtype=bin_dtype)
+    for f in range(x_np.shape[1]):
+        binned_np[:, f] = np.searchsorted(edges[f], x_np[:, f], side="left")
+
     if not sharded:
-        matmul_hist = _use_matmul_hist()
-        binned = bin_features(jnp.asarray(x_np), edges_dev)
+        hist_impl = _hist_impl()
         feats, threshs, leaves = _boost_jit(
-            binned, jnp.asarray(y_np), jnp.asarray(w), base_logit, cfg=cfg,
-            matmul_hist=matmul_hist,
+            jnp.asarray(binned_np),  # narrow wire; _boost widens on device
+            jnp.asarray(y_np), jnp.asarray(w), base_logit, cfg=cfg,
+            hist_impl=hist_impl,
+            hist_interpret=jax.default_backend() != "tpu",
         )
     else:
         from fraud_detection_tpu.parallel.mesh import default_mesh
 
         mesh = mesh or default_mesh()
-        matmul_hist = _use_matmul_hist(mesh.devices.flat[0].platform)
+        # pallas under shard_map is not exercised; the XLA matmul path
+        # shards cleanly (see _hist_impl).
+        hist_impl = _hist_impl(mesh.devices.flat[0].platform)
+        if hist_impl == "pallas":
+            hist_impl = "matmul"
         ndev = mesh.shape[DATA_AXIS]
-        x_pad, _ = pad_to_multiple(x_np, ndev)
+        b_pad, _ = pad_to_multiple(binned_np, ndev)  # narrow wire, as above
         y_pad, _ = pad_to_multiple(y_np, ndev)
         w_pad, _ = pad_to_multiple(w, ndev)  # pad weight 0 ⇒ g = h = 0, inert
-        binned = bin_features(jnp.asarray(x_pad), edges_dev)
-        x_dev, _ = shard_batch(np.asarray(binned), mesh)
+        x_dev, _ = shard_batch(b_pad, mesh)
         y_dev, _ = shard_batch(y_pad, mesh)
         w_dev, _ = shard_batch(w_pad, mesh)
 
-        feats, threshs, leaves = _sharded_boost(mesh, cfg, matmul_hist)(
+        feats, threshs, leaves = _sharded_boost(mesh, cfg, hist_impl)(
             x_dev, y_dev, w_dev, base_logit
         )
 
@@ -441,8 +557,14 @@ def gbt_fit(
     # returning. Beyond semantics this is a hard requirement — a process
     # exiting while the (cached, async-dispatched) boost program is still
     # executing segfaults in XLA teardown (reproduced 5/6 on the CPU
-    # backend; blocked runs 6/6 clean).
+    # backend; blocked runs 6/6 clean). The barrier is a real d2h fetch of
+    # one output (tiny — the tree arrays are KBs): on tunneled PJRT
+    # platforms block_until_ready can report ready before the device
+    # finishes (measured r5: a 5 s boost program "ready" in 0.27 s), and a
+    # fetch is the only true completion proof. All three arrays come from
+    # the one boost program, so one fetch covers them.
     feats, threshs, leaves = jax.block_until_ready((feats, threshs, leaves))
+    np.asarray(leaves[:1, :1])
     return GBTModel(
         split_feature=feats,
         split_bin=threshs,

@@ -360,5 +360,10 @@ def logistic_fit_sgd(
             epoch_callback(e, params, velocity, rng, fingerprint)
     # fit() is synchronous (sklearn contract) — and exiting a process while
     # the cached shard_map epoch program is still executing asynchronously
-    # segfaults in XLA teardown (see gbt_fit's matching note).
-    return jax.block_until_ready(params)
+    # segfaults in XLA teardown (see gbt_fit's matching note). The barrier
+    # is a real d2h fetch of the (tiny) intercept: on tunneled PJRT
+    # platforms block_until_ready can report ready before the device
+    # finishes, and a fetch is the only true completion proof.
+    params = jax.block_until_ready(params)
+    np.asarray(params.intercept)
+    return params
