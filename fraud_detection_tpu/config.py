@@ -29,6 +29,18 @@ def _get_float(name: str, default: float) -> float:
     return float(v) if v else default
 
 
+def env_flag(name: str) -> bool | None:
+    """Tri-state boolean env flag: ``None`` when unset (caller picks its
+    default), else falsy only for the conventional off tokens. The single
+    parse for every 0|1-style override (GBT_DENSE_PREDICT, the
+    GBT_MATMUL_HIST compat flag, ...) so accepted tokens can't drift
+    between call sites."""
+    v = os.environ.get(name)
+    if v is None:
+        return None
+    return v.lower() not in ("0", "false", "no", "off")
+
+
 # --------------------------------------------------------------------------
 # Data / training (reference: train_model.py:22, preprocess.py:15)
 # --------------------------------------------------------------------------

@@ -58,6 +58,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
+from fraud_detection_tpu import config
 from fraud_detection_tpu.parallel.mesh import DATA_AXIS
 from fraud_detection_tpu.parallel.sharding import pad_to_multiple, shard_batch
 
@@ -167,17 +168,20 @@ def _hist_impl(platform: str | None = None) -> str:
 
     ``platform`` is the platform of the devices the fit actually runs on (a
     sharded fit's mesh may not be on the default backend); default backend
-    otherwise. Overrides: ``GBT_HIST=pallas|matmul|segment`` picks directly;
-    the older ``GBT_MATMUL_HIST=0|1`` still forces segment/matmul."""
+    otherwise. Overrides: ``GBT_HIST=pallas|matmul|segment`` picks directly
+    (anything else raises — a typo must not silently run the default impl
+    under the operator's nose); the older ``GBT_MATMUL_HIST=0|1`` still
+    forces segment/matmul."""
     env = os.environ.get("GBT_HIST")
-    if env in ("pallas", "matmul", "segment"):
-        return env
-    env = os.environ.get("GBT_MATMUL_HIST")
     if env is not None:
-        return (
-            "matmul" if env.lower() not in ("0", "false", "no", "off")
-            else "segment"
-        )
+        if env not in ("pallas", "matmul", "segment"):
+            raise ValueError(
+                f"GBT_HIST must be pallas|matmul|segment, got {env!r}"
+            )
+        return env
+    matmul = config.env_flag("GBT_MATMUL_HIST")
+    if matmul is not None:
+        return "matmul" if matmul else "segment"
     if (platform or jax.default_backend()) != "tpu":
         return "segment"
     from fraud_detection_tpu.ops.pallas_kernels import _flag_state
@@ -596,11 +600,66 @@ def fold_scaler_into_gbt(model: GBTModel, scaler) -> GBTModel:
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def gbt_predict_logits(model: GBTModel, x: jax.Array) -> jax.Array:
-    """Margin prediction: bin once, then traverse every tree level-by-level
-    (a gather per level — no data-dependent control flow, so the whole forest
-    walk is one fused XLA program)."""
+@functools.lru_cache(maxsize=8)
+def _leaf_paths(depth: int) -> tuple[np.ndarray, np.ndarray]:
+    """Static heap-layout path tables: ``nodes[k, l]`` is the internal node
+    visited at level k on the way to leaf l, ``bits[k, l]`` the go-right
+    decision that continues toward l. Pure functions of the (static) depth —
+    leaf l's path is just its binary expansion."""
+    n_leaves = 2**depth
+    nodes = np.zeros((depth, n_leaves), np.int32)
+    bits = np.zeros((depth, n_leaves), bool)
+    for leaf in range(n_leaves):
+        node = 0
+        for k in range(depth):
+            b = (leaf >> (depth - 1 - k)) & 1
+            nodes[k, leaf] = node
+            bits[k, leaf] = bool(b)
+            node = 2 * node + 1 + b
+    return nodes, bits
+
+
+def _predict_logits_dense(model: GBTModel, x: jax.Array) -> jax.Array:
+    """Margin prediction as DENSE vector ops (the GEMM-style tree-inference
+    trick, cf. Hummingbird): evaluate every internal node's comparison for
+    every (row, tree) at once, then select each leaf by AND-ing its path's
+    decisions via the static heap tables (:func:`_leaf_paths`), and reduce
+    ``Σ leaf_value·indicator``.
+
+    The TPU path: the level-by-level walk is a per-(row, tree, level)
+    gather chain, and gathers retire ~element/cycle on the TPU
+    scatter/gather unit — the walk measured ~195k rows/s honest (r5). Here
+    the only gather is ``take`` with indices SHARED across rows (a column
+    permutation); everything after is compare/select and one fused
+    reduction, and the leaf each row lands in is exactly the walk's."""
+    binned = bin_features(x.astype(jnp.float32), model.bin_edges)
+    n = binned.shape[0]
+    n_trees, n_internal = model.split_feature.shape
+    depth = int(np.log2(n_internal + 1))
+    nodes, bits = _leaf_paths(depth)
+
+    # (n, T·ni): row r's bin of the feature each (tree, node) splits on.
+    feat_flat = model.split_feature.reshape(-1)
+    go_right = (
+        jnp.take(binned, feat_flat, axis=1)
+        > model.split_bin.reshape(-1)[None, :]
+    ).reshape(n, n_trees, n_internal)
+
+    # Leaf indicator: AND of the depth decisions along each leaf's static
+    # path. nodes/bits indexing is static → slices/permutes, no gathers.
+    ind = None
+    for k in range(depth):
+        sel = go_right[:, :, nodes[k]] == jnp.asarray(bits[k])[None, None, :]
+        ind = sel if ind is None else ind & sel
+    contrib = jnp.where(ind, model.leaf_value[None, :, :], 0.0)
+    return model.base_logit + jnp.sum(contrib, axis=(1, 2))
+
+
+def _predict_logits_walk(model: GBTModel, x: jax.Array) -> jax.Array:
+    """Margin prediction by level-wise traversal (a gather per level) — the
+    CPU path: gathers are cheap there and the walk touches ~50× fewer
+    elements than the dense form (measured 6× faster on the CPU backend at
+    the serving batch shape)."""
     binned = bin_features(x.astype(jnp.float32), model.bin_edges)
     n = binned.shape[0]
     n_internal = model.split_feature.shape[1]
@@ -610,9 +669,7 @@ def gbt_predict_logits(model: GBTModel, x: jax.Array) -> jax.Array:
         feat, thresh, leaf = tree
 
         def level(l, node):
-            f = feat[node]
-            t = thresh[node]
-            go_right = binned[jnp.arange(n), f] > t
+            go_right = binned[jnp.arange(n), feat[node]] > thresh[node]
             return 2 * node + 1 + go_right.astype(jnp.int32)
 
         node = jax.lax.fori_loop(0, depth, level, jnp.zeros((n,), jnp.int32))
@@ -627,7 +684,31 @@ def gbt_predict_logits(model: GBTModel, x: jax.Array) -> jax.Array:
     return logits
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("dense", "proba"))
+def _predict_jit(model: GBTModel, x: jax.Array, dense: bool, proba: bool):
+    logits = (
+        _predict_logits_dense(model, x) if dense
+        else _predict_logits_walk(model, x)
+    )
+    return jax.nn.sigmoid(logits) if proba else logits
+
+
+def _use_dense_predict() -> bool:
+    """Scoring impl dispatch (mirrors :func:`_hist_impl`): dense leaf
+    indicators on TPU, gather walk elsewhere. Both produce the same leaf
+    per row — they differ only in the f32 order of the over-trees sum.
+    ``GBT_DENSE_PREDICT=0|1`` overrides."""
+    env = config.env_flag("GBT_DENSE_PREDICT")
+    if env is not None:
+        return env
+    return jax.default_backend() == "tpu"
+
+
+def gbt_predict_logits(model: GBTModel, x: jax.Array) -> jax.Array:
+    """Margin prediction, ``XGBClassifier``'s decision_function analogue."""
+    return _predict_jit(model, x, _use_dense_predict(), False)
+
+
 def gbt_predict_proba(model: GBTModel, x: jax.Array) -> jax.Array:
     """P(class=1), matching ``XGBClassifier.predict_proba[:, 1]``."""
-    return jax.nn.sigmoid(gbt_predict_logits(model, x))
+    return _predict_jit(model, x, _use_dense_predict(), True)

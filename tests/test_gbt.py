@@ -239,3 +239,19 @@ def test_pallas_histograms_match_matmul(rng, monkeypatch):
     auc_mm = roc_auc_score(y, p_mm)
     assert abs(auc_pl - auc_mm) < 0.01, (auc_pl, auc_mm)
     assert np.corrcoef(p_pl, p_mm)[0, 1] > 0.98
+
+
+def test_dense_and_walk_predictions_agree(rng, monkeypatch):
+    """The dense leaf-indicator scorer (TPU dispatch, r5) must put every row
+    in exactly the leaf the gather walk does — identical probabilities up
+    to the f32 order of the over-trees sum."""
+    x = rng.standard_normal((1500, 8)).astype(np.float32)
+    w = rng.standard_normal(8).astype(np.float32)
+    y = (x @ w > 0.3).astype(np.int32)
+    model = gbt_fit(x, y, GBTConfig(n_trees=12, max_depth=5, n_bins=64))
+    xq = rng.standard_normal((513, 8)).astype(np.float32)  # odd batch
+    monkeypatch.setenv("GBT_DENSE_PREDICT", "1")
+    p_dense = np.asarray(gbt_predict_proba(model, xq))
+    monkeypatch.setenv("GBT_DENSE_PREDICT", "0")
+    p_walk = np.asarray(gbt_predict_proba(model, xq))
+    np.testing.assert_allclose(p_dense, p_walk, atol=2e-6)
