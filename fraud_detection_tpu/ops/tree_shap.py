@@ -30,8 +30,10 @@ cheap:
 Shapley values then follow from the subset-marginal formula with weights
 ``|S|!(u−|S|−1)!/u!`` over the ``u ≤ depth`` distinct path features. Exact
 (verified against brute-force subset enumeration in tests), no sampling, and
-every step is a static-shape XLA program: ``scan`` over trees, ``vmap`` over
-rows, gathers/products over (leaf, mask, level) axes.
+every step is a static-shape XLA program: one ``scan`` over trees carrying
+all-rows tensors — shared-index takes and one-hot matmuls only, no per-row
+gather or scatter anywhere (the scatter/gather unit is the TPU's weak spot;
+see ``tree_shap``'s docstring).
 
 Complexity per explained row: O(trees · 2^depth · 2^depth · depth), ~1.6M
 flops for the reference recipe (100 trees, depth 5) — microseconds on MXU;
@@ -157,68 +159,86 @@ def build_tree_explainer(
 @jax.jit
 def tree_shap(explainer: TreeShapExplainer, x: jax.Array) -> jax.Array:
     """SHAP values (n, d) in margin (logit) space; exact:
-    ``Σ_j φ_j + expected_value == gbt_predict_logits(model, x)``."""
+    ``Σ_j φ_j + expected_value == gbt_predict_logits(model, x)``.
+
+    Batched so NO scatter exists (r5 — the previous vmap-over-rows form
+    segment-summed per (row, tree): a batched scatter on the TPU's
+    scatter/gather unit; measured 228k rows/s honest on the chip): the
+    tree scan runs over all-rows tensors and the per-feature scatter is a
+    one-hot matmul on the MXU (HIGHEST precision — exact for these
+    operands' f32 values). The remaining index ops are shared-index
+    gathers (column permutations), which vectorize."""
     model = explainer.model
     d_features = model.bin_edges.shape[0]
     depth = int(np.log2(model.split_feature.shape[1] + 1))
     anc, direc, bits_np, pair_np = _tree_static(depth)
     bits = jnp.asarray(bits_np)                      # (masks, depth)
-    pair = jnp.asarray(pair_np)                      # (masks, depth)
     size = jnp.sum(bits, axis=1)                     # (masks,)
     wtab = jnp.asarray(_shapley_weights(depth), jnp.float32)
 
     binned = bin_features(x.astype(jnp.float32), model.bin_edges)  # (n, d)
+    n = binned.shape[0]
 
-    def per_row(bx):
-        def per_tree(phi, tree):
-            feat_nodes, thr_nodes, leaf_value, bg_t = tree
-            feat = feat_nodes[anc]                   # (leaves, depth)
-            thr = thr_nodes[anc]
-            dup, canonical, u = _dup_structure(feat)
-            cx = _path_conditions(bx, feat, thr, direc)  # (leaves, depth)
-            bitdup = bits[:, dup]                    # (masks, leaves, depth)
-            cxsel = jnp.all(
-                jnp.where(bitdup, cx[None], True), axis=2
-            )                                        # (masks, leaves)
-            v = cxsel.astype(jnp.float32) * bg_t.T   # (masks, leaves)
+    def per_tree(phi, tree):
+        feat_nodes, thr_nodes, leaf_value, bg_t = tree
+        feat = feat_nodes[anc]                       # (leaves, depth)
+        thr = thr_nodes[anc]
+        dup, canonical, u = _dup_structure(feat)
+        cx = _path_conditions(binned, feat, thr, direc)  # (n, leaves, depth)
+        bitdup = bits[:, dup]                        # (masks, leaves, depth)
+        cxsel = jnp.all(
+            jnp.where(bitdup[None], cx[:, None], True), axis=3
+        )                                            # (n, masks, leaves)
+        v = cxsel.astype(jnp.float32) * bg_t.T[None]  # (n, masks, leaves)
 
-            # A mask is a feature subset iff every non-canonical bit is 0.
-            valid = jnp.all(
-                canonical[None, :, :] | ~bits[:, None, :], axis=2
-            )                                        # (masks, leaves)
-            # Marginal contribution of canonical level k on leaf l:
-            # Σ_m W[u, |m|] · (V[m ∪ {k}] − V[m]) over valid m with k ∉ m.
-            v_pair = v[pair]                         # (masks, depth, leaves)
-            delta = v_pair - v[:, None, :]           # (masks, depth, leaves)
-            w = wtab[u[None, None, :], size[:, None, None]]
-            include = (
-                valid[:, None, :]
-                & ~bits[:, :, None]
-                & canonical.T[None, :, :]
-            )                                        # (masks, depth, leaves)
-            contrib = jnp.sum(
-                jnp.where(include, w * delta, 0.0), axis=0
-            )                                        # (depth, leaves)
-            scaled = contrib.T * leaf_value[:, None]  # (leaves, depth)
-            phi_t = jax.ops.segment_sum(
-                scaled.reshape(-1), feat.reshape(-1), num_segments=d_features
-            )
-            return phi + phi_t, None
+        # A mask is a feature subset iff every non-canonical bit is 0.
+        valid = jnp.all(
+            canonical[None, :, :] | ~bits[:, None, :], axis=2
+        )                                            # (masks, leaves)
+        # Marginal contribution of canonical level k on leaf l:
+        # Σ_m W[u, |m|] · (V[m ∪ {k}] − V[m]) over valid m with k ∉ m.
+        # pair indices are static → take lowers to slices, not gathers.
+        v_pair = jnp.take(v, pair_np.reshape(-1), axis=1).reshape(
+            n, *pair_np.shape, v.shape[2]
+        )                                            # (n, masks, depth, leaves)
+        delta = v_pair - v[:, :, None, :]
+        w = wtab[u[None, None, :], size[:, None, None]]  # (masks, 1, leaves)
+        include = (
+            valid[:, None, :]
+            & ~bits[:, :, None]
+            & canonical.T[None, :, :]
+        )                                            # (masks, depth, leaves)
+        contrib = jnp.sum(
+            jnp.where(include[None], w[None] * delta, 0.0), axis=1
+        )                                            # (n, depth, leaves)
+        scaled = (
+            jnp.swapaxes(contrib, 1, 2) * leaf_value[None, :, None]
+        )                                            # (n, leaves, depth)
+        # scatter-to-features as a one-hot matmul (shared segment ids).
+        # HIGHEST precision: the default TPU matmul truncates operands to
+        # bf16, which would break the exact-f32 equality this module
+        # promises (the 0/1 one-hot is exact either way; ``scaled`` is not).
+        onehot = (
+            feat.reshape(-1)[:, None] == jnp.arange(d_features)[None, :]
+        ).astype(jnp.float32)                        # (leaves·depth, d)
+        phi_t = jnp.matmul(
+            scaled.reshape(n, -1), onehot,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                            # (n, d)
+        return phi + phi_t, None
 
-        phi0 = jnp.zeros((d_features,), jnp.float32)
-        phi, _ = jax.lax.scan(
-            per_tree,
-            phi0,
-            (
-                model.split_feature,
-                model.split_bin,
-                model.leaf_value,
-                explainer.bg_table,
-            ),
-        )
-        return phi
-
-    return jax.vmap(per_row)(binned)
+    phi0 = jnp.zeros((n, d_features), jnp.float32)
+    phi, _ = jax.lax.scan(
+        per_tree,
+        phi0,
+        (
+            model.split_feature,
+            model.split_bin,
+            model.leaf_value,
+            explainer.bg_table,
+        ),
+    )
+    return phi
 
 
 @jax.jit
