@@ -62,7 +62,11 @@ from jax.sharding import PartitionSpec as P
 
 from fraud_detection_tpu import config
 from fraud_detection_tpu.parallel.mesh import DATA_AXIS
-from fraud_detection_tpu.parallel.sharding import pad_to_multiple, shard_batch
+from fraud_detection_tpu.parallel.sharding import (
+    pad_to_multiple,
+    shard_batch,
+    sync_fetch,
+)
 
 
 @dataclass(frozen=True)
@@ -563,14 +567,10 @@ def gbt_fit(
     # returning. Beyond semantics this is a hard requirement — a process
     # exiting while the (cached, async-dispatched) boost program is still
     # executing segfaults in XLA teardown (reproduced 5/6 on the CPU
-    # backend; blocked runs 6/6 clean). The barrier is a real d2h fetch of
-    # one output (tiny — the tree arrays are KBs): on tunneled PJRT
-    # platforms block_until_ready can report ready before the device
-    # finishes (measured r5: a 5 s boost program "ready" in 0.27 s), and a
-    # fetch is the only true completion proof. All three arrays come from
-    # the one boost program, so one fetch covers them.
-    feats, threshs, leaves = jax.block_until_ready((feats, threshs, leaves))
-    np.asarray(leaves[:1, :1])
+    # backend; blocked runs 6/6 clean). sync_fetch's docstring has the
+    # tunneled-PJRT rationale for the real d2h fetch; all three arrays
+    # come from the one boost program, so its one fetch covers them.
+    feats, threshs, leaves = sync_fetch((feats, threshs, leaves))
     return GBTModel(
         split_feature=feats,
         split_bin=threshs,

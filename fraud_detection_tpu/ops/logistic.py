@@ -38,6 +38,7 @@ from fraud_detection_tpu.parallel.sharding import (
     as_device_f32,
     pad_to_multiple,
     shard_batch,
+    sync_fetch,
 )
 
 
@@ -160,7 +161,11 @@ def logistic_fit_lbfgs(
         sw_dev, _ = shard_batch(sw, mesh)  # pad weight 0 ⇒ padded rows inert
     else:
         x_dev, y_dev, sw_dev = jnp.asarray(x_in), jnp.asarray(y_np), jnp.asarray(sw)
-    return _fit_lbfgs(x_dev, y_dev, sw_dev, float(c), int(max_iter), float(tol))
+    # Synchronous like the SGD path (sklearn contract + XLA-teardown
+    # safety); sync_fetch's docstring has the tunneled-PJRT rationale.
+    return sync_fetch(
+        _fit_lbfgs(x_dev, y_dev, sw_dev, float(c), int(max_iter), float(tol))
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -360,10 +365,6 @@ def logistic_fit_sgd(
             epoch_callback(e, params, velocity, rng, fingerprint)
     # fit() is synchronous (sklearn contract) — and exiting a process while
     # the cached shard_map epoch program is still executing asynchronously
-    # segfaults in XLA teardown (see gbt_fit's matching note). The barrier
-    # is a real d2h fetch of the (tiny) intercept: on tunneled PJRT
-    # platforms block_until_ready can report ready before the device
-    # finishes, and a fetch is the only true completion proof.
-    params = jax.block_until_ready(params)
-    np.asarray(params.intercept)
-    return params
+    # segfaults in XLA teardown. sync_fetch's docstring has the
+    # tunneled-PJRT rationale for the real d2h fetch.
+    return sync_fetch(params)

@@ -24,6 +24,25 @@ def as_device_f32(x) -> jax.Array | np.ndarray:
     return np.asarray(x, dtype=np.float32)
 
 
+def sync_fetch(tree):
+    """TRUE completion barrier for a dispatched device computation: block
+    on the pytree AND fetch one element of its first leaf to host.
+
+    ``block_until_ready`` alone is not a completion proof on tunneled PJRT
+    platforms — it can report ready before the device finishes (measured
+    r5: a 5 s boost program "ready" in 0.27 s; BASELINE.md "r5 CRITICAL").
+    The d2h fetch is; one element suffices because every leaf comes from
+    the same finished program (or one ordered after the others). Fits call
+    this before returning so fit() is synchronous (sklearn contract) and
+    process exit can't race XLA teardown (which segfaults; see gbt_fit).
+    Returns the blocked tree unchanged."""
+    tree = jax.block_until_ready(tree)
+    leaves = jax.tree_util.tree_leaves(tree)
+    if leaves:
+        np.asarray(jnp.ravel(leaves[0])[:1])
+    return tree
+
+
 def batch_sharding(mesh: Mesh | None = None) -> NamedSharding:
     """Rows sharded over the data axis, features replicated."""
     mesh = mesh or default_mesh()

@@ -241,6 +241,19 @@ def test_pallas_histograms_match_matmul(rng, monkeypatch):
     assert np.corrcoef(p_pl, p_mm)[0, 1] > 0.98
 
 
+def test_hist_impl_typo_raises(monkeypatch):
+    """A GBT_HIST typo must raise, not silently run the default impl under
+    the operator's nose (an operator timing GBT_HIST=seg would otherwise
+    draw conclusions about a kernel that never executed)."""
+    from fraud_detection_tpu.ops.gbt import _hist_impl
+
+    monkeypatch.setenv("GBT_HIST", "seg")
+    with pytest.raises(ValueError, match="GBT_HIST"):
+        _hist_impl()
+    monkeypatch.setenv("GBT_HIST", "segment")
+    assert _hist_impl() == "segment"
+
+
 def test_dense_and_walk_predictions_agree(rng, monkeypatch):
     """The dense leaf-indicator scorer (TPU dispatch, r5) must put every row
     in exactly the leaf the gather walk does — identical probabilities up
