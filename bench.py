@@ -476,19 +476,25 @@ def bench_link_bandwidth(x) -> tuple[float, float]:
     import jax
     import jax.numpy as jnp
 
-    jax.device_put(x[:1024]).block_until_ready()
+    _window_barrier(jax.device_put(x[:1024]))
     h2d = []
     for i in range(3):  # distinct slices of the random set = fresh bytes
         buf = np.ascontiguousarray(x[i * 4 * BATCH : (i + 1) * 4 * BATCH])
         t0 = time.perf_counter()
-        jax.device_put(buf).block_until_ready()
+        # consume + fetch, not block_until_ready (which can report a
+        # transfer done early — see _window_barrier): an op reading the
+        # array requires the FULL upload to have landed, and its 1-element
+        # fetch (~1 RTT, <10% of a 31 MB upload on this link) proves it.
+        _window_barrier(jax.device_put(buf))
         h2d.append(buf.nbytes / (time.perf_counter() - t0))
     d2h = []
     key = jax.random.PRNGKey(0)
     for i in range(3):  # fresh device data: np.asarray caches host copies
         key, k = jax.random.split(key)
         d = jax.random.uniform(k, (1 << 21,), dtype=jnp.float32)
-        d.block_until_ready()
+        # true pre-timing barrier (fetches a DERIVED 1-element slice, so it
+        # can't populate np.asarray's host copy of d itself)
+        _window_barrier(d)
         t0 = time.perf_counter()
         np.asarray(d)
         d2h.append(d.nbytes / (time.perf_counter() - t0))
