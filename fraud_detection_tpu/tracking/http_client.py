@@ -28,7 +28,7 @@ import urllib.error
 import urllib.request
 from typing import Any
 
-from fraud_detection_tpu.tracking.registry import _MODEL_URI
+from fraud_detection_tpu.tracking.registry import parse_model_uri
 
 TIMEOUT = 30.0
 
@@ -228,17 +228,13 @@ class HttpModelRegistry:
         """models:/ URI → local artifact directory (download-through cache).
         Raises FileNotFoundError on unknown model/alias like the file
         registry, so the serving fallback behaves identically."""
-        m = _MODEL_URI.match(model_uri)
-        if not m:
-            raise ValueError(f"not a models:/ URI: {model_uri}")
-        name = m.group("name")
+        name, alias, version = parse_model_uri(model_uri)
         try:
-            if m.group("version"):
-                version: int | None = int(m.group("version"))
-            elif m.group("alias"):
-                version = self.get_version_by_alias(name, m.group("alias"))
-            else:
-                version = self.latest_version(name)
+            if version is None:
+                version = (
+                    self.get_version_by_alias(name, alias) if alias
+                    else self.latest_version(name)
+                )
         except TrackingHTTPError as e:
             raise FileNotFoundError(f"registry unreachable: {e}") from e
         if version is None:

@@ -18,7 +18,32 @@ import time
 
 from fraud_detection_tpu.tracking.store import _atomic_write_json, _read_json
 
-_MODEL_URI = re.compile(r"^models:/(?P<name>[^@/]+)(@(?P<alias>[^/]+))?(/(?P<version>\d+))?$")
+# models:/name@alias | models:/name/3 | models:/name/Production (legacy
+# MLflow STAGE form — the reference's validate_auc default is
+# models:/fraud/prod, scripts/validate_auc.py:32; a non-numeric tail is
+# treated as an alias so that contract keeps working) | models:/name
+_MODEL_URI = re.compile(
+    r"^models:/(?P<name>[^@/]+)(@(?P<alias>[^/]+))?"
+    r"(/(?P<version>\d+)|/(?P<stage>[^/]+))?$"
+)
+
+
+def parse_model_uri(model_uri: str) -> tuple[str, str | None, int | None]:
+    """``models:/...`` → (name, alias, version). The ONE parser both
+    registry clients use, so the HTTP and file registries can't drift.
+    Raises ValueError on non-models URIs and on ``@alias`` combined with a
+    non-numeric tail (``models:/fraud@prod/v2`` is a typo for ``/2``, not a
+    request for prod — serving prod silently would mask it)."""
+    m = _MODEL_URI.match(model_uri)
+    if not m:
+        raise ValueError(f"not a models:/ URI: {model_uri}")
+    alias, stage = m.group("alias"), m.group("stage")
+    if alias and stage:
+        raise ValueError(
+            f"ambiguous models:/ URI (both @{alias} and /{stage}): {model_uri}"
+        )
+    version = int(m.group("version")) if m.group("version") else None
+    return m.group("name"), alias or stage, version
 
 
 class ModelRegistry:
@@ -83,20 +108,16 @@ class ModelRegistry:
         return os.path.join(self._model_dir(name), "versions", str(version))
 
     def resolve(self, model_uri: str) -> str:
-        """``models:/name@alias`` | ``models:/name/3`` | ``models:/name``
-        (latest) → artifact directory path. Raises FileNotFoundError when the
-        model/alias doesn't exist (callers implement the serving fallback,
-        api/app.py:41-44)."""
-        m = _MODEL_URI.match(model_uri)
-        if not m:
-            raise ValueError(f"not a models:/ URI: {model_uri}")
-        name = m.group("name")
-        if m.group("version"):
-            version: int | None = int(m.group("version"))
-        elif m.group("alias"):
-            version = self.get_version_by_alias(name, m.group("alias"))
-        else:
-            version = self.latest_version(name)
+        """``models:/name@alias`` | ``models:/name/3`` | ``models:/name/stage``
+        (legacy stage form ≡ alias) | ``models:/name`` (latest) → artifact
+        directory path. Raises FileNotFoundError when the model/alias doesn't
+        exist (callers implement the serving fallback, api/app.py:41-44)."""
+        name, alias, version = parse_model_uri(model_uri)
+        if version is None:
+            version = (
+                self.get_version_by_alias(name, alias) if alias
+                else self.latest_version(name)
+            )
         if version is None:
             raise FileNotFoundError(f"no registered version for {model_uri}")
         d = self.artifact_dir(name, version)

@@ -67,6 +67,13 @@ def test_registry_versions_and_aliases(tmp_path):
     assert reg.resolve("models:/fraud/1").endswith("versions/1")
     reg.set_alias("fraud", "prod", v2)
     assert reg.resolve("models:/fraud@prod").endswith("versions/2")
+    # Legacy MLflow STAGE form — the reference's validate_auc default URI
+    # (scripts/validate_auc.py:32 is models:/fraud/prod); a non-numeric
+    # tail resolves like the alias so that contract keeps working.
+    assert reg.resolve("models:/fraud/prod").endswith("versions/2")
+    # ...but @alias plus a non-numeric tail is a typo, not a request
+    with pytest.raises(ValueError, match="ambiguous"):
+        reg.resolve("models:/fraud@prod/v2")
 
 
 def test_registry_gate(tmp_path):
