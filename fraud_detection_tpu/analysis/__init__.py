@@ -1,0 +1,39 @@
+"""graftcheck: JAX-aware static analysis + virtual-mesh shape verification.
+
+Two passes, one CLI (``python -m fraud_detection_tpu.analysis`` or the
+``graftcheck`` console script):
+
+- **Pass 1 — AST lint engine** (:mod:`.core`, :mod:`.rules_jax`,
+  :mod:`.rules_service`): a pluggable rule registry walked over every
+  module's AST. The rules encode the failure modes pytest-on-CPU cannot see:
+  host-device syncs inside jit regions, Python-scalar closure captures that
+  trigger recompile storms, tracer leakage into globals, missing donation on
+  state-threading jits, and the service-tier analogues (sockets without
+  timeouts, silent exception swallowing, non-daemon threads that are never
+  joined).
+- **Pass 2 — virtual-mesh shape verifier** (:mod:`.meshcheck`): every
+  registered jitted entrypoint is abstractly evaluated with
+  ``jax.eval_shape`` under CPU meshes of sizes 1/2/8, proving that shapes
+  and named shardings compose at every mesh size before code ever reaches a
+  real TPU topology.
+
+Findings are reported as text or JSON (:mod:`.report`) and gated against a
+checked-in baseline (:mod:`.baseline`); ``tests/test_static_analysis.py``
+asserts the repo itself is clean modulo that baseline, and CI runs the CLI
+on every push.
+"""
+
+from fraud_detection_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    ModuleInfo,
+    Rule,
+    Severity,
+    analyze_file,
+    analyze_paths,
+    iter_rules,
+    register_rule,
+)
+
+# Importing the rule modules populates the registry.
+from fraud_detection_tpu.analysis import rules_jax  # noqa: F401,E402
+from fraud_detection_tpu.analysis import rules_service  # noqa: F401,E402

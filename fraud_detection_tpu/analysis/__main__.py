@@ -1,0 +1,155 @@
+"""graftcheck CLI: ``python -m fraud_detection_tpu.analysis`` (also installed
+as the ``graftcheck`` console script).
+
+Exit status 0 ⇔ the tree is clean modulo the checked-in baseline AND every
+registered jit entrypoint shape-verifies at every virtual mesh size. CI runs
+exactly this on every push; the gate test runs the same passes in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _ensure_virtual_devices() -> None:
+    """The mesh verifier needs 8 virtual CPU devices; both env vars must be
+    set before jax initializes its backend (same dance as tests/conftest)."""
+    if "jax" in sys.modules:
+        return  # too late to influence backend init; verifier will report
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="JAX-aware static analysis + virtual-mesh shape verification",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: the fraud_detection_tpu package)",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--baseline", default=None,
+        help="baseline suppression file (default: analysis_baseline.json "
+        "next to the package)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept all current findings into the baseline file and exit 0",
+    )
+    ap.add_argument(
+        "--no-shape-check", action="store_true",
+        help="skip the virtual-mesh shape verification pass",
+    )
+    ap.add_argument(
+        "--shape-check-only", action="store_true",
+        help="run only the virtual-mesh shape verification pass",
+    )
+    ap.add_argument(
+        "--mesh-sizes", default=None,
+        help="comma-separated mesh sizes for the verifier (default 1,2,8)",
+    )
+    ap.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--fail-on", default="info", choices=("info", "warning", "error"),
+        help="minimum severity of NEW findings that fails the run",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument(
+        "--output", default=None, help="write the report here as well as stdout"
+    )
+    args = ap.parse_args(argv)
+
+    if not args.no_shape_check or args.shape_check_only:
+        _ensure_virtual_devices()
+
+    # Lint pass imports are pure-stdlib; meshcheck (imports jax + ops) is
+    # deferred until we know the shape pass is wanted.
+    from fraud_detection_tpu.analysis import baseline as baseline_mod
+    from fraud_detection_tpu.analysis import report
+    from fraud_detection_tpu.analysis.core import (
+        Severity,
+        analyze_paths,
+        iter_rules,
+    )
+
+    if args.list_rules:
+        for r in iter_rules():
+            print(f"{r.id:24s} {r.severity.name.lower():8s} {r.description}")
+        return 0
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(pkg_dir)
+    paths = args.paths or [pkg_dir]
+    baseline_path = args.baseline or os.path.join(
+        root, baseline_mod.DEFAULT_BASELINE
+    )
+
+    rules = None
+    if args.rules:
+        wanted = {s.strip() for s in args.rules.split(",") if s.strip()}
+        rules = [r for r in iter_rules() if r.id in wanted]
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    if args.write_baseline and args.shape_check_only:
+        print(
+            "--write-baseline requires the lint pass; drop --shape-check-only"
+            " (writing here would wipe the baseline with an empty list)",
+            file=sys.stderr,
+        )
+        return 2
+
+    findings = (
+        [] if args.shape_check_only
+        else analyze_paths(paths, root=root, rules=rules)
+    )
+
+    if args.write_baseline:
+        baseline_mod.save(baseline_path, findings)
+        print(
+            f"wrote {len(findings)} finding(s) to {baseline_path}",
+            file=sys.stderr,
+        )
+        return 0
+
+    result = baseline_mod.apply(findings, baseline_mod.load(baseline_path))
+
+    mesh_results = None
+    if not args.no_shape_check:
+        from fraud_detection_tpu.analysis import meshcheck
+
+        sizes = None
+        if args.mesh_sizes:
+            sizes = tuple(int(s) for s in args.mesh_sizes.split(","))
+        mesh_results = meshcheck.verify_all(sizes)
+
+    if args.format == "json":
+        out = report.render_json(result, mesh_results)
+    else:
+        out = report.render_text(result, mesh_results, verbose=args.verbose)
+    print(out)
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(out + "\n")
+    return report.exit_code(
+        result, mesh_results, fail_on=Severity.parse(args.fail_on)
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""Baseline suppression file: accepted findings checked in at repo root.
+
+The gate is "no findings beyond the baseline", so adopting the analyzer on
+a codebase with pre-existing accepted findings doesn't require fixing (or
+inline-tagging) every one of them up front. Matching is by
+:attr:`Finding.fingerprint` — rule + file + normalized source line — as a
+multiset, so
+
+- editing unrelated lines above a baselined finding keeps it matched
+  (fingerprints carry no line numbers);
+- fixing a baselined finding never breaks the gate (stale entries are
+  reported separately so they can be pruned);
+- a NEW instance of an already-baselined pattern on a *different* line
+  text is still caught.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from dataclasses import dataclass
+from typing import Iterable
+
+from fraud_detection_tpu.analysis.core import Finding
+
+DEFAULT_BASELINE = "analysis_baseline.json"
+
+
+@dataclass
+class BaselineResult:
+    new: list[Finding]          # findings not covered by the baseline
+    suppressed: list[Finding]   # findings matched against baseline entries
+    stale: list[dict]           # baseline entries matching nothing (prunable)
+
+
+def load(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        return doc.get("findings", [])
+    return doc
+
+
+def save(path: str, findings: Iterable[Finding]) -> None:
+    doc = {
+        "comment": (
+            "graftcheck baseline: accepted findings. Regenerate with "
+            "`python -m fraud_detection_tpu.analysis --write-baseline` "
+            "after reviewing that every entry is an accepted exception."
+        ),
+        "findings": [f.to_dict() for f in findings],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def apply(findings: list[Finding], entries: list[dict]) -> BaselineResult:
+    budget = Counter(e.get("fingerprint") for e in entries)
+    new: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in findings:
+        if budget.get(f.fingerprint, 0) > 0:
+            budget[f.fingerprint] -= 1
+            suppressed.append(f)
+        else:
+            new.append(f)
+    stale: list[dict] = []
+    for e in entries:
+        fp = e.get("fingerprint")
+        if budget.get(fp, 0) > 0:  # unconsumed: matched no current finding
+            budget[fp] -= 1
+            stale.append(e)
+    return BaselineResult(new=new, suppressed=suppressed, stale=stale)

@@ -1,0 +1,351 @@
+"""Lint engine core: findings, severities, rule registry, AST module model.
+
+The engine parses each file once into a :class:`ModuleInfo` — AST plus the
+derived facts every rule needs (parent links, which functions are
+jit-compiled, comment suppressions) — and hands it to each registered
+:class:`Rule`. Rules are pure functions of the module model; registering a
+new one is a decorator (:func:`register_rule`), no engine changes.
+
+Suppression: a finding is dropped when its line (or the line above) carries
+``# graftcheck: ignore[rule-id]`` (or a bare ``# graftcheck: ignore`` for
+any rule). The tag doubles as the reviewed-and-narrowed marker the
+``silent-except`` audit rule accepts in lieu of logging.
+"""
+
+from __future__ import annotations
+
+import ast
+import enum
+import hashlib
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Callable, Iterable, Iterator
+
+
+class Severity(enum.IntEnum):
+    """Finding severity. ERROR means "wrong on real hardware" (host syncs in
+    jit, tracer leaks); WARNING is a latent operational hazard; INFO is an
+    optimization opportunity."""
+
+    INFO = 1
+    WARNING = 2
+    ERROR = 3
+
+    @classmethod
+    def parse(cls, s: str) -> "Severity":
+        try:
+            return cls[s.upper()]
+        except KeyError:
+            raise ValueError(
+                f"severity must be info|warning|error, got {s!r}"
+            ) from None
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    severity: Severity
+    path: str       # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    snippet: str    # the source line, stripped
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity for baseline matching: rule + file + normalized
+        source line. Deliberately line-NUMBER-insensitive so unrelated edits
+        above a baselined finding don't invalidate the baseline."""
+        norm = re.sub(r"\s+", " ", self.snippet).strip()
+        h = hashlib.sha1(
+            f"{self.rule_id}|{self.path}|{norm}".encode()
+        ).hexdigest()
+        return h[:16]
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule_id,
+            "severity": self.severity.name.lower(),
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "snippet": self.snippet,
+            "fingerprint": self.fingerprint,
+        }
+
+
+# --------------------------------------------------------------------------
+# Module model
+# --------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*ignore(?:\[([A-Za-z0-9_,\- ]*)\])?"
+)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains; ``.item`` style (leading dot)
+    when the chain root is a call/subscript rather than a name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if parts:  # method on an arbitrary expression: "(expr).item" → ".item"
+        return "." + ".".join(reversed(parts))
+    return None
+
+
+class ModuleInfo:
+    """One parsed module plus the derived facts rules dispatch on."""
+
+    def __init__(self, path: str, rel_path: str, source: str):
+        self.path = path
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self._suppressions = self._scan_suppressions()
+        self.jit_functions = self._find_jit_functions()
+
+    # -- suppressions ------------------------------------------------------
+    def _scan_suppressions(self) -> dict[int, set[str] | None]:
+        """line -> None (suppress all) or set of rule ids, from comments.
+        Tokenized (not regexed over raw lines) so a '#' inside a string
+        can't fake a suppression."""
+        out: dict[int, set[str] | None] = {}
+        try:
+            tokens = tokenize.generate_tokens(StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                ids = m.group(1)
+                if ids is None or not ids.strip():
+                    out[tok.start[0]] = None
+                else:
+                    out[tok.start[0]] = {
+                        s.strip() for s in ids.split(",") if s.strip()
+                    }
+        except tokenize.TokenError:
+            pass
+        return out
+
+    def suppressed(self, line: int, rule_id: str) -> bool:
+        for ln in (line, line - 1):
+            ids = self._suppressions.get(ln, "missing")
+            if ids is None:
+                return True
+            if isinstance(ids, set) and rule_id in ids:
+                return True
+        return False
+
+    # -- jit context -------------------------------------------------------
+    def _jit_names_in_call_args(self) -> set[str]:
+        """Names referenced inside jax.jit(...)/shard_map(...)/jit(...) call
+        arguments — functions compiled by reference rather than decorator
+        (``_boost_jit = jax.jit(_boost, ...)``, ``shard_map(partial(f, ...))``)."""
+        names: set[str] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee not in ("jax.jit", "jit", "shard_map", "jax.pmap", "pmap"):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+        return names
+
+    @staticmethod
+    def _decorator_is_jit(dec: ast.AST) -> bool:
+        name = dotted_name(dec)
+        if name in ("jax.jit", "jit", "jax.pmap", "pmap"):
+            return True
+        if isinstance(dec, ast.Call):
+            callee = dotted_name(dec.func)
+            if callee in ("jax.jit", "jit", "jax.pmap", "pmap"):
+                return True
+            # @partial(jax.jit, ...) / @functools.partial(jax.jit, ...)
+            if callee in ("partial", "functools.partial") and dec.args:
+                return dotted_name(dec.args[0]) in ("jax.jit", "jit")
+        return False
+
+    def _find_jit_functions(self) -> set[ast.AST]:
+        by_ref = self._jit_names_in_call_args()
+        out: set[ast.AST] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, _FuncDef):
+                continue
+            if any(self._decorator_is_jit(d) for d in node.decorator_list):
+                out.add(node)
+            elif node.name in by_ref:
+                out.add(node)
+        return out
+
+    def enclosing_functions(self, node: ast.AST) -> Iterator[ast.AST]:
+        """Innermost-out chain of FunctionDefs containing ``node``."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, _FuncDef):
+                yield cur
+            cur = self.parents.get(cur)
+
+    def in_jit_context(self, node: ast.AST) -> bool:
+        """True when ``node`` sits inside a jit-compiled function (including
+        functions nested within one — their bodies trace too)."""
+        return any(
+            fn in self.jit_functions for fn in self.enclosing_functions(node)
+        )
+
+    # -- misc helpers ------------------------------------------------------
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(
+        self, rule: "Rule", node: ast.AST, message: str
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(
+            rule_id=rule.id,
+            severity=rule.severity,
+            path=self.rel_path,
+            line=line,
+            col=col,
+            message=message,
+            snippet=self.line_text(line),
+        )
+
+
+# --------------------------------------------------------------------------
+# Rules + registry
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Rule:
+    id: str
+    severity: Severity
+    description: str
+    check: Callable[[ModuleInfo], Iterable[Finding]] = field(repr=False)
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register_rule(id: str, severity: Severity, description: str):
+    """Decorator: register ``fn(mod: ModuleInfo) -> Iterable[Finding]`` as a
+    rule. The decorated function receives the rule object as attribute
+    ``fn.rule`` so it can mint findings via ``mod.finding(fn.rule, ...)``."""
+
+    def deco(fn):
+        rule = Rule(id=id, severity=severity, description=description, check=fn)
+        if id in _REGISTRY:
+            raise ValueError(f"duplicate rule id {id!r}")
+        _REGISTRY[id] = rule
+        fn.rule = rule
+        return fn
+
+    return deco
+
+
+def iter_rules() -> list[Rule]:
+    return list(_REGISTRY.values())
+
+
+def get_rule(rule_id: str) -> Rule:
+    return _REGISTRY[rule_id]
+
+
+# --------------------------------------------------------------------------
+# Driver
+# --------------------------------------------------------------------------
+
+#: paths (relative, substring match on normalized separators) never scanned:
+#: the lint fixtures are deliberately bad code.
+DEFAULT_EXCLUDES = ("tests/analysis_fixtures/",)
+
+
+def analyze_file(
+    path: str,
+    root: str | None = None,
+    rules: Iterable[Rule] | None = None,
+) -> list[Finding]:
+    root = root or os.getcwd()
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        mod = ModuleInfo(path, rel, source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule_id="syntax-error",
+                severity=Severity.ERROR,
+                path=rel,
+                line=e.lineno or 1,
+                col=e.offset or 0,
+                message=f"file does not parse: {e.msg}",
+                snippet="",
+            )
+        ]
+    findings: list[Finding] = []
+    for rule in rules if rules is not None else iter_rules():
+        for f_ in rule.check(mod):
+            if not mod.suppressed(f_.line, f_.rule_id):
+                findings.append(f_)
+    return findings
+
+
+def iter_python_files(
+    paths: Iterable[str], excludes: Iterable[str] = DEFAULT_EXCLUDES
+) -> Iterator[str]:
+    excludes = tuple(excludes)
+
+    def excluded(p: str) -> bool:
+        norm = p.replace(os.sep, "/")
+        return any(e in norm for e in excludes)
+
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py") and not excluded(p):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in sorted(dirnames) if d != "__pycache__"]
+            for fn in sorted(filenames):
+                full = os.path.join(dirpath, fn)
+                if fn.endswith(".py") and not excluded(full):
+                    yield full
+
+
+def analyze_paths(
+    paths: Iterable[str],
+    root: str | None = None,
+    rules: Iterable[Rule] | None = None,
+    excludes: Iterable[str] = DEFAULT_EXCLUDES,
+) -> list[Finding]:
+    rules = list(rules) if rules is not None else iter_rules()
+    out: list[Finding] = []
+    for path in iter_python_files(paths, excludes):
+        out.extend(analyze_file(path, root=root, rules=rules))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return out

@@ -1,0 +1,105 @@
+"""Rendering + exit-code policy for analyzer results (text and JSON)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from fraud_detection_tpu.analysis.baseline import BaselineResult
+from fraud_detection_tpu.analysis.core import Finding, Severity, iter_rules
+
+_SEV_TAG = {
+    Severity.ERROR: "error",
+    Severity.WARNING: "warning",
+    Severity.INFO: "info",
+}
+
+
+def render_text(
+    result: BaselineResult,
+    mesh_results: list[dict] | None = None,
+    verbose: bool = False,
+) -> str:
+    lines: list[str] = []
+    for f in result.new:
+        lines.append(
+            f"{f.path}:{f.line}:{f.col}: {_SEV_TAG[f.severity]} "
+            f"[{f.rule_id}] {f.message}"
+        )
+        if f.snippet:
+            lines.append(f"    {f.snippet}")
+    if mesh_results:
+        for r in mesh_results:
+            if not r["ok"]:
+                lines.append(
+                    f"meshcheck: error [{r['entrypoint']}] mesh size "
+                    f"{r['mesh_size']}: {r['error']}"
+                )
+            elif verbose:
+                lines.append(
+                    f"meshcheck: ok [{r['entrypoint']}] mesh size "
+                    f"{r['mesh_size']} ({r['out']})"
+                )
+    n_mesh_fail = sum(1 for r in (mesh_results or []) if not r["ok"])
+    summary = (
+        f"graftcheck: {len(result.new)} finding(s), "
+        f"{len(result.suppressed)} baselined"
+    )
+    if result.stale:
+        summary += f", {len(result.stale)} stale baseline entr(y/ies)"
+    if mesh_results is not None:
+        summary += (
+            f"; mesh verification: {len(mesh_results) - n_mesh_fail}/"
+            f"{len(mesh_results)} checks passed"
+        )
+    lines.append(summary)
+    if result.stale and verbose:
+        for e in result.stale:
+            lines.append(
+                f"  stale baseline entry: [{e.get('rule')}] "
+                f"{e.get('path')} — {e.get('snippet', '')!r}"
+            )
+    return "\n".join(lines)
+
+
+def render_json(
+    result: BaselineResult, mesh_results: list[dict] | None = None
+) -> str:
+    doc: dict[str, Any] = {
+        "findings": [f.to_dict() for f in result.new],
+        "baselined": [f.to_dict() for f in result.suppressed],
+        "stale_baseline": result.stale,
+        "rules": [
+            {
+                "id": r.id,
+                "severity": r.severity.name.lower(),
+                "description": r.description,
+            }
+            for r in iter_rules()
+        ],
+        "summary": {
+            "new": len(result.new),
+            "baselined": len(result.suppressed),
+            "stale": len(result.stale),
+        },
+    }
+    if mesh_results is not None:
+        doc["mesh_verification"] = mesh_results
+        doc["summary"]["mesh_failures"] = sum(
+            1 for r in mesh_results if not r["ok"]
+        )
+    return json.dumps(doc, indent=2)
+
+
+def exit_code(
+    result: BaselineResult,
+    mesh_results: list[dict] | None = None,
+    fail_on: Severity = Severity.INFO,
+) -> int:
+    """1 when any non-baselined finding at/above ``fail_on`` exists or any
+    mesh verification failed, else 0."""
+    if any(f.severity >= fail_on for f in result.new):
+        return 1
+    if mesh_results and any(not r["ok"] for r in mesh_results):
+        return 1
+    return 0

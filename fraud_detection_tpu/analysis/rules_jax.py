@@ -1,0 +1,349 @@
+"""JAX-specific lint rules: the failure modes CPU pytest cannot surface.
+
+Each rule is a registered checker over :class:`~.core.ModuleInfo`. They are
+heuristic by design — static analysis of a dynamic language — tuned so the
+repo's own idioms (static_argnames casts, lru-cached shard_map builders)
+don't false-positive, with ``# graftcheck: ignore[...]`` as the escape
+hatch for reviewed exceptions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from fraud_detection_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Severity,
+    dotted_name,
+    register_rule,
+)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: calls that force a device→host sync (or a host round trip) when executed
+#: on a traced value — poison inside a jit region.
+_HOST_SYNC_CALLS = {
+    "np.asarray": "np.asarray materializes the traced value on host",
+    "np.array": "np.array materializes the traced value on host",
+    "numpy.asarray": "numpy.asarray materializes the traced value on host",
+    "numpy.array": "numpy.array materializes the traced value on host",
+    "jax.device_get": "device_get is a host transfer",
+    "onp.asarray": "np.asarray materializes the traced value on host",
+}
+
+#: zero-arg methods that sync scalar-by-scalar — the classic silent
+#: hot-path killer (`.item()` in a loop).
+_HOST_SYNC_METHODS = {"item", "tolist", "to_py"}
+
+_PY_CASTS = {"float", "int", "bool", "complex"}
+
+
+def _jit_static_names(fn: ast.AST, mod: ModuleInfo) -> set[str]:
+    """Parameter names marked static in the function's jit decorator
+    (``static_argnames`` strings, or ``static_argnums`` indices resolved
+    against the signature)."""
+    out: set[str] = set()
+    args = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        callee = dotted_name(dec.func)
+        if callee in ("partial", "functools.partial"):
+            if not dec.args or dotted_name(dec.args[0]) not in ("jax.jit", "jit"):
+                continue
+        elif callee not in ("jax.jit", "jit"):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                        out.add(sub.value)
+            elif kw.arg == "static_argnums":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and isinstance(sub.value, int):
+                        if 0 <= sub.value < len(args):
+                            out.add(args[sub.value])
+    return out
+
+
+def _nearest_jit_fn(mod: ModuleInfo, node: ast.AST) -> ast.AST | None:
+    for fn in mod.enclosing_functions(node):
+        if fn in mod.jit_functions:
+            return fn
+    return None
+
+
+@register_rule(
+    "jit-host-sync",
+    Severity.ERROR,
+    "host-device synchronization inside a jit region (.item()/np.asarray/"
+    "float() on traced values) — stalls the device pipeline every call",
+)
+def check_host_sync(mod: ModuleInfo) -> Iterator[Finding]:
+    rule = check_host_sync.rule
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        jit_fn = _nearest_jit_fn(mod, node)
+        if jit_fn is None:
+            continue
+        callee = dotted_name(node.func)
+        if callee in _HOST_SYNC_CALLS:
+            yield mod.finding(
+                rule, node,
+                f"{_HOST_SYNC_CALLS[callee]} inside a jit region",
+            )
+            continue
+        # method-style syncs: x.item(), scores.tolist()
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HOST_SYNC_METHODS
+            and not node.args
+        ):
+            yield mod.finding(
+                rule, node,
+                f".{node.func.attr}() forces a device→host sync per element "
+                "inside a jit region",
+            )
+            continue
+        # float(x)/int(x)/bool(x) on a (non-static) parameter of the jitted
+        # function: on a tracer this is a ConcretizationTypeError at best, a
+        # silent recompile-per-value trigger at worst.
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id in _PY_CASTS
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Name)
+        ):
+            params = {
+                a.arg
+                for a in (
+                    jit_fn.args.posonlyargs
+                    + jit_fn.args.args
+                    + jit_fn.args.kwonlyargs
+                )
+            }
+            statics = _jit_static_names(jit_fn, mod)
+            if node.args[0].id in params - statics:
+                yield mod.finding(
+                    rule, node,
+                    f"{node.func.id}() on traced argument "
+                    f"{node.args[0].id!r} inside jit — concretizes the "
+                    "tracer (mark it static or keep it on device)",
+                )
+
+
+@register_rule(
+    "jit-scalar-closure",
+    Severity.WARNING,
+    "jit-decorated function closes over an enclosing function's argument — "
+    "every new value bakes a new trace (recompile storm); hoist the capture "
+    "into an argument or cache the builder with functools.lru_cache",
+)
+def check_scalar_closure(mod: ModuleInfo) -> Iterator[Finding]:
+    rule = check_scalar_closure.rule
+    for fn in mod.jit_functions:
+        if not isinstance(fn, _FuncDef):
+            continue
+        enclosing = list(mod.enclosing_functions(fn))
+        if not enclosing:
+            continue  # module-level jit: closures are module constants
+        # the sanctioned pattern: an lru_cache'd builder keys the cache on
+        # exactly the values the closure captures, so each capture set
+        # compiles once (ops/logistic._sharded_epoch)
+        if any(_is_cached(f2) for f2 in enclosing):
+            continue
+        captured = _captured_enclosing_args(fn, enclosing)
+        for name, line_node in captured:
+            yield mod.finding(
+                rule, line_node,
+                f"jitted {fn.name!r} captures {name!r} from its enclosing "
+                "function's arguments — each distinct value triggers a full "
+                "retrace/recompile",
+            )
+
+
+def _is_cached(fn: ast.AST) -> bool:
+    for dec in fn.decorator_list:
+        name = dotted_name(dec if not isinstance(dec, ast.Call) else dec.func)
+        if name and name.split(".")[-1] in ("lru_cache", "cache"):
+            return True
+    return False
+
+
+def _captured_enclosing_args(
+    fn: ast.AST, enclosing: list[ast.AST]
+) -> list[tuple[str, ast.AST]]:
+    """(name, first-load-node) for loads in ``fn`` of names that are
+    parameters of an enclosing function and not shadowed locally."""
+    local: set[str] = {
+        a.arg for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+    }
+    if fn.args.vararg:
+        local.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        local.add(fn.args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            local.add(node.id)
+        elif isinstance(node, _FuncDef) and node is not fn:
+            local.add(node.name)
+    outer_args: set[str] = set()
+    for f2 in enclosing:
+        outer_args |= {
+            a.arg
+            for a in f2.args.posonlyargs + f2.args.args + f2.args.kwonlyargs
+        }
+    seen: set[str] = set()
+    out: list[tuple[str, ast.AST]] = []
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in outer_args
+            and node.id not in local
+            and node.id not in seen
+        ):
+            seen.add(node.id)
+            out.append((node.id, node))
+    return out
+
+
+@register_rule(
+    "jit-tracer-global",
+    Severity.ERROR,
+    "mutation of module-global state inside a jit region — the write runs "
+    "once at trace time and can leak tracers into host state",
+)
+def check_tracer_global(mod: ModuleInfo) -> Iterator[Finding]:
+    rule = check_tracer_global.rule
+    module_names = _module_level_names(mod)
+    mutators = {"append", "extend", "add", "update", "setdefault", "insert"}
+    for node in ast.walk(mod.tree):
+        if not mod.in_jit_context(node):
+            continue
+        if isinstance(node, ast.Global):
+            yield mod.finding(
+                rule, node,
+                f"`global {', '.join(node.names)}` inside a jit region — "
+                "assignments here run at trace time and capture tracers",
+            )
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                root = _subscript_or_attr_root(t)
+                if root is not None and root in module_names:
+                    yield mod.finding(
+                        rule, node,
+                        f"write to module-global {root!r} inside a jit "
+                        "region — runs at trace time, not per call",
+                    )
+        elif isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in mutators
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in module_names
+            ):
+                yield mod.finding(
+                    rule, node,
+                    f"{node.func.value.id}.{node.func.attr}(...) mutates "
+                    "module-global state inside a jit region",
+                )
+
+
+def _module_level_names(mod: ModuleInfo) -> set[str]:
+    """Names bound by module-level assignments (the mutable-global
+    candidates; imports/defs excluded — calling or reading those is fine)."""
+    out: set[str] = set()
+    for stmt in mod.tree.body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                stmt.targets
+                if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+    return out
+
+
+def _subscript_or_attr_root(t: ast.AST) -> str | None:
+    if isinstance(t, (ast.Subscript, ast.Attribute)):
+        cur = t
+        while isinstance(cur, (ast.Subscript, ast.Attribute)):
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            return cur.id
+    return None
+
+
+@register_rule(
+    "jit-missing-donate",
+    Severity.INFO,
+    "state-threading jit (returns an updated version of one of its "
+    "arguments) without donate_argnums/donate_argnames — the old buffer "
+    "stays live across the call, doubling peak memory for large states",
+)
+def check_missing_donate(mod: ModuleInfo) -> Iterator[Finding]:
+    rule = check_missing_donate.rule
+    for fn in mod.jit_functions:
+        if not isinstance(fn, _FuncDef):
+            continue
+        if _jit_has_donate(fn):
+            continue
+        threaded = _threaded_params(fn)
+        if threaded:
+            yield mod.finding(
+                rule, fn,
+                f"jitted {fn.name!r} returns updated argument(s) "
+                f"{sorted(threaded)} without donating them — consider "
+                "donate_argnums so XLA reuses the input buffers",
+            )
+
+
+def _jit_has_donate(fn: ast.AST) -> bool:
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            for kw in dec.keywords:
+                if kw.arg in ("donate_argnums", "donate_argnames"):
+                    return True
+    return False
+
+
+def _threaded_params(fn: ast.AST) -> set[str]:
+    """Parameter names that are reassigned in the body AND appear in a
+    return value — the update-in-place pattern donation exists for."""
+    params = {
+        a.arg for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+    }
+    reassigned: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            if node.id in params:
+                reassigned.add(node.id)
+    if not reassigned:
+        return set()
+    returned: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            # only DIRECT returns (`return params` / `return params, v`) —
+            # a param passed as an argument in the return expression is
+            # being consumed, not threaded
+            elts = (
+                node.value.elts
+                if isinstance(node.value, ast.Tuple)
+                else [node.value]
+            )
+            for sub in elts:
+                if isinstance(sub, ast.Name) and sub.id in reassigned:
+                    returned.add(sub.id)
+    return returned

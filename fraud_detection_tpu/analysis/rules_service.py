@@ -1,0 +1,212 @@
+"""Service-tier lint rules: operational hazards in the network/threading code.
+
+The store tier (netserver/sentinel/netclient) is hand-rolled sockets and
+threads; these rules encode the review checklist that kept biting in chaos
+testing — unbounded blocking I/O, exceptions swallowed without a trace, and
+threads that can wedge interpreter shutdown.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fraud_detection_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Severity,
+    dotted_name,
+    register_rule,
+)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _assign_target_name(mod: ModuleInfo, call: ast.Call) -> str | None:
+    """Dotted name the call's result is bound to (``s = socket.socket(...)``
+    → ``s``; ``self._sock = ...`` → ``self._sock``; ``conn, addr =
+    sock.accept()`` → ``conn``)."""
+    parent = mod.parents.get(call)
+    if isinstance(parent, ast.withitem):
+        var = parent.optional_vars
+        return dotted_name(var) if var is not None else None
+    if not isinstance(parent, ast.Assign) or len(parent.targets) != 1:
+        return None
+    target = parent.targets[0]
+    if isinstance(target, ast.Tuple) and target.elts:
+        return dotted_name(target.elts[0])
+    return dotted_name(target)
+
+
+def _settimeout_targets(mod: ModuleInfo) -> set[str]:
+    """Every dotted name X in the module with an ``X.settimeout(...)`` call."""
+    out: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "settimeout"
+        ):
+            base = dotted_name(node.func.value)
+            if base:
+                out.add(base)
+    return out
+
+
+@register_rule(
+    "socket-no-timeout",
+    Severity.WARNING,
+    "socket created or accepted without a timeout — a silently-dead peer "
+    "blocks the calling thread until TCP gives up (~15 min) or forever",
+)
+def check_socket_timeout(mod: ModuleInfo) -> Iterator[Finding]:
+    rule = check_socket_timeout.rule
+    timeout_targets = _settimeout_targets(mod)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        if callee == "socket.create_connection":
+            # signature: create_connection(address, timeout=..., ...)
+            has_timeout = len(node.args) >= 2 or any(
+                kw.arg == "timeout" for kw in node.keywords
+            )
+            target = _assign_target_name(mod, node)
+            if not has_timeout and (
+                target is None or target not in timeout_targets
+            ):
+                yield mod.finding(
+                    rule, node,
+                    "socket.create_connection without a timeout — connect "
+                    "can hang for the kernel default (minutes)",
+                )
+        elif callee == "socket.socket":
+            target = _assign_target_name(mod, node)
+            if target is None or target not in timeout_targets:
+                yield mod.finding(
+                    rule, node,
+                    "socket.socket() whose handle never gets settimeout() — "
+                    "blocking send/recv on it can wedge the thread",
+                )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "accept"
+            and not node.args
+        ):
+            target = _assign_target_name(mod, node)
+            if target is None or target not in timeout_targets:
+                yield mod.finding(
+                    rule, node,
+                    "accepted connection never gets settimeout() — a "
+                    "stalled peer wedges this handler thread",
+                )
+
+
+_LOGGING_HINTS = ("log", "logger", "logging", "warn", "print_exc", "exception")
+
+
+def _body_handles_error(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises or leaves a trace (logging call)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            head = callee.split(".")[0].lower()
+            tail = callee.split(".")[-1].lower()
+            if any(h in head for h in _LOGGING_HINTS) or any(
+                h in tail for h in _LOGGING_HINTS
+            ):
+                return True
+    return False
+
+
+@register_rule(
+    "silent-except",
+    Severity.WARNING,
+    "`except Exception:` (or bare except) that neither logs nor re-raises — "
+    "swallows real faults invisibly; add debug logging or a "
+    "`# graftcheck: ignore[silent-except]` tag after review",
+)
+def check_silent_except(mod: ModuleInfo) -> Iterator[Finding]:
+    rule = check_silent_except.rule
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is not None:
+            name = dotted_name(node.type)
+            if name not in ("Exception", "BaseException"):
+                continue  # narrowed handlers may legitimately stay quiet
+        if not _body_handles_error(node):
+            kind = (
+                "bare except" if node.type is None else "except Exception"
+            )
+            yield mod.finding(
+                rule, node,
+                f"{kind} swallows the error without logging or re-raising",
+            )
+
+
+@register_rule(
+    "thread-nondaemon-nojoin",
+    Severity.WARNING,
+    "non-daemon thread that is never joined — keeps the process alive after "
+    "main exits; mark daemon=True or join it on shutdown",
+)
+def check_thread_daemon(mod: ModuleInfo) -> Iterator[Finding]:
+    rule = check_thread_daemon.rule
+    joined = _join_targets(mod)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        if callee not in ("threading.Thread", "Thread"):
+            continue
+        daemon_kw = next(
+            (kw for kw in node.keywords if kw.arg == "daemon"), None
+        )
+        if daemon_kw is not None and (
+            not isinstance(daemon_kw.value, ast.Constant)
+            or daemon_kw.value.value is True
+        ):
+            continue  # daemon=True (or dynamic — trust it)
+        target = _assign_target_name(mod, node)
+        if target is not None and target in joined:
+            continue
+        # `t.daemon = True` after construction also counts
+        if target is not None and _daemon_attr_set(mod, target):
+            continue
+        yield mod.finding(
+            rule, node,
+            "threading.Thread without daemon=True and no matching join() — "
+            "can block interpreter shutdown indefinitely",
+        )
+
+
+def _join_targets(mod: ModuleInfo) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+        ):
+            base = dotted_name(node.func.value)
+            if base:
+                out.add(base)
+    return out
+
+
+def _daemon_attr_set(mod: ModuleInfo, target: str) -> bool:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if (
+                isinstance(t, ast.Attribute)
+                and t.attr == "daemon"
+                and dotted_name(t.value) == target
+                and isinstance(node.value, ast.Constant)
+                and node.value.value is True
+            ):
+                return True
+    return False

@@ -55,10 +55,11 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
+
+from fraud_detection_tpu.parallel.compat import shard_map
 
 from fraud_detection_tpu import config
 from fraud_detection_tpu.parallel.mesh import DATA_AXIS

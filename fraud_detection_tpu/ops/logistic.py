@@ -31,8 +31,8 @@ import numpy as np
 import optax
 import optax.tree_utils as otu
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
+from fraud_detection_tpu.parallel.compat import shard_map
 from fraud_detection_tpu.parallel.mesh import DATA_AXIS, default_mesh
 from fraud_detection_tpu.parallel.sharding import (
     as_device_f32,
@@ -112,7 +112,11 @@ def _run_lbfgs(loss_fn, init_params, max_iter: int, tol: float):
         _, state = carry
         count = otu.tree_get(state, "count")
         grad = otu.tree_get(state, "grad")
-        err = otu.tree_max(jax.tree.map(jnp.abs, grad))
+        # max |g| over all leaves, written with jax.tree_util primitives so
+        # it runs on optax versions without tree_utils.tree_max
+        err = jax.tree_util.tree_reduce(
+            jnp.maximum, jax.tree.map(lambda t: jnp.max(jnp.abs(t)), grad)
+        )
         return (count == 0) | ((count < max_iter) & (err >= tol))
 
     init = (init_params, opt.init(init_params))

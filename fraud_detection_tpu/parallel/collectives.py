@@ -13,8 +13,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
+from fraud_detection_tpu.parallel.compat import shard_map
 from fraud_detection_tpu.parallel.mesh import DATA_AXIS, default_mesh
 
 

@@ -1,0 +1,43 @@
+"""JAX version compatibility for the parallelism primitives.
+
+The framework targets the modern API surface (``jax.shard_map`` with
+``check_vma``, jax >= 0.8) but must also run on older toolchains where the
+primitive lives at ``jax.experimental.shard_map.shard_map`` and the
+replication check is spelled ``check_rep`` (jax 0.4.x). This module is the
+single import point — everything else in the repo says
+``from fraud_detection_tpu.parallel.compat import shard_map`` and stays
+version-agnostic.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    _shard_map_impl = jax.shard_map
+else:  # jax < 0.8: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+# ``check_vma`` (new) vs ``check_rep`` (old): same semantic — verify that
+# out_specs' replication claims hold at trace time.
+_PARAMS = inspect.signature(_shard_map_impl).parameters
+_HAS_CHECK_VMA = "check_vma" in _PARAMS
+_HAS_CHECK_REP = "check_rep" in _PARAMS
+
+
+@functools.wraps(_shard_map_impl)
+def shard_map(f=None, /, **kwargs):
+    if "check_vma" in kwargs and not _HAS_CHECK_VMA:
+        val = kwargs.pop("check_vma")
+        if _HAS_CHECK_REP:
+            kwargs["check_rep"] = val
+    elif "check_rep" in kwargs and not _HAS_CHECK_REP:
+        val = kwargs.pop("check_rep")
+        if _HAS_CHECK_VMA:
+            kwargs["check_vma"] = val
+    if f is None:
+        return functools.partial(shard_map, **kwargs)
+    return _shard_map_impl(f, **kwargs)

@@ -23,6 +23,7 @@ reproduced.
 from __future__ import annotations
 
 import json
+import logging
 import sqlite3
 import threading
 import time
@@ -30,6 +31,8 @@ import uuid
 from typing import Any
 
 from fraud_detection_tpu import config
+
+log = logging.getLogger("fraud_detection_tpu.db")
 
 # Status enum (db/models.py:11-14)
 PENDING = "PENDING"
@@ -208,6 +211,8 @@ class SqliteResultsDB:
                 self._conn.execute("SELECT 1").fetchone()
             return True
         except Exception:
+            # health probe contract is bool, but leave a trace for debugging
+            log.debug("results-db ping failed", exc_info=True)
             return False
 
     def close(self) -> None:

@@ -262,7 +262,7 @@ async def _handle_connection(
             writer.close()
             await writer.wait_closed()
         except Exception:
-            pass
+            log.debug("connection close failed", exc_info=True)
 
 
 async def serve(app: App, host: str = "0.0.0.0", port: int = 8000) -> None:

@@ -51,7 +51,7 @@ def create_app(model=None) -> App:
                 payload, dict
             ) else payload
             label, prob = model.score_one(features)
-        except Exception as e:  # noqa: BLE001 — contract: any error → 500
+        except Exception as e:  # noqa: BLE001  # graftcheck: ignore[silent-except] — contract: any error → 500 with the message
             return Response({"error": str(e)}, status_code=500)
         return Response(
             {

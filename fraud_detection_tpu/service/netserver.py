@@ -59,6 +59,7 @@ from fraud_detection_tpu.service.db import SqliteResultsDB
 from fraud_detection_tpu.service.taskq import DEFAULT_MAX_RETRIES, SqliteBroker
 from fraud_detection_tpu.service.wire import (
     AUTH_REJECTION,
+    CONN_STALL_TIMEOUT,
     attach_auth,
     check_auth,
     parse_hostport,
@@ -70,6 +71,9 @@ log = logging.getLogger("fraud_detection_tpu.netserver")
 
 HEARTBEAT_INTERVAL = 1.0
 RESYNC_INTERVAL = 0.5
+# Accept-time stall timeout for command connections (semantics documented
+# at the definition in wire.py). Previously only _serve_subscriber set a
+# timeout, so a stalled peer could wedge any other handler thread.
 # Per-subscriber replication buffer: a replica that stops draining (hung
 # process, dead TCP peer) would otherwise grow its queue without bound on
 # the primary. On overflow the subscriber is dropped; it reconnects and
@@ -184,6 +188,7 @@ class StoreServer:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
+        # graftcheck: ignore[socket-no-timeout] — listener blocks in accept by design; stop() shutdown() unblocks it
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.host, self.port))
@@ -256,6 +261,7 @@ class StoreServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(CONN_STALL_TIMEOUT)
             with self._conns_lock:
                 self._conns.add(conn)
             t = threading.Thread(target=self._handle, args=(conn,), daemon=True)
@@ -264,7 +270,13 @@ class StoreServer:
     def _handle(self, conn: socket.socket) -> None:
         try:
             while not self._stop.is_set():
-                req = recv_frame(conn)
+                try:
+                    req = recv_frame(conn)
+                except TimeoutError:
+                    # idle at a frame boundary; re-check _stop. (A mid-frame
+                    # stall raises StalledPeerError — an OSError, not a
+                    # TimeoutError — and drops the conn via the outer except.)
+                    continue
                 if req is None:
                     return
                 if not check_auth(req, self.auth_token):
@@ -284,9 +296,11 @@ class StoreServer:
                          "error": f"{op} rejected: server is a replica"},
                     )
                 except Exception as e:  # surface server faults to the client
+                    log.debug("op %r failed", op, exc_info=True)
                     send_frame(conn, {"ok": False, "kind": "error", "error": str(e)})
         except Exception:
-            pass  # client went away; per-connection thread exits
+            # client went away (or stalled); per-connection thread exits
+            log.debug("connection handler exiting", exc_info=True)
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
@@ -457,8 +471,9 @@ class StoreServer:
         # send_frame once the TCP buffer fills; without a timeout this
         # thread would never consume its poison pill after an overflow
         # drop, leaking the thread+socket until TCP retransmission gives
-        # up (~15 min). The timeout is per-send() progress, so a slow but
-        # live replica draining a large snapshot is fine.
+        # up (~15 min). sendall() applies the timeout as a deadline on the
+        # whole call, so a replica must drain each frame (snapshot
+        # included) within the window or be dropped-and-resynced.
         conn.settimeout(10 * HEARTBEAT_INTERVAL)
         sub: queue.Queue = queue.Queue(maxsize=REPL_QUEUE_MAX)
         with self._pub_lock:

@@ -36,6 +36,7 @@ from typing import Any
 from fraud_detection_tpu import config
 from fraud_detection_tpu.service.wire import (
     AUTH_REJECTION,
+    CONN_STALL_TIMEOUT,
     attach_auth,
     check_auth,
     parse_hostport,
@@ -100,6 +101,7 @@ class Sentinel:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
+        # graftcheck: ignore[socket-no-timeout] — listener blocks in accept by design; stop() shutdown() unblocks it
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.host, self.port))
@@ -365,13 +367,21 @@ class Sentinel:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            # accept-time stall timeout shared with the store servers
+            # (semantics documented at the definition in wire.py)
+            conn.settimeout(CONN_STALL_TIMEOUT)
             threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
 
     def _handle(self, conn: socket.socket) -> None:
         token = config.store_token()
         try:
             while not self._stop.is_set():
-                req = recv_frame(conn)
+                try:
+                    req = recv_frame(conn)
+                except TimeoutError:
+                    # idle at a frame boundary; a mid-frame stall raises
+                    # StalledPeerError (an OSError) and drops the conn below
+                    continue
                 if req is None:
                     return
                 if not check_auth(req, token):
@@ -392,7 +402,7 @@ class Sentinel:
                         conn, {"ok": False, "kind": "error", "error": f"unknown op {op!r}"}
                     )
         except Exception:
-            pass
+            log.debug("sentinel command connection failed", exc_info=True)
         finally:
             try:
                 conn.close()

@@ -23,6 +23,7 @@ built-in wire client).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sqlite3
 import threading
@@ -32,6 +33,8 @@ from dataclasses import dataclass
 from typing import Any
 
 from fraud_detection_tpu import config
+
+log = logging.getLogger("fraud_detection_tpu.taskq")
 
 QUEUED = "QUEUED"
 CLAIMED = "CLAIMED"
@@ -262,6 +265,7 @@ class SqliteBroker:
                 self._conn.execute("SELECT 1").fetchone()
             return True
         except Exception:
+            log.debug("broker ping failed", exc_info=True)
             return False
 
     def close(self) -> None:

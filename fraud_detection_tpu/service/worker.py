@@ -108,7 +108,8 @@ class XaiWorker:
         for t in tasks:
             try:
                 prepared.append((t, self.model.prepare_row(t.args[1])))
-            except Exception as e:  # bad input fails only ITS task
+            except Exception as e:  # graftcheck: ignore[silent-except] — captured into outcome, settled+logged by _settle
+                # bad input fails only ITS task
                 outcome[t.id] = e
         if not prepared:
             return outcome
@@ -128,7 +129,8 @@ class XaiWorker:
             scores = self.model.scorer.predict_proba(rows)[:k]
             phis, expected_value = self.model.explain_batch(rows)
             phis = phis[:k]
-        except Exception as e:  # device failure fails the whole batch
+        except Exception as e:  # graftcheck: ignore[silent-except] — captured into outcome, settled+logged by _settle
+            # device failure fails the whole batch
             for t, _ in prepared:
                 outcome[t.id] = e
             return outcome
@@ -145,7 +147,8 @@ class XaiWorker:
                     )
                 outcome[t.id] = None
                 log.info("[%s] explained %s (score %.4f)", corr_id, tx_id, score)
-            except Exception as e:  # DB failure fails only ITS task
+            except Exception as e:  # graftcheck: ignore[silent-except] — captured into outcome, settled+logged by _settle
+                # DB failure fails only ITS task
                 outcome[t.id] = e
         return outcome
 
@@ -190,7 +193,7 @@ class XaiWorker:
             with metrics.timed(metrics.xai_task_duration):
                 self._execute(task)
             err = None
-        except Exception as e:
+        except Exception as e:  # graftcheck: ignore[silent-except] — settled (retry ladder + logging) below
             err = e
         self._settle(task, err)
 

@@ -1,0 +1,14 @@
+"""graftcheck fixture: KNOWN-BAD state threading without donation.
+
+Expected findings: jit-missing-donate × 1.
+"""
+
+import jax
+
+
+@jax.jit
+def train_step(params, opt_state, batch):
+    grads = jax.grad(lambda p: (p * batch).sum())(params)
+    params = params - 0.1 * grads
+    opt_state = opt_state + 1
+    return params, opt_state

@@ -1,0 +1,36 @@
+"""graftcheck fixture: KNOWN-BAD recompile triggers + tracer leaks.
+
+Expected findings: jit-scalar-closure × 2, jit-tracer-global × 3.
+"""
+
+import jax
+import jax.numpy as jnp
+
+_TRACE_LOG = []
+_CACHE = {}
+
+
+def make_step(lr, momentum):
+    @jax.jit
+    def step(params, grads):
+        # BAD ×2: lr and momentum are baked into the trace — every new
+        # value recompiles
+        return params - lr * grads * momentum
+
+    return step
+
+
+_COUNTER = 0
+
+
+@jax.jit
+def leaky(x):
+    global _COUNTER  # BAD: trace-time global mutation
+    _COUNTER += 1
+    _TRACE_LOG.append(x)  # BAD: leaks the tracer into a module list
+    _CACHE["last"] = x  # BAD: leaks the tracer into a module dict
+    return x * 2.0
+
+
+def scale_all(xs, factor):
+    return [jnp.asarray(x) * factor for x in xs]

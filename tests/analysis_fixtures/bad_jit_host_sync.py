@@ -1,0 +1,34 @@
+"""graftcheck fixture: KNOWN-BAD host-device syncs inside jit regions.
+
+Never imported — parsed by tests/test_analysis_rules.py. Expected findings:
+jit-host-sync × 4.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def scores_to_host(x):
+    p = jax.nn.sigmoid(x)
+    return np.asarray(p)  # BAD: host materialization inside jit
+
+
+@jax.jit
+def scalar_sync(x):
+    total = jnp.sum(x)
+    return total.item()  # BAD: per-element device→host sync
+
+
+@partial(jax.jit, static_argnames=("k",))
+def cast_traced(x, threshold, k):
+    n = int(k)  # fine: k is static
+    t = float(threshold)  # BAD: concretizes the traced threshold
+    return jnp.top_k(x, n)[0] > t
+
+
+@jax.jit
+def listify(x):
+    return x.tolist()  # BAD: host sync

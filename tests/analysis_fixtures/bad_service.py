@@ -1,0 +1,41 @@
+"""graftcheck fixture: KNOWN-BAD service-tier hazards.
+
+Expected findings: socket-no-timeout × 3, silent-except × 2,
+thread-nondaemon-nojoin × 1.
+"""
+
+import socket
+import threading
+
+
+def fetch(host, port):
+    s = socket.create_connection((host, port))  # BAD: no timeout
+    s.sendall(b"ping")
+    return s.recv(64)
+
+
+def serve(port):
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # BAD: no timeout
+    srv.bind(("0.0.0.0", port))
+    srv.listen(8)
+    while True:
+        conn, _ = srv.accept()  # BAD: accepted conn never gets settimeout
+        try:
+            conn.sendall(b"hello")
+        except Exception:  # BAD: silent swallow
+            pass
+        finally:
+            conn.close()
+
+
+def start_background(fn):
+    t = threading.Thread(target=fn)  # BAD: non-daemon, never joined
+    t.start()
+    return t
+
+
+def best_effort(fn):
+    try:
+        return fn()
+    except Exception:  # BAD: bare swallow without logging
+        return None

@@ -1,0 +1,20 @@
+"""graftcheck fixture: KNOWN-GOOD donation patterns — ZERO findings."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def train_step(params, opt_state, batch):
+    grads = jax.grad(lambda p: (p * batch).sum())(params)
+    params = params - 0.1 * grads
+    opt_state = opt_state + 1
+    return params, opt_state
+
+
+@jax.jit
+def consume(params, batch):
+    # passing a param to a call in the return is consumption, not threading
+    params = params * 2.0
+    return jax.nn.sigmoid(params @ batch)

@@ -1,0 +1,43 @@
+"""graftcheck fixture: KNOWN-GOOD jit code that must produce ZERO findings.
+
+The same operations the bad fixtures flag, placed where they are legitimate:
+host conversions outside jit, casts of static arguments, device-side
+jnp equivalents inside jit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("out_dtype",))
+def score(coef, intercept, x, out_dtype=jnp.float32):
+    p = jax.nn.sigmoid(x.astype(jnp.float32) @ coef + intercept)
+    return p.astype(out_dtype)
+
+
+def predict(coef, intercept, x):
+    # host boundary OUTSIDE jit: exactly where np.asarray belongs
+    x = np.asarray(x, dtype=np.float32)
+    return np.asarray(score(jnp.asarray(coef), jnp.asarray(intercept), x))
+
+
+def fit(x, y, c: float = 1.0, max_iter: int = 100):
+    # float()/int() on host values before tracing: fine
+    return _fit(jnp.asarray(x), jnp.asarray(y), float(c), int(max_iter))
+
+
+@partial(jax.jit, static_argnames=("c", "max_iter"))
+def _fit(x, y, c, max_iter):
+    del max_iter
+    scale = float(c)  # fine: c is a static argname, a real Python float
+    return jnp.mean(x, axis=0) * scale + jnp.mean(y)
+
+
+@jax.jit
+def device_side(x):
+    # the device-side spellings of the operations jit-host-sync flags
+    arr = jnp.asarray(x)
+    total = jnp.sum(arr)
+    return jnp.where(total > 0, arr, -arr)

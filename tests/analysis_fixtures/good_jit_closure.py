@@ -1,0 +1,29 @@
+"""graftcheck fixture: KNOWN-GOOD closure/caching patterns — ZERO findings.
+
+The sanctioned shapes for per-hyperparameter compilation: an lru_cache'd
+builder (cache key == closure capture set), module-constant captures, and
+values passed as traced arguments instead of captured.
+"""
+
+import functools
+
+import jax
+
+_EPS = 1e-6  # module constant: capturing this is fine
+
+
+@functools.lru_cache(maxsize=16)
+def make_step(lr, momentum):
+    # lru_cache'd builder: one compile per (lr, momentum) — the closure is
+    # exactly the cache key, so there is no storm
+    @jax.jit
+    def step(params, grads):
+        return params - lr * grads * momentum + _EPS
+
+    return step
+
+
+@jax.jit
+def step_with_args(params, grads, lr):
+    # the capture-free alternative: lr is traced, no recompile per value
+    return params - lr * grads

@@ -1,0 +1,57 @@
+"""graftcheck fixture: KNOWN-GOOD service-tier patterns — ZERO findings.
+
+Each hazard from bad_service.py in its reviewed form: timeouts applied,
+exceptions logged or narrowed, threads daemonized or joined — plus one
+deliberate use of the suppression tag.
+"""
+
+import logging
+import socket
+import threading
+
+log = logging.getLogger(__name__)
+
+
+def fetch(host, port):
+    s = socket.create_connection((host, port), timeout=5.0)
+    s.sendall(b"ping")
+    return s.recv(64)
+
+
+def serve_one(srv_sock):
+    conn, _ = srv_sock.accept()
+    conn.settimeout(30.0)
+    try:
+        conn.sendall(b"hello")
+    except Exception:
+        log.debug("client went away", exc_info=True)
+    finally:
+        conn.close()
+
+
+def make_listener(port):
+    # graftcheck: ignore[socket-no-timeout] — listener blocks in accept by design
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(8)
+    return srv
+
+
+def run_workers(fns):
+    threads = [threading.Thread(target=fn, daemon=True) for fn in fns]
+    for t in threads:
+        t.start()
+
+
+def run_and_wait(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+    return t
+
+
+def narrowed(fn):
+    try:
+        return fn()
+    except (OSError, ValueError):  # narrowed: quiet handling is reviewed
+        return None

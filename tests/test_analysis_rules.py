@@ -1,0 +1,174 @@
+"""Rule-engine unit tests: every rule's true-positive and false-positive
+behavior against the known-bad/known-good fixtures, plus the suppression
+and baseline mechanics the repo gate depends on.
+
+Pure-stdlib analysis pass — no jax needed for these (the fixtures are
+parsed, never imported).
+"""
+
+import os
+from collections import Counter
+
+import pytest
+
+from fraud_detection_tpu.analysis.baseline import apply as baseline_apply
+from fraud_detection_tpu.analysis.core import (
+    Severity,
+    analyze_file,
+    analyze_paths,
+)
+from fraud_detection_tpu.analysis import baseline as baseline_mod
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+
+
+def rule_counts(filename):
+    findings = analyze_file(
+        os.path.join(FIXTURES, filename), root=FIXTURES
+    )
+    return Counter(f.rule_id for f in findings), findings
+
+
+# -- true positives ---------------------------------------------------------
+
+
+def test_host_sync_rule_true_positives():
+    counts, findings = rule_counts("bad_jit_host_sync.py")
+    assert counts["jit-host-sync"] == 4, findings
+    assert all(
+        f.severity is Severity.ERROR
+        for f in findings
+        if f.rule_id == "jit-host-sync"
+    )
+
+
+def test_closure_and_global_rules_true_positives():
+    counts, findings = rule_counts("bad_jit_closure.py")
+    assert counts["jit-scalar-closure"] == 2, findings
+    assert counts["jit-tracer-global"] == 3, findings
+
+
+def test_donate_rule_true_positive():
+    counts, findings = rule_counts("bad_donate.py")
+    assert counts["jit-missing-donate"] == 1, findings
+    (f,) = [x for x in findings if x.rule_id == "jit-missing-donate"]
+    assert "params" in f.message and "opt_state" in f.message
+
+
+def test_service_rules_true_positives():
+    counts, findings = rule_counts("bad_service.py")
+    assert counts["socket-no-timeout"] == 3, findings
+    assert counts["silent-except"] == 2, findings
+    assert counts["thread-nondaemon-nojoin"] == 1, findings
+
+
+# -- false positives --------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "good",
+    [
+        "good_jit.py",
+        "good_jit_closure.py",
+        "good_donate.py",
+        "good_service.py",
+    ],
+)
+def test_good_fixtures_are_clean(good):
+    counts, findings = rule_counts(good)
+    assert not findings, f"false positives in {good}: {findings}"
+
+
+# -- suppression mechanics --------------------------------------------------
+
+
+def test_suppression_tag_is_rule_scoped(tmp_path):
+    src = (
+        "import socket\n"
+        "def a():\n"
+        "    # graftcheck: ignore[socket-no-timeout]\n"
+        "    return socket.create_connection(('h', 1))\n"
+        "def b():\n"
+        "    # graftcheck: ignore[silent-except]\n"
+        "    return socket.create_connection(('h', 1))\n"
+    )
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    findings = analyze_file(str(p), root=str(tmp_path))
+    # a(): suppressed by the matching tag; b(): the tag names another rule
+    assert [f.line for f in findings] == [7]
+
+
+def test_bare_suppression_tag_suppresses_all(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text(
+        "import socket\n"
+        "s = socket.create_connection(('h', 1))  # graftcheck: ignore\n"
+    )
+    assert analyze_file(str(p), root=str(tmp_path)) == []
+
+
+def test_suppression_comment_inside_string_is_inert(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text(
+        "import socket\n"
+        "MSG = '# graftcheck: ignore'\n"
+        "s = socket.create_connection(('h', 1))\n"
+    )
+    findings = analyze_file(str(p), root=str(tmp_path))
+    assert len(findings) == 1
+
+
+# -- baseline mechanics -----------------------------------------------------
+
+
+def test_baseline_roundtrip_and_staleness(tmp_path):
+    _, findings = rule_counts("bad_service.py")
+    path = str(tmp_path / "baseline.json")
+    baseline_mod.save(path, findings)
+    entries = baseline_mod.load(path)
+    result = baseline_apply(findings, entries)
+    assert result.new == [] and len(result.suppressed) == len(findings)
+    # removing a finding from "the repo" leaves its entry stale, not failing
+    result = baseline_apply(findings[1:], entries)
+    assert result.new == [] and len(result.stale) == 1
+
+
+def test_baseline_fingerprint_survives_line_shift(tmp_path):
+    src = "import socket\ns = socket.create_connection(('h', 1))\n"
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    (before,) = analyze_file(str(p), root=str(tmp_path))
+    p.write_text("# a new comment line above\n\n" + src)
+    (after,) = analyze_file(str(p), root=str(tmp_path))
+    assert before.line != after.line
+    assert before.fingerprint == after.fingerprint
+
+
+def test_baseline_does_not_cover_new_instances(tmp_path):
+    src = "import socket\ns = socket.create_connection(('h', 1))\n"
+    p = tmp_path / "m.py"
+    p.write_text(src)
+    (one,) = analyze_file(str(p), root=str(tmp_path))
+    # two textually identical findings, baseline budget of one: the second
+    # occurrence is NEW (multiset matching, not set matching)
+    p.write_text(src + "s = socket.create_connection(('h', 1))\n")
+    two = analyze_file(str(p), root=str(tmp_path))
+    assert len(two) == 2
+    result = baseline_apply(two, [one.to_dict()])
+    assert len(result.new) == 1 and len(result.suppressed) == 1
+
+
+# -- driver behavior --------------------------------------------------------
+
+
+def test_fixture_directory_is_excluded_from_default_scans():
+    findings = analyze_paths([os.path.dirname(FIXTURES)], root=FIXTURES)
+    assert not any("analysis_fixtures" in f.path for f in findings)
+
+
+def test_syntax_error_is_reported_not_raised(tmp_path):
+    p = tmp_path / "m.py"
+    p.write_text("def broken(:\n")
+    (f,) = analyze_file(str(p), root=str(tmp_path))
+    assert f.rule_id == "syntax-error" and f.severity is Severity.ERROR

@@ -21,6 +21,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = r"""
@@ -44,7 +46,7 @@ assert jax.local_device_count() == 4
 assert jax.device_count() == 8
 
 mesh = create_mesh()  # all 8 global devices on the data axis
-from jax import shard_map
+from fraud_detection_tpu.parallel.compat import shard_map
 
 summed = shard_map(
     lambda x: jax.lax.psum(x, DATA_AXIS),
@@ -105,6 +107,15 @@ def test_two_process_dcn_psum():
             p.kill()
             out, _ = p.communicate()
             outs.append(out)
+    if any(
+        "Multiprocess computations aren't implemented on the CPU backend"
+        in out
+        for out in outs
+    ):
+        pytest.skip(
+            "this jaxlib cannot run multi-process collectives on CPU; the "
+            "DCN bring-up path needs a newer toolchain or real hardware"
+        )
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"DCN_OK rank={rank} psum=12.0" in out, out

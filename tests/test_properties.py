@@ -7,7 +7,12 @@ CPU mesh would dominate the suite.
 """
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 SETTINGS = dict(max_examples=15, deadline=None)
 

@@ -1,0 +1,121 @@
+"""THE GATE: the repo itself must be clean under graftcheck.
+
+Two assertions CI also enforces via the CLI (``graftcheck --format json``):
+
+1. the lint pass over ``fraud_detection_tpu/`` yields no findings beyond
+   the checked-in baseline (``analysis_baseline.json``);
+2. every registered jit entrypoint abstractly shape-verifies under virtual
+   CPU meshes of sizes 1, 2 and 8 (conftest.py provides the 8 virtual
+   devices).
+
+A PR that introduces a host sync in a jit region, a recompile-trigger
+closure, a socket without a timeout, or a sharding that stops composing at
+some mesh size fails HERE, on CPU, before it ever reaches TPU hardware.
+"""
+
+import os
+
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from fraud_detection_tpu.analysis import baseline as baseline_mod
+from fraud_detection_tpu.analysis import meshcheck
+from fraud_detection_tpu.analysis.core import analyze_paths
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "fraud_detection_tpu")
+
+
+def test_repo_is_lint_clean_modulo_baseline():
+    findings = analyze_paths([PKG], root=REPO_ROOT)
+    entries = baseline_mod.load(
+        os.path.join(REPO_ROOT, baseline_mod.DEFAULT_BASELINE)
+    )
+    result = baseline_mod.apply(findings, entries)
+    msg = "\n".join(
+        f"{f.path}:{f.line}: [{f.rule_id}] {f.message}" for f in result.new
+    )
+    assert not result.new, f"non-baselined graftcheck findings:\n{msg}"
+
+
+def test_tests_directory_parses_cleanly():
+    # the fixture dir is excluded by DEFAULT_EXCLUDES; everything else in
+    # tests/ must at minimum parse (syntax-error findings are real failures)
+    findings = analyze_paths(
+        [os.path.join(REPO_ROOT, "tests")], root=REPO_ROOT
+    )
+    syntax = [f for f in findings if f.rule_id == "syntax-error"]
+    assert not syntax, syntax
+
+
+def test_every_entrypoint_shape_verifies_at_all_mesh_sizes():
+    results = meshcheck.verify_all()
+    failures = [r for r in results if not r["ok"]]
+    msg = "\n".join(
+        f"[{r['entrypoint']}] mesh={r['mesh_size']}: {r['error']}"
+        for r in failures
+    )
+    assert not failures, f"virtual-mesh verification failures:\n{msg}"
+    # the registry covers the paper's full numerics surface at 1/2/8 each
+    names = {r["entrypoint"] for r in results}
+    assert {
+        "scorer.score", "logistic.lbfgs_fit", "logistic.sgd_epoch",
+        "gbt.boost_step", "gbt.predict_proba", "smote.oversample",
+        "linear_shap.batch", "tree_shap.batch", "scaler.fit_transform",
+    } <= names
+    for name in names:
+        sizes = sorted(
+            r["mesh_size"] for r in results if r["entrypoint"] == name
+        )
+        assert sizes == [1, 2, 8], (name, sizes)
+
+
+def test_verifier_catches_indivisible_sharding():
+    """Negative control: the verifier must FAIL a sharding that stops
+    composing — 1003 rows over the data axis don't divide an 8-way mesh."""
+    ep = meshcheck.Entrypoint(
+        name="negative.indivisible",
+        build=lambda mesh: (
+            lambda x: x * 2.0,
+            (meshcheck.sds((1003, 30), jnp.float32, mesh, P("data")),),
+        ),
+        mesh_sizes=(8,),
+    )
+    (res,) = meshcheck.verify_entrypoint(ep)
+    assert not res["ok"] and "divisible" in res["error"]
+
+
+def test_verifier_catches_shard_map_mismatch():
+    """Negative control: a shard_map whose global batch can't split over
+    the mesh must fail at abstract-eval time (rows not divisible by the
+    data-axis size inside the sharded SGD epoch)."""
+    import jax
+
+    from fraud_detection_tpu.ops.logistic import LogisticParams, _sharded_epoch
+
+    devices = jax.devices()
+    assert len(devices) >= 8
+    mesh = meshcheck.create_mesh(
+        meshcheck.MeshSpec(data=8), devices=devices[:8]
+    )
+    fn = _sharded_epoch(mesh, 1.0, 1001, 0.9, 64)
+    rows = 1004  # divisible by nothing relevant: not by 8
+    args = (
+        LogisticParams(
+            coef=meshcheck.sds((30,), jnp.float32),
+            intercept=meshcheck.sds((), jnp.float32),
+        ),
+        LogisticParams(
+            coef=meshcheck.sds((30,), jnp.float32),
+            intercept=meshcheck.sds((), jnp.float32),
+        ),
+        meshcheck.sds((rows, 30), jnp.float32),
+        meshcheck.sds((rows,), jnp.float32),
+        meshcheck.sds((rows,), jnp.float32),
+        meshcheck.sds((rows,), jnp.float32),
+        meshcheck.sds((rows // 8,), jnp.int32),
+        meshcheck.sds((), jnp.float32),
+    )
+    with pytest.raises(Exception):
+        jax.eval_shape(fn, *args)
