@@ -286,6 +286,50 @@ def _build_tree_shap(mesh: Mesh):
     return tree_shap, (explainer, x)
 
 
+@register_entrypoint("watchtower.baseline_profile")
+def _build_baseline_profile(mesh: Mesh):
+    from fraud_detection_tpu.monitor.baseline import (
+        N_FEATURE_BINS,
+        N_SCORE_BINS,
+        _profile,
+    )
+
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, P(DATA_AXIS))
+    scores = sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))
+    feature_edges = sds((_FEATURES, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    return _profile, (x, scores, feature_edges, score_edges)
+
+
+@register_entrypoint("watchtower.window_update")
+def _build_window_update(mesh: Mesh):
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import (
+        N_CALIB_BINS,
+        DriftWindow,
+        _window_update,
+    )
+
+    window = DriftWindow(
+        feature_counts=sds((_FEATURES, N_FEATURE_BINS), jnp.float32, mesh, P()),
+        score_counts=sds((N_SCORE_BINS,), jnp.float32, mesh, P()),
+        calib_count=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_conf=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_label=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        n_rows=sds((), jnp.float32, mesh, P()),
+    )
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, P(DATA_AXIS))
+    per_row = lambda: sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))  # noqa: E731
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((_FEATURES, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    calib_edges = sds((N_CALIB_BINS - 1,), jnp.float32, mesh, P())
+    return _window_update, (
+        window, x, per_row(), per_row(), per_row(), per_row(),
+        decay, decay, feature_edges, score_edges, calib_edges,
+    )
+
+
 @register_entrypoint("scaler.fit_transform")
 def _build_scaler(mesh: Mesh):
     from fraud_detection_tpu.ops.scaler import _fit, scaler_transform

@@ -210,6 +210,69 @@ def scorer_max_inflight() -> int:
     return _get_int("SCORER_MAX_INFLIGHT", 4)
 
 
+# --------------------------------------------------------------------------
+# Watchtower: online drift & quality monitoring + shadow scoring (monitor/)
+# --------------------------------------------------------------------------
+
+def watchtower_enabled() -> bool | None:
+    """Tri-state ``WATCHTOWER_ENABLED``: unset = auto (monitor when the
+    served model's artifacts carry a baseline profile), 0 = force off,
+    1 = on (warn loudly when no profile is found)."""
+    return env_flag("WATCHTOWER_ENABLED")
+
+
+def shadow_stage() -> str:
+    """Registry alias the challenger resolves from
+    (``models:/{name}@{shadow_stage}``) — the shadow counterpart of
+    ``MLFLOW_MODEL_STAGE``."""
+    return _get("MLFLOW_SHADOW_STAGE", "shadow")
+
+
+def watchtower_halflife_rows() -> float:
+    """Exponential window half-life in rows for the drift/shadow
+    accumulators: how much traffic it takes for old evidence to fade."""
+    return _get_float("WATCHTOWER_HALFLIFE_ROWS", 100_000.0)
+
+
+def watchtower_min_rows() -> int:
+    """Window row floor below which watchtower reports ``warming`` and
+    raises no flags (PSI on a near-empty histogram is noise)."""
+    return _get_int("WATCHTOWER_MIN_ROWS", 512)
+
+
+def watchtower_psi_threshold() -> float:
+    """PSI above this flags drift (industry rule of thumb: >0.2 = shifted)."""
+    return _get_float("WATCHTOWER_PSI_THRESHOLD", 0.2)
+
+
+def watchtower_ks_threshold() -> float:
+    return _get_float("WATCHTOWER_KS_THRESHOLD", 0.15)
+
+
+def watchtower_ece_threshold() -> float:
+    """Windowed expected-calibration-error ceiling (evaluated only once
+    enough labeled feedback rows arrive)."""
+    return _get_float("WATCHTOWER_ECE_THRESHOLD", 0.1)
+
+
+def watchtower_shadow_sample() -> float:
+    """Fraction of scored batches the challenger re-scores (0..1)."""
+    return _get_float("WATCHTOWER_SHADOW_SAMPLE", 0.25)
+
+
+def watchtower_disagree_threshold() -> float:
+    """Champion/challenger decision-disagreement rate above which promotion
+    is advised against (rollback recommendation)."""
+    return _get_float("WATCHTOWER_DISAGREE_THRESHOLD", 0.05)
+
+
+def watchtower_retrain_trigger() -> bool:
+    """``WATCHTOWER_RETRAIN_TRIGGER=1`` lets a drift episode enqueue one
+    ``watchtower.trigger_retrain`` task on the broker. Default off — the
+    recommendation is always exposed; acting on it is an operator opt-in."""
+    return env_flag("WATCHTOWER_RETRAIN_TRIGGER") is True
+
+
 @dataclass
 class Settings:
     """Snapshot of all settings, for logging/debugging."""

@@ -1,0 +1,49 @@
+"""watchtower: shadow scoring + online drift & quality monitoring.
+
+The reference system scores traffic blind — observability stops at request
+latency (SURVEY.md §5). This subsystem adds the model-quality layer:
+
+- :mod:`baseline` — per-feature histogram + score-quantile profile captured
+  at train time as a jitted reduction, saved beside ``model.npz``;
+- :mod:`drift` — jitted sliding-window accumulators on the serving path:
+  per-feature PSI/KS against the baseline, score-distribution PSI/KS, and
+  windowed calibration (ECE) — one fused device call per scored batch with
+  donated window state;
+- :mod:`shadow` — challenger scoring (``models:/{name}@shadow``) on a
+  sampled fraction of live batches, off the request path, tracking
+  champion/challenger decision disagreement and challenger score drift;
+- :mod:`watchtower` — the coordinator: threshold evaluation, Prometheus
+  gauges, ``/monitor/status``, promotion/rollback recommendation, and the
+  optional taskq retrain trigger;
+- :mod:`promlint` — promtool-style validation of the alert-rule /
+  dashboard configs under ``monitoring/`` so drift alerts can't ship broken.
+"""
+
+# Lazy re-exports (PEP 562): graftcheck's virtual-mesh pass and the promlint
+# CLI import monitor submodules from a dependency-light environment (jax +
+# numpy only) — an eager `from .watchtower import ...` here would drag in
+# service.metrics → prometheus_client for every submodule import.
+_EXPORTS = {
+    "PROFILE_FILE": "baseline",
+    "BaselineProfile": "baseline",
+    "build_baseline_profile": "baseline",
+    "load_profile": "baseline",
+    "save_profile": "baseline",
+    "DriftMonitor": "drift",
+    "ShadowScorer": "shadow",
+    "Watchtower": "watchtower",
+    "build_watchtower": "watchtower",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f"fraud_detection_tpu.monitor.{_EXPORTS[name]}"
+        )
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,217 @@
+"""Train-time baseline profile: the reference distribution drift is judged
+against.
+
+Captured once per trained model as jitted reductions (histogramming 200k+
+rows is an embarrassingly-parallel MapReduce — the DrJAX-style shape,
+PAPERS.md), saved as ``monitor_profile.npz`` beside ``model.npz`` so every
+resolution path (registry alias, native dir, promoted artifact copy) carries
+its own baseline:
+
+- **per-feature histograms** over equiprobable (training-quantile) bin
+  edges — the canonical binning for PSI, so a stable live distribution puts
+  ~1/n_bins of its mass in every bin;
+- **score histogram** over uniform [0, 1] edges plus tail quantiles of the
+  held-out score distribution (the drift reference for the serving scores
+  AND for any challenger's scores).
+
+The histogram kernel is shared with the online accumulators in
+:mod:`fraud_detection_tpu.monitor.drift` so baseline and window counts can
+never disagree on binning.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PROFILE_FILE = "monitor_profile.npz"
+
+N_FEATURE_BINS = 16
+N_SCORE_BINS = 20
+SCORE_QUANTILES = (0.5, 0.9, 0.95, 0.99, 0.999)
+
+
+@dataclass(frozen=True)
+class BaselineProfile:
+    feature_edges: np.ndarray  # (d, n_bins - 1) interior edges, sorted
+    feature_counts: np.ndarray  # (d, n_bins)
+    score_edges: np.ndarray  # (s_bins - 1,) interior edges on [0, 1]
+    score_counts: np.ndarray  # (s_bins,)
+    score_quantiles: np.ndarray  # (len(SCORE_QUANTILES),)
+    n_rows: int
+    feature_names: tuple[str, ...]
+
+    @property
+    def n_features(self) -> int:
+        return int(self.feature_edges.shape[0])
+
+
+def feature_histogram(
+    x: jax.Array, edges: jax.Array, weights: jax.Array | None = None
+) -> jax.Array:
+    """Per-feature weighted histogram: ``x`` (n, d) against ``edges``
+    (d, n_edges) → (d, n_edges + 1) counts. Dense one-hot reduction rather
+    than a scatter-add: the scatter unit is the TPU's weak spot (and 7×
+    slower on CPU at micro-batch shapes — same trick as the GBT one-hot
+    histogram contractions). Bin convention: index = #edges ≤ x
+    (``searchsorted side='right'``). Traceable; callers bound ``n`` (the
+    drift path is bucket-padded, the baseline path chunks), so the
+    (n, d, bins) intermediate stays small and fuses."""
+    n_edges = edges.shape[1]
+    idx = jnp.sum(x[:, :, None] >= edges[None, :, :], axis=-1)  # (n, d)
+    onehot = idx[:, :, None] == jnp.arange(n_edges + 1)[None, None, :]
+    if weights is None:
+        return jnp.sum(onehot, axis=0, dtype=jnp.float32)
+    return jnp.sum(
+        onehot * weights.astype(jnp.float32)[:, None, None], axis=0
+    )
+
+
+def score_histogram(
+    scores: jax.Array, edges: jax.Array, weights: jax.Array | None = None
+) -> jax.Array:
+    """Weighted histogram of ``scores`` (n,) against interior ``edges``
+    (n_edges,) → (n_edges + 1,) counts. Same dense one-hot reduction and
+    bin convention as :func:`feature_histogram`. Traceable."""
+    idx = jnp.sum(scores[:, None] >= edges[None, :], axis=-1)  # (n,)
+    onehot = idx[:, None] == jnp.arange(edges.shape[0] + 1)[None, :]
+    if weights is None:
+        return jnp.sum(onehot, axis=0, dtype=jnp.float32)
+    return jnp.sum(onehot * weights.astype(jnp.float32)[:, None], axis=0)
+
+
+@jax.jit
+def _quantile_edges(x: jax.Array, qs: jax.Array) -> jax.Array:
+    """Per-feature quantile bin edges: (n, d) × (n_edges,) → (d, n_edges)."""
+    return jnp.quantile(x.astype(jnp.float32), qs, axis=0).T
+
+
+@jax.jit
+def _profile(
+    x: jax.Array,
+    scores: jax.Array,
+    feature_edges: jax.Array,
+    score_edges: jax.Array,
+    x_weights: jax.Array | None = None,
+    score_weights: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """The baseline reduction: feature + score histograms in one fused
+    program. ``x`` and ``scores`` may have different row counts (feature
+    profile from the train split, score profile from held-out scores).
+    Weights carry the chunked caller's padding mask."""
+    return (
+        feature_histogram(
+            x.astype(jnp.float32), feature_edges, weights=x_weights
+        ),
+        score_histogram(
+            scores.astype(jnp.float32), score_edges, weights=score_weights
+        ),
+    )
+
+
+@jax.jit
+def _score_quantiles(scores: jax.Array, qs: jax.Array) -> jax.Array:
+    return jnp.quantile(scores.astype(jnp.float32), qs)
+
+
+#: rows per chunk of the baseline reduction — bounds the (chunk, d, bins)
+#: one-hot intermediate to a few MB while keeping one executable (the tail
+#: chunk is zero-weight padded to the same shape).
+PROFILE_CHUNK = 1 << 16
+
+
+def build_baseline_profile(
+    x,
+    scores,
+    feature_names: list[str] | None = None,
+    n_bins: int = N_FEATURE_BINS,
+    n_score_bins: int = N_SCORE_BINS,
+) -> BaselineProfile:
+    """Profile training features ``x`` (n, d) + model ``scores`` (m,)."""
+    x = np.asarray(x, np.float32)
+    scores_np = np.asarray(scores, np.float32).reshape(-1)
+    qs = jnp.arange(1, n_bins, dtype=jnp.float32) / n_bins
+    feature_edges = _quantile_edges(jnp.asarray(x), qs)
+    score_edges = jnp.asarray(
+        np.linspace(0.0, 1.0, n_score_bins + 1)[1:-1], jnp.float32
+    )
+
+    n, d = x.shape
+    m = scores_np.shape[0]
+    chunk = min(PROFILE_CHUNK, max(n, m, 1))
+
+    def padded(a: np.ndarray, lo: int) -> tuple[np.ndarray, np.ndarray]:
+        sl = a[lo : lo + chunk]
+        w = np.zeros((chunk,), np.float32)
+        w[: sl.shape[0]] = 1.0
+        if sl.shape[0] < chunk:
+            sl = np.concatenate(
+                [sl, np.zeros((chunk - sl.shape[0],) + a.shape[1:], np.float32)]
+            )
+        return sl, w
+
+    feature_counts = jnp.zeros((d, n_bins), jnp.float32)
+    score_counts = jnp.zeros((n_score_bins,), jnp.float32)
+    for lo in range(0, max(n, m), chunk):
+        xc, xw = padded(x, lo)
+        sc, sw = padded(scores_np, lo)
+        fc, scc = _profile(
+            jnp.asarray(xc), jnp.asarray(sc), feature_edges, score_edges,
+            x_weights=jnp.asarray(xw), score_weights=jnp.asarray(sw),
+        )
+        feature_counts = feature_counts + fc
+        score_counts = score_counts + scc
+    quantiles = _score_quantiles(
+        jnp.asarray(scores_np), jnp.asarray(SCORE_QUANTILES, jnp.float32)
+    )
+    names = tuple(feature_names) if feature_names else tuple(
+        f"f{i}" for i in range(d)
+    )
+    return BaselineProfile(
+        feature_edges=np.asarray(feature_edges, np.float32),
+        feature_counts=np.asarray(feature_counts, np.float32),
+        score_edges=np.asarray(score_edges, np.float32),
+        score_counts=np.asarray(score_counts, np.float32),
+        score_quantiles=np.asarray(quantiles, np.float32),
+        n_rows=n,
+        feature_names=names,
+    )
+
+
+def save_profile(directory: str, profile: BaselineProfile) -> str:
+    """Write ``monitor_profile.npz`` beside the model artifacts."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, PROFILE_FILE)
+    np.savez(
+        path,
+        feature_edges=profile.feature_edges,
+        feature_counts=profile.feature_counts,
+        score_edges=profile.score_edges,
+        score_counts=profile.score_counts,
+        score_quantiles=profile.score_quantiles,
+        n_rows=np.int64(profile.n_rows),
+        feature_names=np.asarray(profile.feature_names),
+    )
+    return path
+
+
+def load_profile(directory: str) -> BaselineProfile | None:
+    """Load the profile from an artifact directory; None when absent (the
+    serving side then runs unmonitored rather than failing the model load)."""
+    path = os.path.join(directory, PROFILE_FILE)
+    if not os.path.exists(path):
+        return None
+    with np.load(path, allow_pickle=False) as z:
+        return BaselineProfile(
+            feature_edges=np.asarray(z["feature_edges"], np.float32),
+            feature_counts=np.asarray(z["feature_counts"], np.float32),
+            score_edges=np.asarray(z["score_edges"], np.float32),
+            score_counts=np.asarray(z["score_counts"], np.float32),
+            score_quantiles=np.asarray(z["score_quantiles"], np.float32),
+            n_rows=int(z["n_rows"]),
+            feature_names=tuple(str(n) for n in z["feature_names"]),
+        )

@@ -1,0 +1,315 @@
+"""Online drift accumulators: jitted sliding-window statistics.
+
+Driven from the microbatch scorer path: every scored batch lands in ONE
+fused device call (``_window_update``, window state donated so XLA updates
+the buffers in place) that bins the batch against the baseline edges and
+folds it into exponentially-decayed window histograms. No per-row host
+work; the host only computes the scalar decay factor.
+
+Statistics are derived lazily (``_drift_stats``, a second small jitted
+program) when ``/monitor/status`` or a Prometheus scrape asks:
+
+- **PSI** per feature and for the score distribution — the population
+  stability index ``Σ (p−q)·ln(p/q)`` over smoothed bin masses (industry
+  convention: <0.1 stable, 0.1–0.2 moderate, >0.2 drifted);
+- **KS** — the two-sample Kolmogorov–Smirnov statistic
+  ``max |CDF_p − CDF_q|`` from the same histograms;
+- **windowed ECE** — expected calibration error over uniform score bins,
+  accumulated only for rows that arrive with feedback labels (fraud labels
+  are delayed; unlabeled traffic leaves calibration state untouched).
+
+The window is exponential (half-life in rows) rather than a ring of
+per-batch histograms: O(1) state, O(1) update, and the half-life knob maps
+directly to "how fast do alerts forget".
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.monitor.baseline import (
+    BaselineProfile,
+    feature_histogram,
+    score_histogram,
+)
+from fraud_detection_tpu.ops.scorer import _bucket
+
+PSI_EPS = 1e-4
+N_CALIB_BINS = 10
+
+
+class DriftWindow(NamedTuple):
+    """Decayed window state — a pytree of device buffers, donated through
+    every update so monitoring holds one live copy."""
+
+    feature_counts: jax.Array  # (d, n_bins)
+    score_counts: jax.Array  # (s_bins,)
+    calib_count: jax.Array  # (c_bins,) labeled rows per score bin
+    calib_conf: jax.Array  # (c_bins,) Σ score over labeled rows
+    calib_label: jax.Array  # (c_bins,) Σ label over labeled rows
+    n_rows: jax.Array  # () decayed row count
+
+
+class DriftStats(NamedTuple):
+    feature_psi: jax.Array  # (d,)
+    feature_ks: jax.Array  # (d,)
+    score_psi: jax.Array  # ()
+    score_ks: jax.Array  # ()
+    ece: jax.Array  # ()
+    n_labeled: jax.Array  # ()
+
+
+def init_window(
+    n_features: int, n_feature_bins: int, n_score_bins: int,
+    n_calib_bins: int = N_CALIB_BINS,
+) -> DriftWindow:
+    return DriftWindow(
+        feature_counts=jnp.zeros((n_features, n_feature_bins), jnp.float32),
+        score_counts=jnp.zeros((n_score_bins,), jnp.float32),
+        calib_count=jnp.zeros((n_calib_bins,), jnp.float32),
+        calib_conf=jnp.zeros((n_calib_bins,), jnp.float32),
+        calib_label=jnp.zeros((n_calib_bins,), jnp.float32),
+        n_rows=jnp.zeros((), jnp.float32),
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _window_update(
+    window: DriftWindow,
+    x: jax.Array,  # (n, d) padded batch
+    scores: jax.Array,  # (n,)
+    labels: jax.Array,  # (n,) feedback labels (0/1), garbage where unlabeled
+    label_valid: jax.Array,  # (n,) 1.0 where labels[i] is real
+    valid: jax.Array,  # (n,) 1.0 for real rows, 0.0 for bucket padding
+    decay: jax.Array,  # () drift forgetting factor (live rows this batch)
+    calib_decay: jax.Array,  # () calibration factor (labeled rows this batch)
+    feature_edges: jax.Array,
+    score_edges: jax.Array,
+    calib_edges: jax.Array,
+) -> DriftWindow:
+    """Fold one scored batch into the window — the per-batch device call.
+
+    ``valid`` masks rows into the DRIFT histograms (live traffic only);
+    ``label_valid`` masks rows into the CALIBRATION state (labeled rows,
+    zero on padding). They are independent so delayed feedback replays —
+    already counted live — can fold calibration-only (valid=0). The decay
+    factors are likewise independent: drift evidence fades in live-row
+    time, calibration evidence in labeled-row time — an unlabeled batch
+    must not erode the (much sparser) calibration window, and a feedback
+    replay must not erode the drift window."""
+    fc = feature_histogram(x.astype(jnp.float32), feature_edges, weights=valid)
+    sc = score_histogram(scores, score_edges, weights=valid)
+    lw = label_valid
+    # calibration bins via the same dense one-hot reduction (no scatter)
+    n_calib = calib_edges.shape[0] + 1
+    cidx = jnp.sum(scores[:, None] >= calib_edges[None, :], axis=-1)
+    onehot = (cidx[:, None] == jnp.arange(n_calib)[None, :]).astype(jnp.float32)
+    cc = lw @ onehot
+    cs = (lw * scores) @ onehot
+    cl = (lw * labels) @ onehot
+    return DriftWindow(
+        feature_counts=window.feature_counts * decay + fc,
+        score_counts=window.score_counts * decay + sc,
+        calib_count=window.calib_count * calib_decay + cc,
+        calib_conf=window.calib_conf * calib_decay + cs,
+        calib_label=window.calib_label * calib_decay + cl,
+        n_rows=window.n_rows * decay + jnp.sum(valid),
+    )
+
+
+def _smoothed_mass(counts: jax.Array) -> jax.Array:
+    """Additively-smoothed bin masses along the last axis — keeps PSI finite
+    on empty bins without visibly biasing populated ones."""
+    n_bins = counts.shape[-1]
+    total = jnp.sum(counts, axis=-1, keepdims=True)
+    return (counts + PSI_EPS) / (total + PSI_EPS * n_bins)
+
+
+def psi_from_counts(p_counts: jax.Array, q_counts: jax.Array) -> jax.Array:
+    """Population stability index along the last axis (traceable)."""
+    p = _smoothed_mass(p_counts)
+    q = _smoothed_mass(q_counts)
+    return jnp.sum((p - q) * jnp.log(p / q), axis=-1)
+
+
+def ks_from_counts(p_counts: jax.Array, q_counts: jax.Array) -> jax.Array:
+    """Two-sample KS statistic from histograms along the last axis."""
+    p = p_counts / jnp.maximum(jnp.sum(p_counts, axis=-1, keepdims=True), 1.0)
+    q = q_counts / jnp.maximum(jnp.sum(q_counts, axis=-1, keepdims=True), 1.0)
+    return jnp.max(
+        jnp.abs(jnp.cumsum(p, axis=-1) - jnp.cumsum(q, axis=-1)), axis=-1
+    )
+
+
+@jax.jit
+def _drift_stats(
+    window: DriftWindow,
+    base_feature_counts: jax.Array,
+    base_score_counts: jax.Array,
+) -> DriftStats:
+    n_labeled = jnp.sum(window.calib_count)
+    cnt = jnp.maximum(window.calib_count, 1e-9)
+    conf = window.calib_conf / cnt
+    acc = window.calib_label / cnt
+    w = window.calib_count / jnp.maximum(n_labeled, 1e-9)
+    return DriftStats(
+        feature_psi=psi_from_counts(window.feature_counts, base_feature_counts),
+        feature_ks=ks_from_counts(window.feature_counts, base_feature_counts),
+        score_psi=psi_from_counts(window.score_counts, base_score_counts),
+        score_ks=ks_from_counts(window.score_counts, base_score_counts),
+        ece=jnp.sum(w * jnp.abs(conf - acc)),
+        n_labeled=n_labeled,
+    )
+
+
+def psi_np(p_counts: np.ndarray, q_counts: np.ndarray) -> float:
+    """Numpy PSI with identical smoothing — for host-side consumers (the
+    shadow scorer's challenger histogram) so thresholds mean the same thing
+    on both paths."""
+    p_counts = np.asarray(p_counts, np.float64)
+    q_counts = np.asarray(q_counts, np.float64)
+    n_bins = p_counts.shape[-1]
+    p = (p_counts + PSI_EPS) / (p_counts.sum() + PSI_EPS * n_bins)
+    q = (q_counts + PSI_EPS) / (q_counts.sum() + PSI_EPS * n_bins)
+    return float(np.sum((p - q) * np.log(p / q)))
+
+
+class DriftMonitor:
+    """Host wrapper: owns the device-resident window, pads batches onto the
+    scorer's power-of-two bucket ladder (so the update program compiles once
+    per bucket, not per batch size), and surfaces stats as floats."""
+
+    def __init__(
+        self,
+        profile: BaselineProfile,
+        halflife_rows: float | None = None,
+        min_bucket: int = 8,
+    ):
+        self.profile = profile
+        self.halflife_rows = float(
+            halflife_rows
+            if halflife_rows is not None
+            else config.watchtower_halflife_rows()
+        )
+        self.min_bucket = min_bucket
+        self._feature_edges = jnp.asarray(profile.feature_edges, jnp.float32)
+        self._score_edges = jnp.asarray(profile.score_edges, jnp.float32)
+        self._calib_edges = jnp.asarray(
+            np.linspace(0.0, 1.0, N_CALIB_BINS + 1)[1:-1], jnp.float32
+        )
+        self._base_fc = jnp.asarray(profile.feature_counts, jnp.float32)
+        self._base_sc = jnp.asarray(profile.score_counts, jnp.float32)
+        self.window = init_window(
+            profile.n_features,
+            profile.feature_counts.shape[1],
+            profile.score_counts.shape[0],
+        )
+        self.rows_seen = 0  # monotonic (not decayed), host-side
+        # decay is a function of the true row count; caching the device
+        # scalar saves one host→device put per update on the ingest path
+        self._decay_cache: dict[int, jax.Array] = {}
+        # update() donates the window buffers — a stats() reader (scrape /
+        # /monitor/status thread) racing the ingest thread would hand
+        # just-invalidated arrays to _drift_stats and crash the scrape.
+        # Both paths are cheap (one dispatch / a small host sync), so one
+        # lock serializes them.
+        self._lock = threading.Lock()
+
+    def _decay_for(self, n: int) -> jax.Array:
+        decay = self._decay_cache.get(n)
+        if decay is None:
+            if len(self._decay_cache) >= 256:
+                # /monitor/feedback batch sizes are client-controlled —
+                # without a bound the cache holds one device scalar per
+                # distinct size for the life of the process
+                self._decay_cache.clear()
+            decay = jnp.float32(0.5 ** (n / self.halflife_rows))
+            self._decay_cache[n] = decay
+        return decay
+
+    def update(self, x, scores, labels=None, calibration_only=False) -> None:
+        """Fold one scored batch in — one fused device call.
+
+        ``calibration_only=True`` is the delayed-feedback path: the rows
+        were already observed live when scored, so they must update ONLY
+        the calibration state — not the drift histograms or row counts."""
+        x = np.asarray(x, np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        scores = np.asarray(scores, np.float32).reshape(-1)
+        n = x.shape[0]
+        b = _bucket(n, self.min_bucket)
+        if b != n:
+            x = np.concatenate([x, np.zeros((b - n, x.shape[1]), np.float32)])
+            scores = np.concatenate([scores, np.zeros(b - n, np.float32)])
+        real = np.zeros(b, np.float32)
+        real[:n] = 1.0
+        valid = np.zeros(b, np.float32) if calibration_only else real
+        if labels is None:
+            lab = np.zeros(b, np.float32)
+            lab_valid = np.zeros(b, np.float32)
+        else:
+            lab = np.zeros(b, np.float32)
+            lab[:n] = np.asarray(labels, np.float32).reshape(-1)
+            lab_valid = real
+        n_live = 0 if calibration_only else n
+        n_labeled = n if labels is not None else 0
+        with self._lock:
+            self.window = _window_update(
+                self.window,
+                jnp.asarray(x),
+                jnp.asarray(scores),
+                jnp.asarray(lab),
+                jnp.asarray(lab_valid),
+                jnp.asarray(valid),
+                self._decay_for(n_live),
+                self._decay_for(n_labeled),
+                self._feature_edges,
+                self._score_edges,
+                self._calib_edges,
+            )
+            if not calibration_only:
+                self.rows_seen += n
+
+    def stats(self) -> dict:
+        """Host-synced snapshot (small arrays; called at status/scrape time,
+        never on the per-batch path)."""
+        with self._lock:
+            s = _drift_stats(self.window, self._base_fc, self._base_sc)
+            # materialize inside the lock: once released, the next update
+            # donates the window buffers these device values derive from
+            feature_psi = np.asarray(s.feature_psi, np.float64)
+            feature_ks = np.asarray(s.feature_ks, np.float64)
+            score_psi = float(s.score_psi)
+            score_ks = float(s.score_ks)
+            ece = float(s.ece)
+            n_labeled = float(s.n_labeled)
+            window_rows = float(self.window.n_rows)
+            rows_seen = self.rows_seen
+        order = np.argsort(feature_psi)[::-1][:5]
+        top = [
+            {
+                "feature": self.profile.feature_names[i],
+                "psi": round(float(feature_psi[i]), 5),
+                "ks": round(float(feature_ks[i]), 5),
+            }
+            for i in order
+        ]
+        return {
+            "window_rows": window_rows,
+            "rows_seen": rows_seen,
+            "feature_psi_max": float(feature_psi.max(initial=0.0)),
+            "feature_ks_max": float(feature_ks.max(initial=0.0)),
+            "score_psi": score_psi,
+            "score_ks": score_ks,
+            "ece": ece,
+            "n_labeled": n_labeled,
+            "top_features": top,
+        }

@@ -1,0 +1,107 @@
+"""Shadow scoring: a challenger model rides a sample of live traffic.
+
+The challenger resolves from the registry alias ``models:/{name}@shadow``
+(:func:`fraud_detection_tpu.service.loading.load_shadow_model`). A
+configurable fraction of scored batches is re-scored by the challenger —
+always OFF the request path (the watchtower's single ingest thread), so a
+slow or broken challenger can never add champion latency; at worst its
+batches are dropped by the watchtower's backlog bound.
+
+Tracked, with the same exponential window semantics as :mod:`drift`:
+
+- **decision disagreement**: fraction of rows where champion and challenger
+  land on opposite sides of the alert threshold — the "would promotion
+  change production behavior" number;
+- **mean |Δscore|**: magnitude of the score gap;
+- **challenger score PSI** against the baseline score histogram — per-model
+  score drift, so the promotion recommendation can compare which model's
+  output distribution still matches training.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.monitor.baseline import BaselineProfile
+from fraud_detection_tpu.monitor.drift import psi_np
+
+log = logging.getLogger("fraud_detection_tpu.watchtower")
+
+
+class ShadowScorer:
+    def __init__(
+        self,
+        scorer,
+        profile: BaselineProfile,
+        sample_rate: float | None = None,
+        threshold: float = 0.5,
+        halflife_rows: float | None = None,
+        seed: int = 0,
+    ):
+        self._scorer = scorer
+        self.sample_rate = float(
+            sample_rate
+            if sample_rate is not None
+            else config.watchtower_shadow_sample()
+        )
+        self.threshold = threshold
+        self.halflife_rows = float(
+            halflife_rows
+            if halflife_rows is not None
+            else config.watchtower_halflife_rows()
+        )
+        self._rng = np.random.default_rng(seed)
+        self._score_edges = np.asarray(profile.score_edges, np.float64)
+        self._base_counts = np.asarray(profile.score_counts, np.float64)
+        self._score_counts = np.zeros_like(self._base_counts)
+        self._rows = 0.0  # decayed
+        self._disagree = 0.0  # decayed
+        self._delta = 0.0  # decayed
+        self.batches_seen = 0
+        self.batches_sampled = 0
+
+    def maybe_observe(self, rows: np.ndarray, champion_scores: np.ndarray) -> bool:
+        """Sample-and-score one batch; returns True when the challenger ran.
+        Called from the watchtower ingest thread, never the request path."""
+        self.batches_seen += 1
+        if self._rng.random() >= self.sample_rate:
+            return False
+        ch = np.asarray(
+            self._scorer.predict_proba(np.asarray(rows, np.float32)),
+            np.float64,
+        ).reshape(-1)
+        champ = np.asarray(champion_scores, np.float64).reshape(-1)
+        n = ch.shape[0]
+        # A sampled batch of n rows stands in for ~n/sample_rate rows of
+        # live traffic, so fade in live-row terms — the halflife knob means
+        # the same amount of traffic here as on the (full-rate) drift window.
+        decay = 0.5 ** (n / (self.halflife_rows * min(self.sample_rate, 1.0)))
+        self._rows = self._rows * decay + n
+        self._disagree = self._disagree * decay + float(
+            np.sum((ch >= self.threshold) != (champ >= self.threshold))
+        )
+        self._delta = self._delta * decay + float(np.sum(np.abs(ch - champ)))
+        # side='right' keeps the bin convention identical to the jitted
+        # histograms (index = #edges <= x) so boundary ties land the same
+        hist = np.bincount(
+            np.searchsorted(self._score_edges, ch, side="right"),
+            minlength=self._base_counts.shape[0],
+        ).astype(np.float64)
+        self._score_counts = self._score_counts * decay + hist
+        self.batches_sampled += 1
+        return True
+
+    def stats(self) -> dict:
+        rows = max(self._rows, 1e-9)
+        return {
+            "sample_rate": self.sample_rate,
+            "batches_seen": self.batches_seen,
+            "batches_sampled": self.batches_sampled,
+            "window_rows": self._rows,
+            "disagreement": self._disagree / rows,
+            "mean_abs_delta": self._delta / rows,
+            "score_psi": psi_np(self._score_counts, self._base_counts),
+        }

@@ -1,0 +1,374 @@
+"""The watchtower coordinator: drift + shadow + thresholds + actions.
+
+One instance per serving process. The micro-batcher hands every scored
+batch to :meth:`Watchtower.observe`, which is non-blocking: batches are
+queued to a single ingest thread (bounded backlog, drop-and-count under
+pressure), so monitoring can never stall the request path — the drift
+update is one fused device call and the shadow challenger runs on the same
+thread behind it.
+
+``status()`` evaluates the configured thresholds and produces:
+
+- a status: ``warming`` (window below ``WATCHTOWER_MIN_ROWS``), ``ok``, or
+  ``drift``;
+- a recommendation:
+  - ``retrain`` — drift detected and no healthier challenger is standing by
+    (optionally fires the ``watchtower.trigger_retrain`` taskq task, once
+    per drift episode);
+  - ``promote_challenger`` — the champion's score distribution drifted but
+    the shadow challenger's still matches the baseline;
+  - ``rollback_challenger`` — champion healthy but the challenger disagrees
+    with it beyond the disagreement threshold (do not promote; unregister
+    the shadow alias);
+  - ``none`` otherwise;
+- the Prometheus gauges the ``monitoring/`` alert rules and Grafana panels
+  read.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from dataclasses import dataclass
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.monitor.baseline import BaselineProfile, load_profile
+from fraud_detection_tpu.monitor.drift import DriftMonitor
+from fraud_detection_tpu.monitor.shadow import ShadowScorer
+from fraud_detection_tpu.service import metrics
+
+log = logging.getLogger("fraud_detection_tpu.watchtower")
+
+RETRAIN_TASK = "watchtower.trigger_retrain"
+
+RECOMMENDATIONS = (
+    "none", "retrain", "promote_challenger", "rollback_challenger"
+)
+
+
+@dataclass(frozen=True)
+class Thresholds:
+    psi: float
+    ks: float
+    ece: float
+    disagree: float
+    min_rows: int
+
+    @classmethod
+    def from_config(cls) -> "Thresholds":
+        return cls(
+            psi=config.watchtower_psi_threshold(),
+            ks=config.watchtower_ks_threshold(),
+            ece=config.watchtower_ece_threshold(),
+            disagree=config.watchtower_disagree_threshold(),
+            min_rows=config.watchtower_min_rows(),
+        )
+
+
+def _recommend(
+    warming: bool, flags: dict, shadow: dict | None, thr: Thresholds
+) -> str:
+    """Pure recommendation logic (unit-tested directly)."""
+    if warming:
+        return "none"
+    drifting = any(flags.values())
+    shadow_ready = (
+        shadow is not None and shadow["window_rows"] >= thr.min_rows
+    )
+    if drifting:
+        if (
+            shadow_ready
+            and flags.get("score_psi")
+            and shadow["score_psi"] <= thr.psi
+        ):
+            return "promote_challenger"
+        return "retrain"
+    if shadow_ready and shadow["disagreement"] > thr.disagree:
+        return "rollback_challenger"
+    return "none"
+
+
+class Watchtower:
+    def __init__(
+        self,
+        profile: BaselineProfile,
+        challenger=None,
+        challenger_source: str | None = None,
+        thresholds: Thresholds | None = None,
+        sample_rate: float | None = None,
+        halflife_rows: float | None = None,
+        retrain_sender=None,
+        max_backlog: int = 32,
+    ):
+        self.thresholds = thresholds or Thresholds.from_config()
+        self.drift = DriftMonitor(profile, halflife_rows=halflife_rows)
+        self.shadow = (
+            ShadowScorer(
+                challenger.scorer,
+                profile,
+                sample_rate=sample_rate,
+                halflife_rows=halflife_rows,
+            )
+            if challenger is not None
+            else None
+        )
+        self.challenger_source = challenger_source
+        self.max_backlog = max_backlog
+        self._retrain_sender = retrain_sender
+        self._retrain_latched = False
+        # a /metrics scrape and a /monitor/status call can evaluate status()
+        # concurrently (separate to_thread workers) — the latch check/set
+        # must be atomic or one episode enqueues duplicate retrain tasks
+        self._retrain_lock = threading.Lock()
+        # Bounded handoff queue + ONE daemon ingest thread, not a thread
+        # pool: put_nowait is ~2µs with no per-call Future allocation — the
+        # observe() hook is the only monitoring cost the request path ever
+        # pays, so it is priced in microseconds (bench: monitored_scoring).
+        self._queue: queue.Queue = queue.Queue(maxsize=max_backlog)
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._ingest_loop, name="watchtower-ingest", daemon=True
+        )
+        self._thread.start()
+
+    # -- ingest (request path adjacent; must never block) -------------------
+    def observe(self, rows, scores, labels=None, calibration_only=False) -> bool:
+        """Queue one scored batch for monitoring. Non-blocking; returns
+        False when the backlog bound forced a drop (counted).
+
+        ``calibration_only=True`` marks a delayed-feedback replay
+        (/monitor/feedback): the rows were already observed live, so they
+        update only calibration state and skip the shadow comparison (the
+        recorded champion scores may predate the current champion)."""
+        try:
+            self._queue.put_nowait((rows, scores, labels, calibration_only))
+        except queue.Full:
+            metrics.watchtower_batches_dropped.inc()
+            return False
+        return True
+
+    def _ingest_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None or self._stop:
+                    return
+                rows, scores, labels, calibration_only = item
+                self.drift.update(
+                    rows, scores, labels, calibration_only=calibration_only
+                )
+                metrics.watchtower_batches_observed.inc()
+                if (
+                    self.shadow is not None
+                    and not calibration_only
+                    and self.shadow.maybe_observe(rows, scores)
+                ):
+                    metrics.watchtower_shadow_batches.inc()
+            except Exception:
+                log.warning("watchtower ingest failed", exc_info=True)
+            finally:
+                self._queue.task_done()
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Wait for queued batches to finish ingesting (tests/shutdown)."""
+        deadline = time.monotonic() + timeout
+        while self._queue.unfinished_tasks:  # Queue.join() has no timeout
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.002)
+        return True
+
+    # -- evaluation ---------------------------------------------------------
+    def status(self) -> dict:
+        """Threshold evaluation + gauge refresh + recommendation. Runs a
+        small host sync; called from /monitor/status and metric scrapes,
+        never per batch."""
+        thr = self.thresholds
+        d = self.drift.stats()
+        sh = self.shadow.stats() if self.shadow is not None else None
+        warming = d["window_rows"] < thr.min_rows
+        flags = {
+            "feature_psi": d["feature_psi_max"] > thr.psi,
+            "feature_ks": d["feature_ks_max"] > thr.ks,
+            "score_psi": d["score_psi"] > thr.psi,
+            "score_ks": d["score_ks"] > thr.ks,
+            "calibration": d["n_labeled"] >= thr.min_rows
+            and d["ece"] > thr.ece,
+        }
+        if warming:
+            flags = {k: False for k in flags}
+        drifting = any(flags.values())
+        recommendation = _recommend(warming, flags, sh, thr)
+        self._maybe_trigger_retrain(recommendation, d)
+
+        # A warming window's raw stats are empty-histogram smoothing noise
+        # (score PSI against an empty window is ~5): exporting them would
+        # trip the `> 0.2 for 15m` alert rules on every fresh deploy, so
+        # the stat gauges read 0 until min_rows. window_rows still exports
+        # so operators can watch the warm-up itself.
+        g = dict.fromkeys(
+            ("feature_psi_max", "feature_ks_max", "score_psi", "score_ks",
+             "ece"),
+            0.0,
+        ) if warming else d
+        metrics.watchtower_feature_psi_max.set(g["feature_psi_max"])
+        metrics.watchtower_feature_ks_max.set(g["feature_ks_max"])
+        metrics.watchtower_score_psi.set(g["score_psi"])
+        metrics.watchtower_score_ks.set(g["score_ks"])
+        # ECE gets the same floor as the calibration flag: a handful of
+        # labeled rows yields ECE near 1, and the calibration window fades
+        # only in labeled-row time, so the noise would outlast the alert's
+        # `for:` window
+        metrics.watchtower_ece.set(
+            g["ece"] if d["n_labeled"] >= thr.min_rows else 0.0
+        )
+        metrics.watchtower_window_rows.set(d["window_rows"])
+        metrics.watchtower_drift_detected.set(1 if drifting else 0)
+        for action in RECOMMENDATIONS:
+            metrics.watchtower_recommendation.labels(action).set(
+                1 if action == recommendation else 0
+            )
+        if sh is not None:
+            # same warm-up suppression as the drift gauges: an empty shadow
+            # window's smoothed PSI is ~3 until sampling fills it
+            shadow_warm = sh["window_rows"] >= thr.min_rows
+            metrics.watchtower_shadow_disagreement.set(
+                sh["disagreement"] if shadow_warm else 0.0
+            )
+            metrics.watchtower_shadow_score_psi.set(
+                sh["score_psi"] if shadow_warm else 0.0
+            )
+
+        return {
+            "enabled": True,
+            "status": "warming" if warming else ("drift" if drifting else "ok"),
+            "recommendation": recommendation,
+            "flags": flags,
+            "drift": d,
+            "shadow": sh,
+            "challenger_source": self.challenger_source,
+            "thresholds": {
+                "psi": thr.psi,
+                "ks": thr.ks,
+                "ece": thr.ece,
+                "disagree": thr.disagree,
+                "min_rows": thr.min_rows,
+            },
+        }
+
+    def _maybe_trigger_retrain(self, recommendation: str, d: dict) -> None:
+        with self._retrain_lock:
+            if recommendation != "retrain":
+                self._retrain_latched = False  # episode over; re-arm
+                return
+            if self._retrain_latched or self._retrain_sender is None:
+                return
+            if not config.watchtower_retrain_trigger():
+                return
+            self._retrain_latched = True  # latch before the send: a racing
+            # status() must not double-enqueue while the broker call runs
+            try:
+                self._retrain_sender(
+                    f"drift detected: "
+                    f"feature_psi_max={d['feature_psi_max']:.4f} "
+                    f"score_psi={d['score_psi']:.4f} ece={d['ece']:.4f}"
+                )
+                metrics.watchtower_retrain_triggers.inc()
+                log.warning(
+                    "watchtower fired retrain trigger task %s", RETRAIN_TASK
+                )
+            except Exception as e:
+                self._retrain_latched = False  # retry on the next evaluation
+                log.error("retrain trigger enqueue failed: %s", e)
+
+    def close(self) -> None:
+        """Stop the ingest thread; still-queued batches are discarded (the
+        window is advisory state — shutdown must not wait on a challenger)."""
+        self._stop = True
+        try:
+            self._queue.put_nowait(None)  # wake the blocked get()
+        except queue.Full:
+            pass  # thread sees _stop on the next dequeue
+        self._thread.join(timeout=5.0)
+
+
+def resolve_profile_dir(model_source: str) -> str | None:
+    """Map a ``load_production_model`` source description to the artifact
+    directory that may hold ``monitor_profile.npz``."""
+    kind, _, rest = model_source.partition(":")
+    if kind == "registry":
+        from fraud_detection_tpu.tracking import TrackingClient
+
+        try:
+            return TrackingClient().registry.resolve(rest)
+        except (FileNotFoundError, ValueError) as e:
+            log.debug("profile dir resolution failed for %s: %s", rest, e)
+            return None
+    if kind == "native":
+        return rest
+    if kind == "joblib":
+        return os.path.dirname(rest) or "."
+    return None
+
+
+def build_watchtower(model, model_source: str, retrain_sender=None):
+    """Serving-side factory: None when disabled (``WATCHTOWER_ENABLED=0``)
+    or when the resolved model artifacts carry no baseline profile (models
+    trained before the watchtower existed keep serving, unmonitored)."""
+    enabled = config.watchtower_enabled()
+    if enabled is False:
+        return None
+    profile_dir = resolve_profile_dir(model_source)
+    profile = load_profile(profile_dir) if profile_dir else None
+    if profile is None:
+        lvl = logging.WARNING if enabled else logging.INFO
+        log.log(
+            lvl,
+            "no %s beside model (%s) — serving unmonitored",
+            "monitor_profile.npz",
+            model_source,
+        )
+        return None
+    if list(profile.feature_names) != list(model.feature_names):
+        log.warning(
+            "baseline profile feature names do not match the served model — "
+            "serving unmonitored (stale profile beside a newer model?)"
+        )
+        return None
+    challenger = challenger_source = None
+    try:
+        from fraud_detection_tpu.service.loading import load_shadow_model
+
+        resolved = load_shadow_model()
+        if resolved is not None:
+            challenger, challenger_source = resolved
+            ch_names = getattr(challenger, "feature_names", None)
+            if ch_names is not None and list(ch_names) != list(
+                model.feature_names
+            ):
+                # Caught here once at startup; inside the ingest loop it
+                # would instead fail on every sampled batch while the
+                # shadow stats silently never accumulate.
+                log.warning(
+                    "shadow challenger %s feature schema does not match the "
+                    "champion — monitoring without it",
+                    challenger_source,
+                )
+                challenger = challenger_source = None
+    except Exception as e:
+        log.warning("shadow model load failed (%s); monitoring without one", e)
+    wt = Watchtower(
+        profile,
+        challenger=challenger,
+        challenger_source=challenger_source,
+        retrain_sender=retrain_sender,
+    )
+    log.info(
+        "watchtower active: baseline over %d rows, challenger=%s",
+        profile.n_rows,
+        challenger_source or "none",
+    )
+    return wt

@@ -12,6 +12,11 @@ Endpoint-for-endpoint rebuild of the reference's FastAPI app (api/app.py):
   pending (api/app.py:262-278); reads the SAME table the worker writes
   (fixing the reference's two-table split-brain, SURVEY.md §2.3.2)
 - ``GET /metrics`` — Prometheus exposition (api/app.py:281)
+- ``GET /monitor/status`` — watchtower drift/shadow state + the
+  promote/rollback/retrain recommendation (no reference counterpart; the
+  reference scores blind — SURVEY.md §5)
+- ``POST /monitor/feedback`` — delayed fraud-label feedback for the
+  watchtower's windowed-calibration (ECE) monitoring
 
 Middleware: per-request correlation ID propagated to the response header,
 logs, and the task args (api/app.py:121-128, 244-245).
@@ -106,6 +111,7 @@ def create_app(
         "batcher": None,
         "db": None,
         "broker": None,
+        "watchtower": None,
         "started_at": None,
     }
     app.state = state  # exposed for tests/embedding
@@ -136,18 +142,42 @@ def create_app(
         try:
             model, source = load_production_model()
             state["model"], state["model_source"] = model, source
-            batcher = MicroBatcher(model.scorer)
+            try:
+                # Monitoring must never take serving down: a broken profile
+                # or challenger degrades to an unmonitored (but scoring) API.
+                from fraud_detection_tpu.monitor import build_watchtower
+                from fraud_detection_tpu.monitor.watchtower import RETRAIN_TASK
+
+                def _retrain_sender(reason: str) -> None:
+                    state["broker"].send_task(RETRAIN_TASK, [reason])
+
+                state["watchtower"] = build_watchtower(
+                    model, source, retrain_sender=_retrain_sender
+                )
+            except Exception as e:
+                state["watchtower"] = None
+                log.warning("watchtower startup failed (%s); unmonitored", e)
+            batcher = MicroBatcher(
+                model.scorer, watchtower=state["watchtower"]
+            )
             await batcher.start()  # warms the bucket ladder; can raise
             state["batcher"] = batcher
             metrics.model_loaded.set(1)
         except RuntimeError as e:
             metrics.model_loaded.set(0)
             state["model"] = state["batcher"] = None  # all-or-nothing
+            if state["watchtower"]:  # built before the warmup failed — a
+                # degraded API must not keep an ingest thread (and shadow
+                # challenger) alive or report monitoring as enabled
+                state["watchtower"].close()
+                state["watchtower"] = None
             log.error("model load/warmup failed at startup: %s", e)
 
     async def shutdown():
         if state["batcher"]:
             await state["batcher"].stop()
+        if state["watchtower"]:
+            state["watchtower"].close()
         if state["db"]:
             state["db"].close()
         if state["broker"]:
@@ -278,11 +308,96 @@ def create_app(
             ).model_dump()
         )
 
+    @app.get("/monitor/status")
+    async def monitor_status(req: Request) -> Response:
+        """Watchtower state: drift statistics, shadow champion/challenger
+        comparison, threshold flags, and the promotion/rollback/retrain
+        recommendation. ``enabled: false`` when the served model carries no
+        baseline profile (or WATCHTOWER_ENABLED=0)."""
+        wt = state["watchtower"]
+        if wt is None:
+            return Response(
+                {"enabled": False, "status": "disabled", "recommendation": "none"}
+            )
+        # status() host-syncs small device arrays — off-loop like the other
+        # dependency probes.
+        body = await asyncio.to_thread(wt.status)
+        return Response(body)
+
+    @app.post("/monitor/feedback")
+    async def monitor_feedback(req: Request) -> Response:
+        """Delayed fraud-label feedback — the calibration (windowed ECE)
+        input. Fraud labels arrive hours-to-days after scoring, from a
+        joiner upstream; it POSTs the original feature rows with the score
+        served and the settled label:
+        ``{"features": [[...30], ...], "scores": [...], "labels": [0|1...]}``.
+        Rows land in the same non-blocking watchtower ingest queue as live
+        traffic (labeled rows update calibration state alongside drift)."""
+        wt = state["watchtower"]
+        model = state["model"]
+        if wt is None or model is None:
+            raise HTTPError(
+                409, "watchtower disabled — no baseline profile loaded"
+            )
+        try:
+            payload = req.json()
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            feats = payload.get("features")
+            scores = payload.get("scores")
+            labels = payload.get("labels")
+            if not isinstance(feats, list) or not feats:
+                raise ValueError("'features' must be a non-empty list of rows")
+            if (
+                not isinstance(scores, list)
+                or not isinstance(labels, list)
+                or len(feats) != len(scores)
+                or len(feats) != len(labels)
+            ):
+                raise ValueError(
+                    "'features', 'scores' and 'labels' must be lists of "
+                    "equal length"
+                )
+            rows = np.stack([model.prepare_row(f) for f in feats])
+            scores_arr = np.asarray(scores, np.float32)
+            labels_arr = np.asarray(labels, np.float32)
+            if scores_arr.ndim != 1 or labels_arr.ndim != 1:
+                # nested lists pass the length checks, then die as a shape
+                # error on the ingest thread AFTER the 202 — reject here
+                raise ValueError("'scores' and 'labels' must be flat lists")
+            if not (
+                np.all(np.isfinite(scores_arr))
+                and np.all((scores_arr >= 0) & (scores_arr <= 1))
+            ):
+                raise ValueError("'scores' must be probabilities in [0, 1]")
+            if not np.all((labels_arr == 0) | (labels_arr == 1)):
+                raise ValueError("'labels' must be 0 or 1")
+        except (TypeError, ValueError) as e:
+            # TypeError too: prepare_row over a non-iterable "row" or
+            # np.asarray over nulls are client input errors, not 500s
+            raise HTTPError(422, str(e)) from e
+        # calibration_only: these rows were already observed live when they
+        # were scored — folding them into the drift histograms again would
+        # double-count them (with a days-old distribution, via the labeled
+        # subset only)
+        queued = wt.observe(rows, scores_arr, labels_arr, calibration_only=True)
+        return Response(
+            {"queued": queued, "rows": int(rows.shape[0])},
+            status_code=202 if queued else 429,
+        )
+
     @app.get("/metrics")
     async def prom(req: Request) -> Response:
         # The API refreshes the queue-depth gauge at scrape time so the KEDA
         # scaling signal survives worker scale-to-zero (workers can't export
         # a gauge while there are zero workers).
+        if state["watchtower"]:
+            try:
+                # refresh the drift/shadow gauges so scrapes see current
+                # statistics even when nobody polls /monitor/status
+                await asyncio.to_thread(state["watchtower"].status)
+            except Exception:  # scrape must not fail on a broken monitor
+                log.debug("watchtower gauge refresh failed", exc_info=True)
         if state["broker"]:
             try:
                 metrics.queue_depth.set(state["broker"].depth())

@@ -66,3 +66,19 @@ def load_production_model() -> tuple[FraudLogisticModel, str]:
         f"no model available: registry {uri} empty and no artifacts at "
         f"{config.model_path()}"
     )
+
+
+def load_shadow_model() -> tuple[FraudLogisticModel, str] | None:
+    """Resolve the challenger ``models:/{name}@{shadow_stage}`` for shadow
+    scoring (watchtower). Registry-only — no local fallback: a challenger
+    is an explicit registration act, never whatever sits on disk. Returns
+    None when the alias doesn't exist (shadowing simply stays off)."""
+    uri = f"models:/{config.model_name()}@{config.shadow_stage()}"
+    try:
+        art = TrackingClient().registry.resolve(uri)
+        model = load_any_model(art)
+        log.info("loaded shadow challenger from %s (%s)", uri, art)
+        return model, f"registry:{uri}"
+    except (FileNotFoundError, ValueError) as e:
+        log.debug("no shadow challenger at %s (%s)", uri, e)
+        return None

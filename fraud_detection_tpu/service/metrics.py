@@ -80,6 +80,87 @@ microbatch_size = Histogram(
     registry=registry,
 )
 
+# Watchtower: online drift / quality / shadow monitoring (monitor/).
+# These names are part of the alerting contract —
+# monitoring/prometheus/rules/watchtower-alerts.yml and the Grafana drift
+# panels read them.
+watchtower_feature_psi_max = Gauge(
+    "watchtower_feature_psi_max",
+    "Max per-feature PSI of the live window vs the training baseline",
+    registry=registry,
+)
+watchtower_feature_ks_max = Gauge(
+    "watchtower_feature_ks_max",
+    "Max per-feature KS statistic vs the training baseline",
+    registry=registry,
+)
+watchtower_score_psi = Gauge(
+    "watchtower_score_psi",
+    "PSI of the live score distribution vs the training baseline",
+    registry=registry,
+)
+watchtower_score_ks = Gauge(
+    "watchtower_score_ks",
+    "KS statistic of the live score distribution vs the training baseline",
+    registry=registry,
+)
+watchtower_ece = Gauge(
+    "watchtower_ece",
+    "Windowed expected calibration error over labeled feedback rows",
+    registry=registry,
+)
+watchtower_window_rows = Gauge(
+    "watchtower_window_rows",
+    "Decayed row count in the drift window",
+    registry=registry,
+)
+watchtower_drift_detected = Gauge(
+    "watchtower_drift_detected",
+    "1 while any drift flag (feature/score/calibration) is raised",
+    registry=registry,
+)
+watchtower_recommendation = Gauge(
+    "watchtower_recommendation",
+    "1 for the currently recommended action, 0 otherwise",
+    ["action"],
+    registry=registry,
+)
+watchtower_shadow_disagreement = Gauge(
+    "watchtower_shadow_disagreement",
+    "Champion/challenger decision disagreement rate in the shadow window",
+    registry=registry,
+)
+watchtower_shadow_score_psi = Gauge(
+    "watchtower_shadow_score_psi",
+    "PSI of the challenger score distribution vs the training baseline",
+    registry=registry,
+)
+watchtower_batches_observed = Counter(
+    "watchtower_batches_observed",
+    "Scored batches folded into the drift window",
+    registry=registry,
+)
+watchtower_batches_dropped = Counter(
+    "watchtower_batches_dropped",
+    "Scored batches dropped by the watchtower backlog bound",
+    registry=registry,
+)
+watchtower_shadow_batches = Counter(
+    "watchtower_shadow_batches",
+    "Batches re-scored by the shadow challenger",
+    registry=registry,
+)
+watchtower_retrain_triggers = Counter(
+    "watchtower_retrain_triggers",
+    "Retrain-trigger tasks enqueued by watchtower",
+    registry=registry,
+)
+retrain_requests = Counter(
+    "watchtower_retrain_requests",
+    "Retrain-trigger tasks processed by workers",
+    registry=registry,
+)
+
 
 def render() -> bytes:
     return generate_latest(registry)

@@ -26,6 +26,7 @@ import numpy as np
 from fraud_detection_tpu import config
 from fraud_detection_tpu.ops.scorer import BatchScorer
 from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.utils.profiling import annotate
 
 log = logging.getLogger("fraud_detection_tpu.microbatch")
 
@@ -37,8 +38,13 @@ class MicroBatcher:
         max_batch: int | None = None,
         max_wait_ms: float | None = None,
         max_inflight: int | None = None,
+        watchtower=None,
     ):
         self.scorer = scorer
+        # Optional monitor.Watchtower: every scored batch is handed to its
+        # non-blocking observe() after the waiters resolve — drift/shadow
+        # monitoring rides the batch boundary, zero per-row host work.
+        self.watchtower = watchtower
         self.max_batch = max_batch or config.scorer_max_batch()
         self.max_wait = (
             max_wait_ms if max_wait_ms is not None else config.scorer_max_wait_ms()
@@ -161,9 +167,13 @@ class MicroBatcher:
             metrics.microbatch_size.observe(len(batch))
             # The device call is synchronous-but-fast; run it in the default
             # executor so the event loop keeps accepting requests while XLA
-            # executes.
+            # executes. annotate() is free when no device_trace is active.
+            def _score() -> np.ndarray:
+                with annotate("microbatch-score"):
+                    return self.scorer.predict_proba(rows)
+
             probs = await asyncio.get_running_loop().run_in_executor(
-                None, self.scorer.predict_proba, rows
+                None, _score
             )
         except Exception as e:  # resolve all waiters with the failure
             for _, f in batch:
@@ -173,3 +183,11 @@ class MicroBatcher:
         for (_, f), p in zip(batch, probs):
             if not f.done():
                 f.set_result(float(p))
+        if self.watchtower is not None:
+            # Waiters are already resolved; observe() only enqueues onto the
+            # watchtower's own ingest thread (bounded, drop-under-pressure),
+            # so a slow monitor can never add request latency.
+            try:
+                self.watchtower.observe(rows, probs)
+            except Exception:
+                log.debug("watchtower observe failed", exc_info=True)

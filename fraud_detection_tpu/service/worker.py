@@ -90,8 +90,25 @@ class XaiWorker:
             correlation_id, transaction_id, score,
         )
 
+    def trigger_retrain(self, reason: str = "") -> None:
+        """Watchtower drift episode (monitor/watchtower.py, one task per
+        episode when WATCHTOWER_RETRAIN_TRIGGER=1). The worker is the
+        operational anchor: it logs the request loudly with the drift
+        evidence — deployments chain their training pipeline off this task
+        (docs/runbooks/DriftDetected.md)."""
+        metrics.retrain_requests.inc()
+        log.warning(
+            "RETRAIN REQUESTED by watchtower: %s — run "
+            "`python -m fraud_detection_tpu.train` and register the new "
+            "model at @shadow (see docs/runbooks/DriftDetected.md)",
+            reason or "(no reason given)",
+        )
+
     def _execute(self, task: Task) -> None:
-        handlers = {"xai_tasks.compute_shap": self.compute_shap}
+        handlers = {
+            "xai_tasks.compute_shap": self.compute_shap,
+            "watchtower.trigger_retrain": self.trigger_retrain,
+        }
         fn = handlers.get(task.name)
         if fn is None:
             raise ValueError(f"unknown task {task.name}")

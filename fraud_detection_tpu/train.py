@@ -38,6 +38,7 @@ from fraud_detection_tpu.data.loader import (
 )
 from fraud_detection_tpu.models.gbt import FraudGBTModel
 from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.monitor.baseline import build_baseline_profile, save_profile
 from fraud_detection_tpu.ops.gbt import GBTConfig, gbt_fit, gbt_predict_proba
 from fraud_detection_tpu.ops.logistic import (
     logistic_fit_lbfgs,
@@ -224,6 +225,16 @@ def train(
         run.log_metric("test_auc", test_auc)
         log.info("test AUC %.4f", test_auc)
 
+        # ---- watchtower baseline profile (monitor/) ----
+        # Profiled in RAW feature space: the serving scorer folds the scaler
+        # into its weights and consumes raw rows, so the drift reference must
+        # bin what the microbatcher actually sees. Score reference comes from
+        # the held-out test scores (the distribution a healthy model emits).
+        profile = build_baseline_profile(
+            x_train, test_scores, feature_names=feature_names
+        )
+        run.log_metric("monitor_profile_rows", profile.n_rows)
+
         # ---- artifacts: native + joblib interchange ----
         model_artifact = run.artifact_path("model")
         if model_family == "gbt":
@@ -242,6 +253,11 @@ def train(
             model = FraudLogisticModel(params, scaler, feature_names)
             model.save(out_dir)
             save_artifacts(model_artifact, params, scaler, feature_names)
+        # Beside model.npz in BOTH destinations: registry registration
+        # copytrees the run artifact dir, so every resolution path (alias,
+        # native dir, promoted copy) carries its own drift baseline.
+        save_profile(out_dir, profile)
+        save_profile(model_artifact, profile)
 
         # ---- AUC promotion gate ----
         threshold = config.auc_threshold()

@@ -22,6 +22,11 @@ import time
 
 log = logging.getLogger("fraud_detection_tpu.profiling")
 
+# Count of device_trace blocks currently capturing. annotate() keys off this
+# so the disabled path (the overwhelmingly common case — serving hot loops
+# run annotated but untraced) allocates nothing per call.
+_active_traces = 0
+
 
 @contextlib.contextmanager
 def device_trace(log_dir: str, create_perfetto_link: bool = False):
@@ -31,6 +36,7 @@ def device_trace(log_dir: str, create_perfetto_link: bool = False):
     out of profiling failures — a broken profiler must not take down
     training or serving.
     """
+    global _active_traces
     import jax
 
     os.makedirs(log_dir, exist_ok=True)
@@ -39,12 +45,14 @@ def device_trace(log_dir: str, create_perfetto_link: bool = False):
     try:
         jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
         started = True
+        _active_traces += 1
     except Exception as e:  # noqa: BLE001 — profiling is best-effort
         log.warning("profiler start failed (%s); running unprofiled", e)
     try:
         yield log_dir
     finally:
         if started:
+            _active_traces -= 1
             try:
                 jax.profiler.stop_trace()
                 log.info(
@@ -56,14 +64,35 @@ def device_trace(log_dir: str, create_perfetto_link: bool = False):
                 log.warning("profiler stop failed: %s", e)
 
 
-@contextlib.contextmanager
+class _NullAnnotation:
+    """Shared no-op context manager for the trace-off path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_ANNOTATION = _NullAnnotation()
+
+
 def annotate(name: str, **kwargs):
     """Name a host-side region in the device timeline
-    (``jax.profiler.TraceAnnotation``); no-op outside an active trace."""
+    (``jax.profiler.TraceAnnotation``). Outside an active ``device_trace``
+    this returns a shared no-op context manager — zero allocations, so
+    annotations can sit on serving hot paths (the micro-batch flush loop)
+    at no cost when nobody is tracing. The gate keys on ``device_trace``'s
+    own counter: traces started via raw ``jax.profiler.start_trace`` are
+    invisible to it and get no annotations — always profile through
+    :func:`device_trace`."""
+    if _active_traces == 0:
+        return _NULL_ANNOTATION
     import jax
 
-    with jax.profiler.TraceAnnotation(name, **kwargs):
-        yield
+    return jax.profiler.TraceAnnotation(name, **kwargs)
 
 
 def save_device_memory_profile(path: str) -> bool:

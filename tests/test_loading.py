@@ -1,0 +1,190 @@
+"""Direct tests for service/loading.py (satellite of the watchtower PR):
+the three-source fallback order — registry alias → native ``model.npz``
+dir → reference joblib artifacts — the degraded-health RuntimeError path,
+and the shadow-challenger resolution the watchtower rides on.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import scaler_fit
+from fraud_detection_tpu.service.loading import (
+    load_production_model,
+    load_shadow_model,
+)
+from fraud_detection_tpu.tracking import TrackingClient
+
+KAGGLE = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+
+
+def _mk_model(rng, intercept: float = -1.0) -> FraudLogisticModel:
+    d = 30
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32),
+        intercept=np.float32(intercept),
+    )
+    x = rng.standard_normal((64, d)).astype(np.float32)
+    return FraudLogisticModel(params, scaler_fit(x), KAGGLE)
+
+
+@pytest.fixture()
+def env(tmp_path, rng, monkeypatch):
+    """Isolated tracking root + a model dir holding BOTH interchange
+    formats (native model.npz and reference joblib artifacts)."""
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    monkeypatch.delenv("REQUIRE_REGISTRY_MODEL", raising=False)
+    model_dir = str(tmp_path / "models")
+    model = _mk_model(rng)
+    model.save(model_dir, joblib_too=True)
+    monkeypatch.setenv(
+        "MODEL_PATH", os.path.join(model_dir, "logistic_model.joblib")
+    )
+    monkeypatch.setenv("SCALER_PATH", os.path.join(model_dir, "scaler.joblib"))
+    monkeypatch.setenv(
+        "FEATURE_NAMES_PATH", os.path.join(model_dir, "feature_names.json")
+    )
+    return tmp_path, model_dir, model
+
+
+def test_registry_alias_wins_over_local_artifacts(env, rng):
+    """Source 1 beats 2 and 3: with a registered @prod model AND both local
+    formats on disk, the registry version must serve — even though the
+    local artifacts are different weights."""
+    tmp_path, _, _ = env
+    registered = _mk_model(rng, intercept=2.5)  # distinguishable weights
+    art = str(tmp_path / "registered")
+    registered.save(art, joblib_too=False)
+    TrackingClient().registry.register_if_gate(
+        "fraud", art, 0.99, 0.5, alias="prod"
+    )
+    model, source = load_production_model()
+    assert source == "registry:models:/fraud@prod"
+    x = np.zeros((4, 30), np.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.predict_proba(x)),
+        np.asarray(registered.predict_proba(x)),
+        rtol=1e-5,
+    )
+
+
+def test_native_dir_preferred_over_joblib(env):
+    """Source 2 beats 3: empty registry, both formats on disk → the native
+    model.npz dir loads (joblib is the last resort, not a peer)."""
+    _, model_dir, _ = env
+    model, source = load_production_model()
+    assert source == f"native:{model_dir}"
+    assert os.path.exists(os.path.join(model_dir, "logistic_model.joblib"))
+
+
+def test_joblib_is_last_resort(env):
+    """Source 3: empty registry and no model.npz → the reference-format
+    joblib artifacts load."""
+    _, model_dir, reference = env
+    os.remove(os.path.join(model_dir, "model.npz"))
+    model, source = load_production_model()
+    assert source == f"joblib:{os.path.join(model_dir, 'logistic_model.joblib')}"
+    x = np.full((4, 30), 0.5, np.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.predict_proba(x)),
+        np.asarray(reference.predict_proba(x)),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+def test_joblib_without_scaler_file(env, monkeypatch):
+    """A missing scaler joblib must not fail the load — the reference
+    treats the scaler as optional (api/utils.py)."""
+    _, model_dir, _ = env
+    os.remove(os.path.join(model_dir, "model.npz"))
+    monkeypatch.setenv("SCALER_PATH", os.path.join(model_dir, "nope.joblib"))
+    model, source = load_production_model()
+    assert source.startswith("joblib:")
+    p = float(np.asarray(model.predict_proba(np.zeros((1, 30), np.float32)))[0, 1])
+    assert 0.0 <= p <= 1.0
+
+
+def test_runtime_error_when_no_source_available(tmp_path, monkeypatch):
+    """All three sources empty → RuntimeError naming both the registry URI
+    and the artifact path (what the operator needs to fix it)."""
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    monkeypatch.delenv("REQUIRE_REGISTRY_MODEL", raising=False)
+    monkeypatch.setenv("MODEL_PATH", str(tmp_path / "nowhere" / "m.joblib"))
+    with pytest.raises(RuntimeError) as ei:
+        load_production_model()
+    assert "models:/fraud@prod" in str(ei.value)
+    assert "m.joblib" in str(ei.value)
+
+
+def test_degraded_health_when_model_unloadable(tmp_path, monkeypatch):
+    """The API wraps the RuntimeError into degraded readiness: /health 503
+    with model=failed, scoring 503s, but the process stays up."""
+    from fraud_detection_tpu.service import metrics
+    from fraud_detection_tpu.service.app import create_app
+    from fraud_detection_tpu.service.http import TestClient
+
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    monkeypatch.delenv("REQUIRE_REGISTRY_MODEL", raising=False)
+    monkeypatch.setenv("MODEL_PATH", str(tmp_path / "void" / "m.joblib"))
+    client = TestClient(
+        create_app(
+            database_url=f"sqlite:///{tmp_path}/f.db",
+            broker_url=f"sqlite:///{tmp_path}/q.db",
+        )
+    )
+    try:
+        r = client.get("/health")
+        assert r.status_code == 503
+        body = r.json()
+        assert body["status"] == "degraded"
+        assert body["checks"]["model"] != "ok"
+        assert metrics.model_loaded._value.get() == 0
+        assert client.app.state["watchtower"] is None
+    finally:
+        client.close()
+
+
+# -- shadow challenger resolution (watchtower) ------------------------------
+
+def test_load_shadow_model_none_without_alias(env):
+    """No @shadow alias → None (shadow scoring simply stays off); local
+    artifacts must NOT leak in as a challenger."""
+    assert load_shadow_model() is None
+
+
+def test_load_shadow_model_resolves_registry_alias(env, rng):
+    tmp_path, _, _ = env
+    challenger = _mk_model(rng, intercept=1.0)
+    art = str(tmp_path / "challenger")
+    challenger.save(art, joblib_too=False)
+    reg = TrackingClient().registry
+    version = reg.register("fraud", art, metrics={"auc": 0.98})
+    reg.set_alias("fraud", "shadow", version)
+    resolved = load_shadow_model()
+    assert resolved is not None
+    model, source = resolved
+    assert source == "registry:models:/fraud@shadow"
+    x = np.zeros((4, 30), np.float32)
+    np.testing.assert_allclose(
+        np.asarray(model.predict_proba(x)),
+        np.asarray(challenger.predict_proba(x)),
+        rtol=1e-5,
+    )
+
+
+def test_shadow_stage_env_override(env, rng, monkeypatch):
+    """MLFLOW_SHADOW_STAGE renames the alias the challenger resolves from."""
+    tmp_path, _, _ = env
+    challenger = _mk_model(rng)
+    art = str(tmp_path / "canary")
+    challenger.save(art, joblib_too=False)
+    reg = TrackingClient().registry
+    reg.set_alias("fraud", "canary", reg.register("fraud", art))
+    monkeypatch.setenv("MLFLOW_SHADOW_STAGE", "canary")
+    resolved = load_shadow_model()
+    assert resolved is not None
+    assert resolved[1] == "registry:models:/fraud@canary"

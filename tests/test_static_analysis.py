@@ -63,6 +63,7 @@ def test_every_entrypoint_shape_verifies_at_all_mesh_sizes():
         "scorer.score", "logistic.lbfgs_fit", "logistic.sgd_epoch",
         "gbt.boost_step", "gbt.predict_proba", "smote.oversample",
         "linear_shap.batch", "tree_shap.batch", "scaler.fit_transform",
+        "watchtower.baseline_profile", "watchtower.window_update",
     } <= names
     for name in names:
         sizes = sorted(

@@ -6,6 +6,7 @@ import logging
 import os
 
 import numpy as np
+import pytest
 
 from fraud_detection_tpu.utils import annotate, device_trace, setup_json_logging
 from fraud_detection_tpu.utils.jsonlog import JsonFormatter
@@ -45,6 +46,41 @@ def test_json_formatter_fields():
     assert out["correlation_id"] == "abc-123"
     assert out["unserializable"].startswith("<object")
     assert out["ts"].endswith("Z")
+
+
+def test_annotate_disabled_path_zero_allocation():
+    """Outside a device_trace, annotate() must hand back the shared no-op
+    context manager — no per-call object construction on the serving hot
+    path (the micro-batch flush annotates every scored batch)."""
+    from fraud_detection_tpu.utils import profiling
+
+    cm1 = annotate("hot-region")
+    cm2 = annotate("other-region", level=2)
+    assert cm1 is cm2 is profiling._NULL_ANNOTATION
+    with cm1 as v:  # still a working context manager
+        assert v is None
+
+
+def test_annotate_active_inside_device_trace(tmp_path):
+    """Inside an active trace annotate() returns a real TraceAnnotation;
+    after the trace closes it reverts to the shared no-op."""
+    import jax
+
+    from fraud_detection_tpu.utils import profiling
+
+    with device_trace(str(tmp_path / "t")):
+        cm = annotate("region")
+        assert isinstance(cm, jax.profiler.TraceAnnotation)
+        with cm:
+            pass
+    assert annotate("region") is profiling._NULL_ANNOTATION
+
+
+def test_annotate_exception_passthrough():
+    """The no-op manager must not swallow exceptions."""
+    with pytest.raises(ValueError):
+        with annotate("boom"):
+            raise ValueError("boom")
 
 
 def test_setup_json_logging_idempotent(capsys):
