@@ -61,6 +61,7 @@ SECTION_BUDGETS = {
     "stream_scoring": 300,
     "sync_scoring": 300,
     "monitored_scoring": 240,
+    "lifecycle": 240,
     "dp_train": 360,
     "online_load": 300,
     "worker_tasks": 300,
@@ -362,6 +363,97 @@ def bench_monitored_scoring(x, coef, intercept, mean, scale) -> dict[str, float]
         "overhead_frac": hook_s / (batch / plain),
         "ingest_rows_per_sec": float(ingest_rate),
         "dropped_frac": dropped / max(observed + dropped, 1.0),
+    }
+
+
+def bench_lifecycle(x, coef, intercept, mean, scale) -> dict[str, float]:
+    """Conductor numbers (lifecycle/): what a closed-loop retrain costs and
+    what a promotion costs the serving path.
+
+    - ``retrain_cold_s`` / ``retrain_warm_s`` — sharded DP L-BFGS fit wall
+      time from zeros vs warm-started from the incumbent's params (the
+      conductor's path: the champion is near the new optimum when drift is
+      marginal, so the linesearch converges in a fraction of the passes);
+    - ``gate_eval_s`` — both models scored + the fused AUC/ECE/PSI gate
+      program on a holdout slice (one device program per slice, no host
+      loops — the GPUTreeShap-spirit batched evaluation);
+    - ``swap_pause_ms`` — wall time of ``ModelSlot.swap`` with a pre-warmed
+      challenger, vs ``batch_interval_ms`` (the serving batch period it
+      must undercut): the swap is a reference store, so promotion costs the
+      request path less than one batch — the "no restart, no dropped
+      requests" number."""
+    import jax
+
+    from fraud_detection_tpu.lifecycle.gate import _gate_stats
+    from fraud_detection_tpu.lifecycle.swap import ModelSlot
+    from fraud_detection_tpu.ops.logistic import (
+        LogisticParams,
+        logistic_fit_lbfgs,
+    )
+
+    n, d = 1 << 16, x.shape[1]
+    rng = np.random.default_rng(3)
+    xt = x[:n]
+    y = (xt @ coef - 1.0 + rng.standard_normal(n).astype(np.float32) > 0).astype(
+        np.int32
+    )
+
+    logistic_fit_lbfgs(xt[: 1 << 12], y[: 1 << 12], max_iter=8, sharded=True)
+    t0 = time.perf_counter()
+    cold = logistic_fit_lbfgs(xt, y, max_iter=100, sharded=True)
+    cold_s = time.perf_counter() - t0
+    # warm start at the incumbent: mimic marginal drift by perturbing the
+    # converged params slightly (what the champion is to the new optimum)
+    warm_init = LogisticParams(
+        coef=np.asarray(cold.coef) * 0.98, intercept=np.asarray(cold.intercept)
+    )
+    t0 = time.perf_counter()
+    logistic_fit_lbfgs(xt, y, max_iter=100, sharded=True, warm_start=warm_init)
+    warm_s = time.perf_counter() - t0
+
+    # gate eval: champion + challenger scores → fused stats program
+    import jax.numpy as jnp
+
+    champ = _scorer(coef, intercept, mean, scale)
+    chall = _scorer(coef * 1.02, intercept, mean, scale)
+    score_edges = jnp.asarray(np.linspace(0, 1, 21)[1:-1], jnp.float32)
+    calib_edges = jnp.asarray(np.linspace(0, 1, 11)[1:-1], jnp.float32)
+    weights = jnp.ones((n,), jnp.float32)
+    labels = jnp.asarray(y, jnp.float32)
+    _gate_stats(  # compile
+        jnp.zeros((n,)), jnp.zeros((n,)), labels, weights, score_edges,
+        calib_edges,
+    )
+    t0 = time.perf_counter()
+    cs = jnp.asarray(champ.predict_proba(xt))
+    hs = jnp.asarray(chall.predict_proba(xt))
+    out = _gate_stats(cs, hs, labels, weights, score_edges, calib_edges)
+    jax.block_until_ready(out)
+    float(out[0])  # true fetch barrier
+    gate_s = time.perf_counter() - t0
+
+    # swap pause vs the serving batch interval
+    slot = ModelSlot(None, "bench:champion", 1)
+    batch = 1 << 11
+    champ.predict_proba(xt[:batch])
+    chall.predict_proba(xt[:batch])  # challenger pre-warmed (reloader contract)
+    t0 = time.perf_counter()
+    reps = 64
+    for i in range(reps):
+        lo = (i * batch) % (n - batch)
+        champ.predict_proba(xt[lo : lo + batch])
+    batch_interval_s = (time.perf_counter() - t0) / reps
+    pauses = []
+    for i in range(32):
+        t0 = time.perf_counter()
+        slot.swap(None, "bench:challenger", i + 2)
+        pauses.append(time.perf_counter() - t0)
+    return {
+        "retrain_cold_s": cold_s,
+        "retrain_warm_s": warm_s,
+        "gate_eval_s": gate_s,
+        "swap_pause_ms": float(np.median(pauses) * 1e3),
+        "batch_interval_ms": batch_interval_s * 1e3,
     }
 
 
@@ -939,6 +1031,24 @@ def main() -> None:
             monitor_overhead_frac=round(mon_res["overhead_frac"], 4),
             monitor_ingest_rows_per_sec=round(mon_res["ingest_rows_per_sec"]),
             monitor_dropped_frac=round(mon_res["dropped_frac"], 4),
+        )
+    lc_res = h.section("lifecycle", bench_lifecycle, x, coef, intercept,
+                       mean, scale)
+    if lc_res:
+        h.update(
+            lifecycle_retrain_cold_s=round(lc_res["retrain_cold_s"], 3),
+            lifecycle_retrain_warm_s=round(lc_res["retrain_warm_s"], 3),
+            lifecycle_warm_start_speedup=round(
+                lc_res["retrain_cold_s"] / max(lc_res["retrain_warm_s"], 1e-9),
+                2,
+            ),
+            lifecycle_gate_eval_s=round(lc_res["gate_eval_s"], 4),
+            lifecycle_swap_pause_ms=round(lc_res["swap_pause_ms"], 4),
+            lifecycle_batch_interval_ms=round(lc_res["batch_interval_ms"], 3),
+            # the promotion SLO: a swap must cost less than one batch period
+            lifecycle_swap_sub_batch=bool(
+                lc_res["swap_pause_ms"] < lc_res["batch_interval_ms"]
+            ),
         )
 
     # ---- end-to-end serving / training sections

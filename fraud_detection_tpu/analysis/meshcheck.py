@@ -167,14 +167,18 @@ def _build_scorer(mesh: Mesh):
 
 @register_entrypoint("logistic.lbfgs_fit")
 def _build_lbfgs(mesh: Mesh):
-    from fraud_detection_tpu.ops.logistic import _fit_lbfgs
+    from fraud_detection_tpu.ops.logistic import LogisticParams, _fit_lbfgs
 
     x = sds((_ROWS, _FEATURES), jnp.float32, mesh, P(DATA_AXIS))
     y = sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))
     sw = sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))
+    init = LogisticParams(  # warm-start seed (replicated, like the output)
+        coef=sds((_FEATURES,), jnp.float32, mesh, P()),
+        intercept=sds((), jnp.float32, mesh, P()),
+    )
     return (
-        lambda xx, yy, ss: _fit_lbfgs(xx, yy, ss, 1.0, 5, 1e-4),
-        (x, y, sw),
+        lambda xx, yy, ss, ii: _fit_lbfgs(xx, yy, ss, ii, 1.0, 5, 1e-4),
+        (x, y, sw, init),
     )
 
 
@@ -327,6 +331,22 @@ def _build_window_update(mesh: Mesh):
     return _window_update, (
         window, x, per_row(), per_row(), per_row(), per_row(),
         decay, decay, feature_edges, score_edges, calib_edges,
+    )
+
+
+@register_entrypoint("lifecycle.gate_eval")
+def _build_gate_eval(mesh: Mesh):
+    from fraud_detection_tpu.lifecycle.gate import (
+        N_GATE_CALIB_BINS,
+        N_GATE_SCORE_BINS,
+        _gate_stats,
+    )
+
+    per_row = lambda: sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))  # noqa: E731
+    score_edges = sds((N_GATE_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    calib_edges = sds((N_GATE_CALIB_BINS - 1,), jnp.float32, mesh, P())
+    return _gate_stats, (
+        per_row(), per_row(), per_row(), per_row(), score_edges, calib_edges,
     )
 
 

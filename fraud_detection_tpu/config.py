@@ -273,6 +273,75 @@ def watchtower_retrain_trigger() -> bool:
     return env_flag("WATCHTOWER_RETRAIN_TRIGGER") is True
 
 
+# --------------------------------------------------------------------------
+# Conductor: closed-loop retrain → challenger gate → promotion (lifecycle/)
+# --------------------------------------------------------------------------
+
+def lifecycle_db_url(broker: str | None = None) -> str:
+    """Database holding the conductor's feedback + state tables.
+    ``LIFECYCLE_DB_URL`` wins; otherwise the broker database (``broker``
+    when the caller holds an explicit URL — an embedded app/worker keeps
+    its state beside its queue — else ``CELERY_BROKER_URL``) when that is
+    a SQL backend, so lifecycle state shares the queue's durability story;
+    the network-store broker (``fraud://``/``sentinel://``) has no generic
+    SQL surface, so the lifecycle tier falls back to its own local file."""
+    explicit = os.environ.get("LIFECYCLE_DB_URL")
+    if explicit:
+        return explicit
+    broker = broker or broker_url()
+    if broker.startswith(("sqlite", "postgresql://", "postgres://")):
+        return broker
+    return "sqlite:///lifecycle.db"
+
+
+def conductor_auto_promote() -> bool:
+    """``CONDUCTOR_AUTO_PROMOTE=1`` lets watchtower's ``promote_challenger``
+    / ``rollback_challenger`` recommendations enqueue the matching conductor
+    tasks (one per episode, latched like the retrain trigger). Default off:
+    alias flips move real traffic, so hands-free promotion is an explicit
+    operator opt-in (docs/runbooks/ModelPromotion.md)."""
+    return env_flag("CONDUCTOR_AUTO_PROMOTE") is True
+
+
+def conductor_gate_auc_margin() -> float:
+    """ε in the challenger gate ``AUC ≥ champion AUC − ε``."""
+    return _get_float("CONDUCTOR_GATE_AUC_MARGIN", 0.005)
+
+
+def conductor_gate_ece_bound() -> float:
+    """Challenger expected-calibration-error ceiling on the labeled slices."""
+    return _get_float("CONDUCTOR_GATE_ECE_BOUND", 0.1)
+
+
+def conductor_gate_psi_bound() -> float:
+    """Ceiling on PSI(challenger scores ‖ champion scores) over the holdout —
+    a challenger whose score mix departs this far from the incumbent would
+    invalidate downstream alert thresholds even with a good AUC."""
+    return _get_float("CONDUCTOR_GATE_PSI_BOUND", 0.25)
+
+
+def conductor_feedback_window() -> int:
+    """Max rows kept in the recent labeled-feedback window."""
+    return _get_int("CONDUCTOR_FEEDBACK_WINDOW", 50_000)
+
+
+def conductor_reservoir_size() -> int:
+    """Uniform-over-history reservoir size for feedback replay."""
+    return _get_int("CONDUCTOR_RESERVOIR_SIZE", 10_000)
+
+
+def conductor_min_eval_rows() -> int:
+    """Labeled-window row floor below which the gate skips the recent-slice
+    AUC criterion (a handful of labels is noise, not evidence)."""
+    return _get_int("CONDUCTOR_MIN_EVAL_ROWS", 256)
+
+
+def lifecycle_reload_interval() -> float:
+    """Seconds between registry alias polls by the serving-side model
+    reloader; 0 disables polling (``POST /admin/reload`` still works)."""
+    return _get_float("LIFECYCLE_RELOAD_INTERVAL_S", 15.0)
+
+
 @dataclass
 class Settings:
     """Snapshot of all settings, for logging/debugging."""

@@ -1,0 +1,34 @@
+"""Conductor: the closed-loop model lifecycle (retrain → gate → promote).
+
+Watchtower (:mod:`fraud_detection_tpu.monitor`) detects drift and emits
+recommendations; this package acts on them hands-free:
+
+- :mod:`store` — durable labeled-feedback (windowed + reservoir) and the
+  persisted, crash-resumable state machine;
+- :mod:`retrain` — warm-started sharded DP refit + evaluation assembly;
+- :mod:`gate` — the jitted challenger gate (AUC/ECE/score-PSI bounds);
+- :mod:`conductor` — the state machine driver consuming the taskq tasks;
+- :mod:`swap` — atomic hot model swap on the serving path (no restarts).
+"""
+
+from fraud_detection_tpu.lifecycle.conductor import (  # noqa: F401
+    FEEDBACK_TASK,
+    PROMOTE_TASK,
+    ROLLBACK_TASK,
+    Conductor,
+)
+from fraud_detection_tpu.lifecycle.gate import (  # noqa: F401
+    GateResult,
+    GateThresholds,
+    evaluate_gate,
+)
+from fraud_detection_tpu.lifecycle.retrain import run_retrain  # noqa: F401
+from fraud_detection_tpu.lifecycle.store import (  # noqa: F401
+    LifecycleStore,
+    open_lifecycle_store,
+)
+from fraud_detection_tpu.lifecycle.swap import (  # noqa: F401
+    ModelReloader,
+    ModelSlot,
+    warm_scorer,
+)

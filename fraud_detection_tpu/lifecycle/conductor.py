@@ -1,0 +1,360 @@
+"""The conductor: closes the watchtower loop end to end.
+
+Watchtower (PR 2) detects drift and *recommends* — ``retrain`` /
+``promote_challenger`` / ``rollback_challenger``. The conductor acts on the
+recommendations through an idempotent, crash-resumable state machine
+persisted in the lifecycle store::
+
+    idle ──(retrain task)──▶ retraining ──gate pass──▶ gated ──@shadow──▶ shadowing
+      ▲                          │                                          │
+      │                      gate fail                           promote /  │ rollback
+      │                          ▼                                          ▼
+      └──(new episode)── rolled_back ◀──rollback──── promoting ──alias──▶ done
+
+Every transition is a compare-and-set on the persisted row
+(:meth:`LifecycleStore.transition`), with the *intent* (challenger version,
+prior champion version) written BEFORE the side effect (registry alias
+flip). A worker killed mid-step resumes via :meth:`Conductor.resume`:
+
+- ``retraining``  → the fit left no partial registry state; re-run it;
+- ``gated``       → challenger registered but ``@shadow`` possibly not set:
+                    re-set the alias (idempotent) and move on;
+- ``promoting``   → the alias either moved or didn't: setting it to the
+                    recorded target version again is a no-op if it did —
+                    promotion can never double-apply or skip a model.
+
+The CAS also carries the retrain latch across processes: a second
+``trigger_retrain`` task landing while an episode is mid-flight loses the
+``idle → retraining`` transition and is dropped (watchtower's in-process
+latch already bounds one task per episode; this bounds one *episode* per
+conductor no matter how many API replicas fire triggers).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.lifecycle import store as st
+from fraud_detection_tpu.lifecycle.retrain import RetrainResult, run_retrain
+from fraud_detection_tpu.lifecycle.store import LifecycleStore
+from fraud_detection_tpu.service import metrics
+
+log = logging.getLogger("fraud_detection_tpu.lifecycle")
+
+# Task names the worker dispatches to the conductor (watchtower's retrain
+# task name is unchanged — monitor/watchtower.py RETRAIN_TASK).
+PROMOTE_TASK = "lifecycle.promote_challenger"
+ROLLBACK_TASK = "lifecycle.rollback_challenger"
+FEEDBACK_TASK = "lifecycle.record_feedback"
+
+# Episode states that must not be interrupted by a new retrain.
+_BUSY = (st.RETRAINING, st.GATED, st.PROMOTING)
+_RESTARTABLE = (st.IDLE, st.DONE, st.ROLLED_BACK, st.SHADOWING)
+
+
+class Conductor:
+    def __init__(
+        self,
+        store: LifecycleStore | None = None,
+        tracking_client=None,
+        model_name: str | None = None,
+        retrain_kwargs: dict | None = None,
+        on_promote=None,
+    ):
+        from fraud_detection_tpu.tracking import TrackingClient
+
+        self.store = store or st.open_lifecycle_store()
+        self.client = tracking_client or TrackingClient()
+        self.name = model_name or config.model_name()
+        self.retrain_kwargs = dict(retrain_kwargs or {})
+        # serving-side hook: called with the promoted version after an alias
+        # flip so the hosting process can hot-reload its own model
+        self.on_promote = on_promote
+
+    # -- helpers -----------------------------------------------------------
+    @property
+    def registry(self):
+        return self.client.registry
+
+    def _champion_version(self) -> int | None:
+        return self.registry.get_version_by_alias(
+            self.name, config.model_stage()
+        )
+
+    def _shadow_version(self) -> int | None:
+        return self.registry.get_version_by_alias(
+            self.name, config.shadow_stage()
+        )
+
+    def _load_champion(self):
+        from fraud_detection_tpu.models import load_any_model
+
+        uri = f"models:/{self.name}@{config.model_stage()}"
+        return load_any_model(self.registry.resolve(uri))
+
+    def _export_state(self, state: str) -> None:
+        for s in st.STATES:
+            metrics.lifecycle_state.labels(s).set(1 if s == state else 0)
+        counts = self.store.feedback_counts()
+        metrics.lifecycle_feedback_rows.labels("window").set(counts["window"])
+        metrics.lifecycle_feedback_rows.labels("reservoir").set(
+            counts["reservoir"]
+        )
+
+    def status(self) -> dict:
+        s = self.store.get_state(self.name)
+        s["feedback"] = self.store.feedback_counts()
+        s["shadow_version"] = self._shadow_version()
+        s["prod_version"] = self._champion_version()
+        return s
+
+    # -- feedback ingest (the worker-side durable path) --------------------
+    def record_feedback(self, features, scores, labels) -> int:
+        n = self.store.add_feedback(features, scores, labels)
+        counts = self.store.feedback_counts()
+        metrics.lifecycle_feedback_rows.labels("window").set(counts["window"])
+        metrics.lifecycle_feedback_rows.labels("reservoir").set(
+            counts["reservoir"]
+        )
+        return n
+
+    # -- retrain episode ---------------------------------------------------
+    def handle_retrain(self, reason: str = "") -> dict:
+        """The ``watchtower.trigger_retrain`` task body: CAS-latch, fit,
+        gate, register at ``@shadow``. Returns a summary dict (logged by the
+        worker; also the test surface)."""
+        if not self.store.transition(
+            self.name, _RESTARTABLE, st.RETRAINING, reason=reason
+        ):
+            # another worker owns the episode — the cross-process latch
+            state = self.store.get_state(self.name)["state"]
+            log.warning(
+                "retrain request dropped: episode already %s", state
+            )
+            metrics.lifecycle_retrains.labels("skipped").inc()
+            return {"outcome": "skipped", "state": state}
+        self._export_state(st.RETRAINING)
+        t0 = time.time()
+        try:
+            champion_version = self._champion_version()
+            champion = self._load_champion()
+        except (FileNotFoundError, ValueError) as e:
+            self.store.transition(
+                self.name, (st.RETRAINING,), st.ROLLED_BACK,
+                reason=f"no champion to retrain from: {e}",
+            )
+            self._export_state(st.ROLLED_BACK)
+            metrics.lifecycle_retrains.labels("failed").inc()
+            log.error("retrain aborted — no champion resolvable: %s", e)
+            return {"outcome": "failed", "error": str(e)}
+        try:
+            result = run_retrain(
+                self.store,
+                champion,
+                champion_version,
+                reason=reason,
+                tracking_client=self.client,
+                **self.retrain_kwargs,
+            )
+        except Exception as e:
+            self.store.transition(
+                self.name, (st.RETRAINING,), st.ROLLED_BACK,
+                reason=f"retrain failed: {e}",
+            )
+            self._export_state(st.ROLLED_BACK)
+            metrics.lifecycle_retrains.labels("failed").inc()
+            log.exception("conductor retrain failed")
+            return {"outcome": "failed", "error": str(e)}
+        finally:
+            metrics.lifecycle_retrain_duration.observe(time.time() - t0)
+        return self._finish_retrain(result)
+
+    def _finish_retrain(self, result: RetrainResult) -> dict:
+        if not result.gate.passed:
+            self.store.transition(
+                self.name, (st.RETRAINING,), st.ROLLED_BACK,
+                reason="gate failed: " + "; ".join(result.gate.reasons),
+                gate=result.gate.to_json(),
+                champion_version=result.champion_version,
+                challenger_version=None,  # nothing registered this episode
+            )
+            self._export_state(st.ROLLED_BACK)
+            metrics.lifecycle_retrains.labels("gate_failed").inc()
+            log.warning(
+                "challenger rejected by gate: %s", "; ".join(result.gate.reasons)
+            )
+            return {"outcome": "gate_failed", "reasons": result.gate.reasons}
+        counts = self.store.feedback_counts()
+        version = self.registry.register(
+            self.name,
+            result.artifact_dir,
+            run_id=result.run_id,
+            metrics={
+                k: float(v)
+                for k, v in result.gate.metrics.items()
+            },
+            lineage={
+                "parent_version": result.champion_version,
+                "trained_by": "conductor",
+                "feedback_window_rows": counts["window"],
+                "feedback_reservoir_rows": counts["reservoir"],
+                "gate": result.gate.to_json(),
+            },
+        )
+        # intent persisted BEFORE the alias write: a crash between the two
+        # re-sets the alias on resume instead of losing the challenger
+        self.store.transition(
+            self.name, (st.RETRAINING,), st.GATED,
+            challenger_version=version,
+            champion_version=result.champion_version,
+            gate=result.gate.to_json(),
+        )
+        self._export_state(st.GATED)
+        self.registry.set_alias(self.name, config.shadow_stage(), version)
+        self.store.transition(self.name, (st.GATED,), st.SHADOWING)
+        self._export_state(st.SHADOWING)
+        metrics.lifecycle_retrains.labels("gated").inc()
+        log.warning(
+            "challenger v%d registered at @%s (parent v%s) — shadowing",
+            version, config.shadow_stage(), result.champion_version,
+        )
+        return {
+            "outcome": "gated",
+            "version": version,
+            "gate": result.gate.to_json(),
+        }
+
+    # -- promotion / rollback ----------------------------------------------
+    def handle_promote(self, reason: str = "", force: bool = False) -> dict:
+        """Flip ``@prod`` to the shadowing challenger. Normally consumes a
+        watchtower ``promote_challenger`` recommendation (state must be
+        ``shadowing``); ``force=True`` is the operator override that
+        promotes whatever ``@shadow`` points at regardless of state
+        (docs/runbooks/ModelPromotion.md)."""
+        shadow = self._shadow_version()
+        if shadow is None:
+            log.warning("promote requested but no @shadow alias exists")
+            return {"outcome": "no_challenger"}
+        prior = self._champion_version()
+        from_states = st.STATES if force else (st.SHADOWING,)
+        if not self.store.transition(
+            self.name, from_states, st.PROMOTING,
+            challenger_version=shadow, champion_version=prior, reason=reason,
+        ):
+            state = self.store.get_state(self.name)["state"]
+            log.warning(
+                "promote dropped: state %s is not shadowing (force=False)",
+                state,
+            )
+            return {"outcome": "skipped", "state": state}
+        self._export_state(st.PROMOTING)
+        return self._complete_promotion()
+
+    def _complete_promotion(self) -> dict:
+        """The promoting → done leg. Separated so :meth:`resume` can finish
+        a half-applied promotion: both registry writes are idempotent and
+        the recorded intent (challenger_version) is the single source of
+        truth for WHAT gets promoted."""
+        state = self.store.get_state(self.name)
+        target = state.get("challenger_version")
+        prior = state.get("champion_version")
+        if target is None:
+            self.store.transition(
+                self.name, (st.PROMOTING,), st.ROLLED_BACK,
+                reason="promoting state carried no challenger version",
+            )
+            self._export_state(st.ROLLED_BACK)
+            return {"outcome": "failed", "error": "no recorded target version"}
+        self.registry.set_alias(self.name, config.model_stage(), int(target))
+        self.registry.delete_alias(self.name, config.shadow_stage())
+        self.store.transition(self.name, (st.PROMOTING,), st.DONE)
+        self._export_state(st.DONE)
+        metrics.lifecycle_promotions.inc()
+        log.warning(
+            "promoted challenger v%s to @%s (prior champion v%s retained "
+            "for rollback)",
+            target, config.model_stage(), prior,
+        )
+        if self.on_promote is not None:
+            try:
+                self.on_promote(int(target))
+            except Exception:
+                log.warning("on_promote hook failed", exc_info=True)
+        return {"outcome": "promoted", "version": int(target), "prior": prior}
+
+    def handle_rollback(self, reason: str = "") -> dict:
+        """Two rollback shapes, selected by where the episode stands:
+
+        - **challenger rollback** (state shadowing/gated — watchtower's
+          ``rollback_challenger``): drop the ``@shadow`` alias; ``@prod``
+          never moved, so nothing else changes;
+        - **promotion rollback** (state promoting/done): restore ``@prod``
+          to the recorded prior champion and drop ``@shadow``."""
+        state = self.store.get_state(self.name)
+        current = state["state"]
+        if current in (st.PROMOTING, st.DONE):
+            prior = state.get("champion_version")
+            if prior is None:
+                log.error("rollback requested but no prior champion recorded")
+                return {"outcome": "failed", "error": "no prior champion"}
+            self.registry.set_alias(self.name, config.model_stage(), int(prior))
+            self.registry.delete_alias(self.name, config.shadow_stage())
+            self.store.transition(
+                self.name, (st.PROMOTING, st.DONE), st.ROLLED_BACK,
+                reason=reason or "promotion rolled back",
+            )
+            self._export_state(st.ROLLED_BACK)
+            metrics.lifecycle_rollbacks.inc()
+            log.warning("rolled @%s back to v%s", config.model_stage(), prior)
+            return {"outcome": "rolled_back", "restored": int(prior)}
+        if not self.store.transition(
+            self.name, (st.SHADOWING, st.GATED), st.ROLLED_BACK,
+            reason=reason or "challenger rolled back",
+        ):
+            log.info("rollback dropped: no episode in progress (%s)", current)
+            return {"outcome": "skipped", "state": current}
+        self.registry.delete_alias(self.name, config.shadow_stage())
+        self._export_state(st.ROLLED_BACK)
+        metrics.lifecycle_rollbacks.inc()
+        log.warning("challenger @%s unregistered", config.shadow_stage())
+        return {"outcome": "rolled_back", "restored": None}
+
+    # -- crash recovery ----------------------------------------------------
+    def resume(self) -> dict | None:
+        """Pick up a killed worker's episode mid-step (called at worker
+        startup). No-op when the state machine is parked."""
+        state = self.store.get_state(self.name)
+        current = state["state"]
+        self._export_state(current)
+        if current == st.RETRAINING:
+            # the interrupted fit left no registry side effects — re-enter
+            # the episode from the top (CAS expects RETRAINING here)
+            log.warning("resuming interrupted retrain episode")
+            self.store.set_state(
+                self.name, st.IDLE, reason="resume after crash mid-retrain"
+            )
+            return self.handle_retrain(
+                reason=(state.get("reason") or "") + " [resumed]"
+            )
+        if current == st.GATED:
+            version = state.get("challenger_version")
+            if version is not None:
+                log.warning("resuming: re-aliasing gated challenger v%s", version)
+                self.registry.set_alias(
+                    self.name, config.shadow_stage(), int(version)
+                )
+                self.store.transition(self.name, (st.GATED,), st.SHADOWING)
+                self._export_state(st.SHADOWING)
+                return {"outcome": "resumed_shadowing", "version": version}
+            self.store.transition(
+                self.name, (st.GATED,), st.ROLLED_BACK,
+                reason="gated state carried no challenger version",
+            )
+            self._export_state(st.ROLLED_BACK)
+            return {"outcome": "failed"}
+        if current == st.PROMOTING:
+            log.warning("resuming interrupted promotion")
+            return self._complete_promotion()
+        return None

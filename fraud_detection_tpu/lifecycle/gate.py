@@ -1,0 +1,208 @@
+"""The challenger gate: batched on-device evaluation of a retrained model.
+
+A challenger earns the ``@shadow`` alias only by beating three bounds
+against the incumbent champion, evaluated on a frozen holdout plus the
+recent labeled-feedback window:
+
+- **AUC**: challenger AUC ≥ champion AUC − ε (``CONDUCTOR_GATE_AUC_MARGIN``)
+  on every slice with both classes present;
+- **ECE**: challenger expected calibration error ≤
+  ``CONDUCTOR_GATE_ECE_BOUND`` (downstream alert thresholds assume
+  calibrated scores);
+- **score PSI vs champion**: PSI(challenger scores ‖ champion scores) on
+  the holdout ≤ ``CONDUCTOR_GATE_PSI_BOUND`` — a model that scores the same
+  traffic with a different distribution would shift production behavior
+  even at equal AUC.
+
+All four statistics come out of ONE jitted program per slice
+(:func:`_gate_stats` — both models' scores go in, the AUCs/ECE/PSI come
+out), in the batched-on-device spirit of GPUTreeShap (PAPERS.md): the host
+never loops over rows, and the program is registered with graftcheck's
+virtual-mesh verifier so its shapes are proven at every mesh size.
+
+NaN discipline matches ``registry.register_if_gate``: every criterion is
+written as ``not (ok_condition)`` so a NaN statistic (diverged fit,
+poisoned eval slice) fails the gate instead of sailing through a ``<``.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.monitor.drift import psi_from_counts
+from fraud_detection_tpu.ops.metrics import _auc_weighted
+
+log = logging.getLogger("fraud_detection_tpu.lifecycle")
+
+N_GATE_SCORE_BINS = 20
+N_GATE_CALIB_BINS = 10
+
+
+@dataclass(frozen=True)
+class GateThresholds:
+    auc_margin: float
+    ece_bound: float
+    psi_bound: float
+    min_eval_rows: int
+
+    @classmethod
+    def from_config(cls) -> "GateThresholds":
+        return cls(
+            auc_margin=config.conductor_gate_auc_margin(),
+            ece_bound=config.conductor_gate_ece_bound(),
+            psi_bound=config.conductor_gate_psi_bound(),
+            min_eval_rows=config.conductor_min_eval_rows(),
+        )
+
+
+@dataclass
+class GateResult:
+    passed: bool
+    reasons: list[str] = field(default_factory=list)
+    metrics: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "passed": self.passed,
+            "reasons": list(self.reasons),
+            "metrics": {k: round(float(v), 6) for k, v in self.metrics.items()},
+        }
+
+
+@jax.jit
+def _gate_stats(
+    champ_scores: jax.Array,  # (n,)
+    chall_scores: jax.Array,  # (n,)
+    labels: jax.Array,  # (n,) 0/1
+    weights: jax.Array,  # (n,) 1.0 real rows, 0.0 padding
+    score_edges: jax.Array,  # (s_bins - 1,) interior edges on [0, 1]
+    calib_edges: jax.Array,  # (c_bins - 1,)
+):
+    """One fused gate-evaluation program per slice. Returns
+    (champ_auc, chall_auc, chall_ece, score_psi) as device scalars."""
+    champ_auc = _auc_weighted(champ_scores, labels, weights)
+    chall_auc = _auc_weighted(chall_scores, labels, weights)
+
+    # score-PSI challenger-vs-champion: histogram both on shared edges
+    def hist(s):
+        idx = jnp.sum(s[:, None] >= score_edges[None, :], axis=-1)
+        onehot = idx[:, None] == jnp.arange(score_edges.shape[0] + 1)[None, :]
+        return jnp.sum(onehot * weights[:, None], axis=0)
+
+    psi = psi_from_counts(hist(chall_scores), hist(champ_scores))
+
+    # challenger ECE over uniform confidence bins (weighted, padding inert)
+    n_calib = calib_edges.shape[0] + 1
+    cidx = jnp.sum(chall_scores[:, None] >= calib_edges[None, :], axis=-1)
+    onehot = (cidx[:, None] == jnp.arange(n_calib)[None, :]) * weights[:, None]
+    cnt = jnp.sum(onehot, axis=0)
+    conf = jnp.sum(onehot * chall_scores[:, None], axis=0) / jnp.maximum(cnt, 1e-9)
+    acc = jnp.sum(
+        onehot * (labels > 0).astype(jnp.float32)[:, None], axis=0
+    ) / jnp.maximum(cnt, 1e-9)
+    w = cnt / jnp.maximum(jnp.sum(cnt), 1e-9)
+    ece = jnp.sum(w * jnp.abs(conf - acc))
+    return champ_auc, chall_auc, ece, psi
+
+
+def _slice_stats(
+    champion, challenger, x: np.ndarray, y: np.ndarray
+) -> dict | None:
+    """Score both models on one eval slice (two batched device passes) and
+    run the fused stats program. None when the slice can't be judged
+    (empty or single-class — AUC undefined)."""
+    y = np.asarray(y).reshape(-1)
+    if x.shape[0] == 0 or (y > 0).all() or (y <= 0).all():
+        return None
+    champ = np.asarray(
+        champion.scorer.predict_proba(np.asarray(x, np.float32)), np.float32
+    ).reshape(-1)
+    chall = np.asarray(
+        challenger.scorer.predict_proba(np.asarray(x, np.float32)), np.float32
+    ).reshape(-1)
+    score_edges = jnp.asarray(
+        np.linspace(0.0, 1.0, N_GATE_SCORE_BINS + 1)[1:-1], jnp.float32
+    )
+    calib_edges = jnp.asarray(
+        np.linspace(0.0, 1.0, N_GATE_CALIB_BINS + 1)[1:-1], jnp.float32
+    )
+    champ_auc, chall_auc, ece, psi = _gate_stats(
+        jnp.asarray(champ),
+        jnp.asarray(chall),
+        jnp.asarray(y, jnp.float32),
+        jnp.ones((y.shape[0],), jnp.float32),
+        score_edges,
+        calib_edges,
+    )
+    return {
+        "champion_auc": float(champ_auc),
+        "challenger_auc": float(chall_auc),
+        "challenger_ece": float(ece),
+        "score_psi_vs_champion": float(psi),
+        "rows": int(x.shape[0]),
+    }
+
+
+def evaluate_gate(
+    champion,
+    challenger,
+    x_holdout: np.ndarray,
+    y_holdout: np.ndarray,
+    x_recent: np.ndarray | None = None,
+    y_recent: np.ndarray | None = None,
+    thresholds: GateThresholds | None = None,
+) -> GateResult:
+    """Run the full gate: frozen holdout (required) + recent labeled window
+    (judged only when it clears ``min_eval_rows`` and holds both classes)."""
+    thr = thresholds or GateThresholds.from_config()
+    reasons: list[str] = []
+    metrics: dict = {}
+
+    hold = _slice_stats(champion, challenger, x_holdout, y_holdout)
+    if hold is None:
+        return GateResult(
+            False, ["holdout slice unusable (empty or single-class)"], {}
+        )
+    metrics.update({f"holdout_{k}": v for k, v in hold.items()})
+    if not (hold["challenger_auc"] >= hold["champion_auc"] - thr.auc_margin):
+        reasons.append(
+            f"holdout AUC {hold['challenger_auc']:.4f} < champion "
+            f"{hold['champion_auc']:.4f} - {thr.auc_margin}"
+        )
+    if not (hold["challenger_ece"] <= thr.ece_bound):
+        reasons.append(
+            f"holdout ECE {hold['challenger_ece']:.4f} > {thr.ece_bound}"
+        )
+    if not (hold["score_psi_vs_champion"] <= thr.psi_bound):
+        reasons.append(
+            f"holdout score PSI vs champion "
+            f"{hold['score_psi_vs_champion']:.4f} > {thr.psi_bound}"
+        )
+
+    if x_recent is not None and x_recent.shape[0] >= thr.min_eval_rows:
+        recent = _slice_stats(champion, challenger, x_recent, y_recent)
+        if recent is not None:
+            metrics.update({f"recent_{k}": v for k, v in recent.items()})
+            if not (
+                recent["challenger_auc"]
+                >= recent["champion_auc"] - thr.auc_margin
+            ):
+                reasons.append(
+                    f"recent-window AUC {recent['challenger_auc']:.4f} < "
+                    f"champion {recent['champion_auc']:.4f} - {thr.auc_margin}"
+                )
+            if not (recent["challenger_ece"] <= thr.ece_bound):
+                reasons.append(
+                    f"recent-window ECE {recent['challenger_ece']:.4f} > "
+                    f"{thr.ece_bound}"
+                )
+        else:
+            log.info("recent labeled window single-class — slice skipped")
+
+    return GateResult(not reasons, reasons, metrics)

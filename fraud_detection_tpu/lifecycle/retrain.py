@@ -1,0 +1,216 @@
+"""The retrain executor: what actually runs when watchtower says "retrain".
+
+Assembles a training set from the base data plus durable feedback replay
+(recent window + uniform-over-history reservoir —
+:mod:`fraud_detection_tpu.lifecycle.store`), warm-starts the solver from
+the incumbent champion's params, runs the SAME sharded data-parallel
+L-BFGS fit the offline trainer uses (the DP mesh "Automatic Cross-Replica
+Sharding" motivates, PAPERS.md), and evaluates the result against the
+champion on a frozen holdout plus the recent-labeled-window slice through
+the jitted challenger gate (:mod:`fraud_detection_tpu.lifecycle.gate`).
+
+The warm start crosses scaler spaces correctly: the champion's params are
+folded to raw-input space (the identity the serving scorer already relies
+on), then re-expressed in the NEW scaler's space — so a champion fitted
+under last month's feature statistics still seeds this month's fit at its
+true decision boundary, not at a mis-scaled copy of it.
+
+Methodological hygiene inherited from train.py: the holdout is carved with
+the same stratified split and seed as offline training (so the gate's
+"frozen holdout" is the artifact every champion was judged on), the scaler
+is fitted on the train side only, and SMOTE never sees eval rows.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.ckpt.checkpoint import save_artifacts
+from fraud_detection_tpu.data.loader import load_creditcard_csv, stratified_split
+from fraud_detection_tpu.lifecycle.gate import (
+    GateResult,
+    GateThresholds,
+    evaluate_gate,
+)
+from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.monitor.baseline import build_baseline_profile, save_profile
+from fraud_detection_tpu.ops.logistic import LogisticParams, logistic_fit_lbfgs
+from fraud_detection_tpu.ops.scaler import scaler_fit, scaler_transform
+from fraud_detection_tpu.ops.scorer import fold_scaler_into_linear
+from fraud_detection_tpu.ops.smote import smote
+
+log = logging.getLogger("fraud_detection_tpu.lifecycle")
+
+HOLDOUT_SEED = 42  # train.py's default split seed — the frozen holdout
+HOLDOUT_FRACTION = 0.2
+
+
+@dataclass
+class RetrainResult:
+    gate: GateResult
+    challenger: FraudLogisticModel | None
+    artifact_dir: str | None
+    run_id: str | None
+    champion_version: int | None
+    metrics: dict = field(default_factory=dict)
+
+
+def warm_start_from(champion, new_scaler) -> LogisticParams | None:
+    """Champion params re-expressed in the new scaler's space (None when the
+    champion family carries no linear params — e.g. GBT — and the fit must
+    start cold)."""
+    params = getattr(champion, "params", None)
+    if params is None or not isinstance(params, LogisticParams):
+        return None
+    folded = fold_scaler_into_linear(params, getattr(champion, "scaler", None))
+    w_raw = np.asarray(folded.coef, np.float32)
+    b_raw = np.float32(folded.intercept)
+    if new_scaler is None:
+        return LogisticParams(coef=w_raw, intercept=b_raw)
+    scale = np.asarray(new_scaler.scale, np.float32)
+    mean = np.asarray(new_scaler.mean, np.float32)
+    return LogisticParams(
+        coef=w_raw * scale, intercept=b_raw + np.dot(mean, w_raw)
+    )
+
+
+def run_retrain(
+    store,
+    champion,
+    champion_version: int | None,
+    reason: str = "",
+    data_csv: str | None = None,
+    use_smote: bool = True,
+    max_iter: int = 200,
+    seed: int = HOLDOUT_SEED,
+    thresholds: GateThresholds | None = None,
+    tracking_client=None,
+) -> RetrainResult:
+    """One full retrain → gate pass. Pure with respect to the registry: the
+    conductor decides what to do with a passing challenger (register,
+    alias, state transitions); this function only trains and judges."""
+    from fraud_detection_tpu.tracking import TrackingClient
+
+    t0 = time.time()
+    client = tracking_client or TrackingClient()
+    thresholds = thresholds or GateThresholds.from_config()
+
+    # ---- base data + frozen holdout (the split every champion was judged on)
+    x, y, feature_names = load_creditcard_csv(data_csv or config.data_csv())
+    train_idx, test_idx = stratified_split(y, HOLDOUT_FRACTION, seed)
+    x_train, y_train = x[train_idx], y[train_idx]
+    x_hold, y_hold = x[test_idx], y[test_idx]
+
+    # ---- feedback replay: recent window + history reservoir (raw features).
+    # The window is split disjointly: even rows replay into TRAINING, odd
+    # rows become the gate's recent-eval slice — evaluating the challenger
+    # on rows it trained on would inflate its recent AUC vs a champion that
+    # never saw them (train-set evaluation) and let a worse model pass.
+    # Interleaved (not chronological) so both halves span the same period.
+    fx_w, _, fy_w = store.window_rows()
+    fx_train, fy_train = fx_w[0::2], fy_w[0::2]
+    fx_eval, fy_eval = fx_w[1::2], fy_w[1::2]
+    fx_r, _, fy_r = store.reservoir_rows()
+    replay_x = [a for a in (fx_train, fx_r) if a.size]
+    replay_y = [a for a in (fy_train, fy_r) if a.size]
+    n_replay = int(sum(a.shape[0] for a in replay_x))
+    if replay_x:
+        if any(a.shape[1] != x_train.shape[1] for a in replay_x):
+            raise ValueError(
+                "feedback feature arity does not match the base dataset"
+            )
+        x_fit = np.concatenate([x_train, *replay_x]).astype(np.float32)
+        y_fit = np.concatenate(
+            [y_train, *(a.astype(y_train.dtype) for a in replay_y)]
+        )
+    else:
+        x_fit, y_fit = x_train, y_train
+
+    with client.start_run() as run:
+        run.log_params(
+            {
+                "trigger": "conductor_retrain",
+                "reason": reason[:500],
+                "n_base_rows": int(len(y_train)),
+                "n_feedback_rows": n_replay,
+                "warm_start": champion_version is not None,
+                "parent_version": champion_version,
+                "use_smote": use_smote,
+                "max_iter": max_iter,
+                "device": jax.devices()[0].platform,
+                "n_devices": jax.device_count(),
+            }
+        )
+
+        # ---- scaler on the train side only, then the sharded DP fit
+        scaler = scaler_fit(x_fit)
+        xs_fit = scaler_transform(scaler, x_fit)
+        ws = warm_start_from(champion, scaler)
+        x_final, y_final = xs_fit, y_fit
+        if use_smote:
+            try:
+                x_final, y_final = smote(
+                    xs_fit, y_fit, jax.random.key(seed + 1000)
+                )
+            except ValueError as e:
+                # degenerate minority (too few positives for k-NN): fit on
+                # the raw mix rather than failing the whole loop closure
+                log.warning("retrain SMOTE skipped: %s", e)
+                run.set_tag("smote_skipped", str(e))
+        params = logistic_fit_lbfgs(
+            x_final, y_final, max_iter=max_iter, sharded=True, warm_start=ws
+        )
+        challenger = FraudLogisticModel(params, scaler, list(feature_names))
+
+        # ---- the challenger gate: frozen holdout + recent labeled window
+        gate = evaluate_gate(
+            champion,
+            challenger,
+            x_hold,
+            y_hold,
+            x_recent=fx_eval if fx_eval.size else None,
+            y_recent=fy_eval if fy_eval.size else None,
+            thresholds=thresholds,
+        )
+        for k, v in gate.metrics.items():
+            run.log_metric(k, float(v))
+        run.set_tag("gate_passed", gate.passed)
+        if gate.reasons:
+            run.set_tag("gate_reasons", "; ".join(gate.reasons)[:900])
+
+        # ---- artifacts: model + drift baseline beside it (every resolution
+        # path carries its own monitor profile, train.py contract)
+        artifact_dir = run.artifact_path("model")
+        save_artifacts(artifact_dir, params, scaler, list(feature_names))
+        hold_scores = np.asarray(
+            challenger.scorer.predict_proba(np.asarray(x_hold, np.float32))
+        )
+        profile = build_baseline_profile(
+            x_fit, hold_scores, feature_names=list(feature_names)
+        )
+        save_profile(artifact_dir, profile)
+
+        wall = time.time() - t0
+        run.log_metric("retrain_seconds", wall)
+        metrics = dict(gate.metrics)
+        metrics.update(
+            {
+                "retrain_seconds": wall,
+                "n_feedback_rows": n_replay,
+                "n_fit_rows": int(x_final.shape[0]),
+            }
+        )
+        return RetrainResult(
+            gate=gate,
+            challenger=challenger,
+            artifact_dir=artifact_dir,
+            run_id=run.run_id,
+            champion_version=champion_version,
+            metrics=metrics,
+        )

@@ -1,0 +1,377 @@
+"""Durable lifecycle state: labeled feedback + the conductor state machine.
+
+Two tables beside the task queue (``LIFECYCLE_DB_URL``, defaulting to the
+broker database when that is a SQL backend — sqlite WAL or PostgreSQL over
+the built-in wire client, same dual-dialect pattern as taskq.py/pgclient.py):
+
+- ``feedback_rows`` — append-only labeled feedback, partitioned into two
+  pools:
+
+  * **window**: the most recent ``CONDUCTOR_FEEDBACK_WINDOW`` rows (oldest
+    pruned) — the "what does settled traffic look like *now*" slice the
+    challenger gate evaluates on;
+  * **reservoir**: a uniform-over-history sample of fixed size (classic
+    reservoir sampling, slot-addressed replacement, ``seen`` persisted so
+    the uniformity survives restarts) — the replay mix that keeps old
+    regimes represented in retraining after the window has forgotten them.
+
+  A row lands in the window always and in the reservoir with probability
+  ``R/seen`` — both pools are maintained in one pass per batch.
+
+- ``lifecycle_state`` — one row per model name holding the conductor's
+  state machine (``idle → retraining → gated → shadowing → promoting →
+  done/rolled_back``) plus the challenger/champion versions and gate
+  evidence. Transitions go through :meth:`LifecycleStore.transition` — a
+  guarded compare-and-set — so a crashed worker resumes mid-step without
+  double-promoting and two workers can't run the same step twice.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Any, Iterable
+
+import numpy as np
+
+from fraud_detection_tpu import config
+
+log = logging.getLogger("fraud_detection_tpu.lifecycle")
+
+WINDOW = "window"
+RESERVOIR = "reservoir"
+
+# State machine vocabulary (ISSUE-pinned): terminal states re-arm to a new
+# episode via begin-retrain.
+IDLE = "idle"
+RETRAINING = "retraining"
+GATED = "gated"
+SHADOWING = "shadowing"
+PROMOTING = "promoting"
+DONE = "done"
+ROLLED_BACK = "rolled_back"
+STATES = (IDLE, RETRAINING, GATED, SHADOWING, PROMOTING, DONE, ROLLED_BACK)
+
+_SCHEMA = [
+    """
+    CREATE TABLE IF NOT EXISTS feedback_rows (
+        id TEXT PRIMARY KEY,
+        seq INTEGER NOT NULL,
+        pool TEXT NOT NULL,
+        slot INTEGER,
+        features TEXT NOT NULL,
+        score REAL NOT NULL,
+        label INTEGER NOT NULL,
+        created_at REAL NOT NULL
+    )
+    """,
+    "CREATE INDEX IF NOT EXISTS idx_feedback_pool_seq ON feedback_rows(pool, seq)",
+    "CREATE INDEX IF NOT EXISTS idx_feedback_pool_slot ON feedback_rows(pool, slot)",
+    """
+    CREATE TABLE IF NOT EXISTS feedback_meta (
+        key TEXT PRIMARY KEY,
+        value TEXT NOT NULL
+    )
+    """,
+    """
+    CREATE TABLE IF NOT EXISTS lifecycle_state (
+        name TEXT PRIMARY KEY,
+        state TEXT NOT NULL,
+        challenger_version INTEGER,
+        champion_version INTEGER,
+        reason TEXT,
+        gate TEXT,
+        updated_at REAL NOT NULL
+    )
+    """,
+]
+
+
+def _sqlite_path(url: str) -> str:
+    return url[len("sqlite:///") :] if url.startswith("sqlite:///") else url
+
+
+class LifecycleStore:
+    """SQLite implementation; :class:`PgLifecycleStore` swaps the connection
+    for the pgwire adapter and inherits every query (written in the
+    PG/SQLite common dialect — no AUTOINCREMENT, no INSERT OR REPLACE)."""
+
+    def __init__(
+        self,
+        url: str | None = None,
+        window_size: int | None = None,
+        reservoir_size: int | None = None,
+        seed: int = 0,
+    ):
+        self.url = url or config.lifecycle_db_url()
+        self.window_size = int(
+            window_size
+            if window_size is not None
+            else config.conductor_feedback_window()
+        )
+        self.reservoir_size = int(
+            reservoir_size
+            if reservoir_size is not None
+            else config.conductor_reservoir_size()
+        )
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        self._connect()
+        with self._lock, self._conn:
+            for stmt in _SCHEMA:
+                self._conn.executescript(stmt)
+
+    def _connect(self) -> None:
+        import os
+
+        path = _sqlite_path(self.url)
+        if path != ":memory:" and os.path.dirname(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False, timeout=30.0)
+        self._conn.row_factory = sqlite3.Row
+        self._conn.execute("PRAGMA journal_mode=WAL")
+
+    # -- feedback ----------------------------------------------------------
+    def _meta_get(self, key: str, default: int = 0) -> int:
+        row = self._conn.execute(
+            "SELECT value FROM feedback_meta WHERE key = ?", (key,)
+        ).fetchone()
+        return int(row["value"]) if row else default
+
+    def _meta_set(self, key: str, value: int) -> None:
+        cur = self._conn.execute(
+            "UPDATE feedback_meta SET value = ? WHERE key = ?",
+            (str(int(value)), key),
+        )
+        if cur.rowcount == 0:
+            self._conn.execute(
+                "INSERT INTO feedback_meta (key, value) VALUES (?, ?)",
+                (key, str(int(value))),
+            )
+
+    def add_feedback(
+        self, features: Iterable, scores: Iterable, labels: Iterable
+    ) -> int:
+        """Append one labeled batch; returns rows ingested. One transaction
+        per batch: a crash mid-batch loses the batch, never corrupts the
+        reservoir's uniformity invariants (``seen`` commits with the rows)."""
+        feats = np.asarray(features, np.float32)
+        if feats.ndim == 1:
+            feats = feats[None, :]
+        scores = np.asarray(scores, np.float64).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        n = feats.shape[0]
+        if not (scores.shape[0] == n and labels.shape[0] == n):
+            raise ValueError("features/scores/labels must have equal length")
+        now = time.time()
+        with self._lock, self._conn:
+            seq = self._meta_get("seq")
+            seen = self._meta_get("reservoir_seen")
+            res_count = self._count(RESERVOIR)
+            for i in range(n):
+                seq += 1
+                payload = json.dumps([float(v) for v in feats[i]])
+                self._conn.execute(
+                    "INSERT INTO feedback_rows (id, seq, pool, slot, features,"
+                    " score, label, created_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+                    (
+                        uuid.uuid4().hex, seq, WINDOW, None, payload,
+                        float(scores[i]), int(labels[i]), now,
+                    ),
+                )
+                # reservoir sampling (Vitter's R): row i of history occupies
+                # each slot with probability R/seen at every point in time
+                seen += 1
+                if res_count < self.reservoir_size:
+                    slot = res_count
+                    res_count += 1
+                else:
+                    j = int(self._rng.integers(seen))
+                    slot = j if j < self.reservoir_size else None
+                if slot is not None:
+                    self._conn.execute(
+                        "DELETE FROM feedback_rows WHERE pool = ? AND slot = ?",
+                        (RESERVOIR, slot),
+                    )
+                    self._conn.execute(
+                        "INSERT INTO feedback_rows (id, seq, pool, slot,"
+                        " features, score, label, created_at)"
+                        " VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+                        (
+                            uuid.uuid4().hex, seq, RESERVOIR, slot, payload,
+                            float(scores[i]), int(labels[i]), now,
+                        ),
+                    )
+            self._meta_set("seq", seq)
+            self._meta_set("reservoir_seen", seen)
+            # prune the window to its bound (oldest first)
+            excess = self._count(WINDOW) - self.window_size
+            if excess > 0:
+                self._conn.execute(
+                    "DELETE FROM feedback_rows WHERE pool = ? AND seq <= ("
+                    "SELECT seq FROM feedback_rows WHERE pool = ? "
+                    "ORDER BY seq LIMIT 1 OFFSET ?)",
+                    (WINDOW, WINDOW, excess - 1),
+                )
+        return n
+
+    def _count(self, pool: str) -> int:
+        row = self._conn.execute(
+            "SELECT COUNT(*) AS n FROM feedback_rows WHERE pool = ?", (pool,)
+        ).fetchone()
+        return int(row["n"])
+
+    def _rows(self, pool: str, limit: int | None = None):
+        sql = (
+            "SELECT features, score, label FROM feedback_rows "
+            "WHERE pool = ? ORDER BY seq DESC"
+        )
+        params: list[Any] = [pool]
+        if limit is not None:
+            sql += " LIMIT ?"
+            params.append(int(limit))
+        return self._conn.execute(sql, params).fetchall()
+
+    @staticmethod
+    def _unpack(rows) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if not rows:
+            return (
+                np.zeros((0, 0), np.float32),
+                np.zeros((0,), np.float32),
+                np.zeros((0,), np.int32),
+            )
+        x = np.asarray([json.loads(r["features"]) for r in rows], np.float32)
+        s = np.asarray([r["score"] for r in rows], np.float32)
+        y = np.asarray([r["label"] for r in rows], np.int32)
+        return x, s, y
+
+    def window_rows(
+        self, limit: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Most-recent-first labeled window → (features, scores, labels)."""
+        with self._lock:
+            return self._unpack(self._rows(WINDOW, limit))
+
+    def reservoir_rows(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """The uniform-over-history replay sample."""
+        with self._lock:
+            return self._unpack(self._rows(RESERVOIR))
+
+    def feedback_counts(self) -> dict:
+        with self._lock:
+            return {
+                "window": self._count(WINDOW),
+                "reservoir": self._count(RESERVOIR),
+                "seen": self._meta_get("reservoir_seen"),
+            }
+
+    # -- conductor state machine -------------------------------------------
+    def get_state(self, name: str) -> dict:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT * FROM lifecycle_state WHERE name = ?", (name,)
+            ).fetchone()
+        if row is None:
+            return {
+                "name": name, "state": IDLE, "challenger_version": None,
+                "champion_version": None, "reason": None, "gate": None,
+                "updated_at": None,
+            }
+        d = dict(row)
+        d["gate"] = json.loads(d["gate"]) if d.get("gate") else None
+        return d
+
+    def _write_state(self, name: str, state: str, fields: dict) -> None:
+        gate = fields.get("gate")
+        vals = (
+            state,
+            fields.get("challenger_version"),
+            fields.get("champion_version"),
+            fields.get("reason"),
+            json.dumps(gate) if gate is not None else None,
+            time.time(),
+        )
+        cur = self._conn.execute(
+            "UPDATE lifecycle_state SET state = ?, challenger_version = ?, "
+            "champion_version = ?, reason = ?, gate = ?, updated_at = ? "
+            "WHERE name = ?",
+            vals + (name,),
+        )
+        if cur.rowcount == 0:
+            self._conn.execute(
+                "INSERT INTO lifecycle_state (state, challenger_version, "
+                "champion_version, reason, gate, updated_at, name) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?)",
+                vals + (name,),
+            )
+
+    def set_state(self, name: str, state: str, **fields) -> None:
+        """Unconditional write (operator override path; the conductor itself
+        uses :meth:`transition`)."""
+        if state not in STATES:
+            raise ValueError(f"unknown lifecycle state {state!r}")
+        with self._lock, self._conn:
+            self._write_state(name, state, fields)
+
+    def transition(
+        self, name: str, from_states: Iterable[str], to_state: str, **fields
+    ) -> bool:
+        """Compare-and-set: move to ``to_state`` only if the current state is
+        in ``from_states``; preserves unspecified fields. Returns False on a
+        lost race / wrong precondition — the caller's idempotency signal."""
+        if to_state not in STATES:
+            raise ValueError(f"unknown lifecycle state {to_state!r}")
+        with self._lock, self._conn:
+            row = self._conn.execute(
+                "SELECT * FROM lifecycle_state WHERE name = ?", (name,)
+            ).fetchone()
+            current = row["state"] if row is not None else IDLE
+            if current not in tuple(from_states):
+                return False
+            merged = dict(row) if row is not None else {}
+            merged.pop("gate", None)
+            if row is not None and row["gate"]:
+                merged["gate"] = json.loads(row["gate"])
+            merged.update(fields)
+            self._write_state(name, to_state, merged)
+            return True
+
+    # -- plumbing ----------------------------------------------------------
+    def ping(self) -> bool:
+        try:
+            with self._lock:
+                self._conn.execute("SELECT 1").fetchone()
+            return True
+        except Exception:
+            log.debug("lifecycle store ping failed", exc_info=True)
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+class PgLifecycleStore(LifecycleStore):
+    """Same queries over genuine PostgreSQL via the pgwire adapter."""
+
+    def _connect(self) -> None:
+        from fraud_detection_tpu.service.pgclient import _PgAdapter
+
+        self._conn = _PgAdapter(self.url)
+
+
+def open_lifecycle_store(url: str | None = None, **kw) -> LifecycleStore:
+    """Scheme dispatch mirroring ``taskq.Broker``: sqlite or postgresql."""
+    url = url or config.lifecycle_db_url()
+    if url.startswith("sqlite"):
+        return LifecycleStore(url, **kw)
+    if url.startswith(("postgresql://", "postgres://")):
+        return PgLifecycleStore(url, **kw)
+    raise NotImplementedError(
+        f"lifecycle store backend for {url.split(':', 1)[0]} not available; "
+        "use sqlite:/// or postgresql:// (set LIFECYCLE_DB_URL)"
+    )

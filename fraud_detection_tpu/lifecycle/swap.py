@@ -1,0 +1,225 @@
+"""Hot model swap: promotion reaches the serving path with zero restarts.
+
+:class:`ModelSlot` holds the served model behind ONE reference. The
+micro-batcher reads the slot once per flush and the XAI/shadow paths once
+per batch, so a swap lands *between* device dispatches: in-flight batches
+finish on the old params, the next batch scores with the new — no dropped
+requests, no lock on the hot path (a Python attribute store is atomic
+under the GIL, and the tuple swap means readers can never observe a
+half-updated model/version pair).
+
+:class:`ModelReloader` watches the registry aliases (poll and/or
+``POST /admin/reload``) and drives the slot: when ``@prod`` moves it loads
+the new champion, **warms the scorer's bucket ladder off-path** (a cold
+XLA compile must stall the reloader thread, never a request), swaps, and
+rebinds the watchtower's baseline profile; when ``@shadow`` moves it
+rebinds the challenger. ``lifecycle_model_swaps_total`` counts swaps and
+``lifecycle_active_model_version`` exports what's serving — the
+promotion-went-live signal the runbook watches.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.service import metrics
+
+log = logging.getLogger("fraud_detection_tpu.lifecycle")
+
+
+class ModelSlot:
+    """The single swappable reference to (model, source, version)."""
+
+    def __init__(self, model, source: str, version: int | None = None):
+        self._ref = (model, source, version)
+
+    def get(self) -> tuple:
+        return self._ref  # one attribute read — atomic snapshot
+
+    @property
+    def model(self):
+        return self._ref[0]
+
+    @property
+    def source(self) -> str:
+        return self._ref[1]
+
+    @property
+    def version(self) -> int | None:
+        return self._ref[2]
+
+    def swap(self, model, source: str, version: int | None = None) -> None:
+        self._ref = (model, source, version)
+        metrics.lifecycle_model_swaps.inc()
+        metrics.lifecycle_active_model_version.set(version or 0)
+        log.warning(
+            "model slot swapped → %s (v%s)", source, version
+        )
+
+
+def warm_scorer(scorer, max_batch: int | None = None) -> None:
+    """Pre-compile the bucket ladder for a freshly loaded model so the swap
+    pause is a pointer write, not an XLA compile (same ladder the
+    micro-batcher warms at startup)."""
+    from fraud_detection_tpu.ops.scorer import _bucket
+
+    max_batch = max_batch or config.scorer_max_batch()
+    d = scorer.n_features
+    b = scorer.min_bucket
+    top = _bucket(max_batch, b)
+    while b <= top:
+        scorer.predict_proba(np.zeros((b, d), np.float32))
+        b *= 2
+
+
+class ModelReloader:
+    """Alias watcher + swap driver for one serving process."""
+
+    def __init__(
+        self,
+        slot: ModelSlot,
+        watchtower=None,
+        interval: float | None = None,
+        max_batch: int | None = None,
+    ):
+        self.slot = slot
+        self.watchtower = watchtower
+        self.interval = (
+            interval
+            if interval is not None
+            else config.lifecycle_reload_interval()
+        )
+        self.max_batch = max_batch
+        self._shadow_version: int | None = self._current_shadow_version()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # check_once can be driven concurrently by the poll thread and
+        # /admin/reload — serialize so two loads can't interleave swaps
+        self._lock = threading.Lock()
+        metrics.lifecycle_active_model_version.set(slot.version or 0)
+
+    # -- registry probes ---------------------------------------------------
+    def _registry(self):
+        from fraud_detection_tpu.tracking import TrackingClient
+
+        return TrackingClient().registry
+
+    def _current_shadow_version(self) -> int | None:
+        try:
+            return self._registry().get_version_by_alias(
+                config.model_name(), config.shadow_stage()
+            )
+        except Exception:
+            log.debug("shadow alias probe failed", exc_info=True)
+            return None
+
+    # -- the reload step ---------------------------------------------------
+    def check_once(self) -> dict:
+        """One alias sweep; returns what changed (the /admin/reload body)."""
+        with self._lock:
+            out = {"champion": "unchanged", "shadow": "unchanged"}
+            try:
+                out["champion"] = self._check_champion()
+            except Exception as e:
+                out["champion"] = f"error: {e}"
+                log.warning("champion reload check failed: %s", e)
+            try:
+                out["shadow"] = self._check_shadow()
+            except Exception as e:
+                out["shadow"] = f"error: {e}"
+                log.warning("shadow reload check failed: %s", e)
+            return out
+
+    def _check_champion(self) -> str:
+        from fraud_detection_tpu.models import load_any_model
+
+        registry = self._registry()
+        name, stage = config.model_name(), config.model_stage()
+        version = registry.get_version_by_alias(name, stage)
+        if version is None or version == self.slot.version:
+            return "unchanged"
+        art = registry.artifact_dir(name, version)
+        model = load_any_model(art)
+        old = self.slot.model
+        if old is not None and list(model.feature_names) != list(
+            old.feature_names
+        ):
+            raise ValueError(
+                f"v{version} feature schema differs from the served model — "
+                "refusing to hot-swap (deploy instead)"
+            )
+        warm_scorer(model.scorer, self.max_batch)  # compile BEFORE the swap
+        source = f"registry:models:/{name}@{stage}"
+        self.slot.swap(model, source, version)
+        if self.watchtower is not None:
+            from fraud_detection_tpu.monitor.baseline import load_profile
+
+            self.watchtower.rebind_champion(load_profile(art))
+            # rebind_champion drops the shadow scorer (the old challenger is
+            # usually the new champion); force the shadow sweep that runs
+            # right after this to re-bind even if the @shadow alias version
+            # itself didn't change
+            self._shadow_version = -1
+        return f"swapped to v{version}"
+
+    def _check_shadow(self) -> str:
+        version = self._current_shadow_version()
+        if version == self._shadow_version:
+            return "unchanged"
+        prev = self._shadow_version
+        if self.watchtower is None:
+            self._shadow_version = version
+            return "unchanged"  # nothing to rebind without a watchtower
+        if version is None:
+            self.watchtower.rebind_challenger(None, None)
+            self._shadow_version = None
+            return f"challenger v{prev} unloaded"
+        from fraud_detection_tpu.models import load_any_model
+
+        # record the version only AFTER a successful bind: a transient
+        # registry/download failure must retry on the next poll, not park
+        # the challenger unbound until the alias moves again
+        name = config.model_name()
+        art = self._registry().artifact_dir(name, version)
+        challenger = load_any_model(art)
+        served = self.slot.model
+        if served is not None and list(challenger.feature_names) != list(
+            served.feature_names
+        ):
+            log.warning(
+                "shadow v%s feature schema mismatch — not binding", version
+            )
+            self._shadow_version = version  # terminal for this version
+            return "schema mismatch"
+        warm_scorer(challenger.scorer, self.max_batch)
+        self.watchtower.rebind_challenger(
+            challenger, f"registry:models:/{name}@{config.shadow_stage()}"
+        )
+        self._shadow_version = version
+        return f"challenger swapped to v{version}"
+
+    # -- polling -----------------------------------------------------------
+    def start(self) -> None:
+        if self.interval <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._poll_loop, name="lifecycle-reloader", daemon=True
+        )
+        self._thread.start()
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.check_once()
+            except Exception:
+                log.warning("reloader poll failed", exc_info=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

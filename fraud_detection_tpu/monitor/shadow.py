@@ -63,6 +63,17 @@ class ShadowScorer:
         self.batches_seen = 0
         self.batches_sampled = 0
 
+    def swap_scorer(self, scorer) -> None:
+        """Atomically replace the challenger params (the conductor's hot
+        swap): one reference store between batches, then a window reset —
+        disagreement/PSI accumulated against the OLD challenger would
+        misjudge the new one."""
+        self._scorer = scorer
+        self._score_counts = np.zeros_like(self._base_counts)
+        self._rows = 0.0
+        self._disagree = 0.0
+        self._delta = 0.0
+
     def maybe_observe(self, rows: np.ndarray, champion_scores: np.ndarray) -> bool:
         """Sample-and-score one batch; returns True when the challenger ran.
         Called from the watchtower ingest thread, never the request path."""

@@ -101,9 +101,12 @@ class Watchtower:
         sample_rate: float | None = None,
         halflife_rows: float | None = None,
         retrain_sender=None,
+        action_sender=None,
         max_backlog: int = 32,
     ):
         self.thresholds = thresholds or Thresholds.from_config()
+        self._sample_rate = sample_rate
+        self._halflife_rows = halflife_rows
         self.drift = DriftMonitor(profile, halflife_rows=halflife_rows)
         self.shadow = (
             ShadowScorer(
@@ -118,7 +121,12 @@ class Watchtower:
         self.challenger_source = challenger_source
         self.max_backlog = max_backlog
         self._retrain_sender = retrain_sender
+        # action_sender(task_name, reason): enqueues the conductor's
+        # promote/rollback tasks when CONDUCTOR_AUTO_PROMOTE=1 — same
+        # one-per-episode latch discipline as the retrain trigger
+        self._action_sender = action_sender
         self._retrain_latched = False
+        self._action_latched: str | None = None
         # a /metrics scrape and a /monitor/status call can evaluate status()
         # concurrently (separate to_thread workers) — the latch check/set
         # must be atomic or one episode enqueues duplicate retrain tasks
@@ -203,6 +211,7 @@ class Watchtower:
         drifting = any(flags.values())
         recommendation = _recommend(warming, flags, sh, thr)
         self._maybe_trigger_retrain(recommendation, d)
+        self._maybe_send_action(recommendation, d, sh)
 
         # A warming window's raw stats are empty-histogram smoothing noise
         # (score PSI against an empty window is ~5): exporting them would
@@ -284,6 +293,89 @@ class Watchtower:
                 self._retrain_latched = False  # retry on the next evaluation
                 log.error("retrain trigger enqueue failed: %s", e)
 
+    def _maybe_send_action(
+        self, recommendation: str, d: dict, sh: dict | None
+    ) -> None:
+        """Enqueue the conductor's promote/rollback task for this episode
+        (CONDUCTOR_AUTO_PROMOTE opt-in). Latched per recommendation value:
+        one task per episode, re-armed when the recommendation changes."""
+        if recommendation not in ("promote_challenger", "rollback_challenger"):
+            with self._retrain_lock:
+                self._action_latched = None  # episode over; re-arm
+            return
+        if self._action_sender is None or not config.conductor_auto_promote():
+            return
+        with self._retrain_lock:
+            if self._action_latched == recommendation:
+                return
+            self._action_latched = recommendation
+        from fraud_detection_tpu.lifecycle.conductor import (
+            PROMOTE_TASK,
+            ROLLBACK_TASK,
+        )
+
+        task = (
+            PROMOTE_TASK
+            if recommendation == "promote_challenger"
+            else ROLLBACK_TASK
+        )
+        reason = (
+            f"watchtower {recommendation}: score_psi={d['score_psi']:.4f} "
+            f"shadow_psi={(sh or {}).get('score_psi', float('nan')):.4f} "
+            f"disagreement={(sh or {}).get('disagreement', float('nan')):.4f}"
+        )
+        try:
+            self._action_sender(task, reason)
+            log.warning("watchtower enqueued conductor task %s", task)
+        except Exception as e:
+            with self._retrain_lock:
+                self._action_latched = None  # retry next evaluation
+            log.error("conductor action enqueue failed: %s", e)
+
+    # -- hot swap (driven by lifecycle.ModelReloader) -----------------------
+    def rebind_champion(self, profile) -> None:
+        """A promotion went live: point drift monitoring at the NEW
+        champion's baseline profile with a fresh window (the old window's
+        evidence was accumulated against the old baseline). When the new
+        artifacts carry no profile the old baseline keeps serving — stale
+        monitoring beats none."""
+        if profile is None:
+            log.warning(
+                "promoted model has no baseline profile — drift window "
+                "keeps the previous baseline"
+            )
+            return
+        self.drift = DriftMonitor(profile, halflife_rows=self._halflife_rows)
+        if self.shadow is not None:
+            # the old challenger IS usually the new champion — comparing a
+            # model to itself reads as perfect agreement and would mask a
+            # genuinely-different next challenger; the reloader rebinds or
+            # clears it right after via the @shadow alias sweep
+            self.shadow = None
+            self.challenger_source = None
+        log.warning("watchtower rebound to the promoted champion's baseline")
+
+    def rebind_challenger(self, challenger, source: str | None) -> None:
+        """@shadow alias changed: swap the challenger scorer (fresh shadow
+        window) or drop shadow scoring when the alias went away."""
+        if challenger is None:
+            self.shadow = None
+            self.challenger_source = None
+            log.info("shadow challenger unbound")
+            return
+        profile = self.drift.profile
+        if self.shadow is None:
+            self.shadow = ShadowScorer(
+                challenger.scorer,
+                profile,
+                sample_rate=self._sample_rate,
+                halflife_rows=self._halflife_rows,
+            )
+        else:
+            self.shadow.swap_scorer(challenger.scorer)
+        self.challenger_source = source
+        log.warning("shadow challenger rebound to %s", source)
+
     def close(self) -> None:
         """Stop the ingest thread; still-queued batches are discarded (the
         window is advisory state — shutdown must not wait on a challenger)."""
@@ -314,7 +406,9 @@ def resolve_profile_dir(model_source: str) -> str | None:
     return None
 
 
-def build_watchtower(model, model_source: str, retrain_sender=None):
+def build_watchtower(
+    model, model_source: str, retrain_sender=None, action_sender=None
+):
     """Serving-side factory: None when disabled (``WATCHTOWER_ENABLED=0``)
     or when the resolved model artifacts carry no baseline profile (models
     trained before the watchtower existed keep serving, unmonitored)."""
@@ -365,6 +459,7 @@ def build_watchtower(model, model_source: str, retrain_sender=None):
         challenger=challenger,
         challenger_source=challenger_source,
         retrain_sender=retrain_sender,
+        action_sender=action_sender,
     )
     log.info(
         "watchtower active: baseline over %d rows, challenger=%s",

@@ -125,12 +125,9 @@ def _run_lbfgs(loss_fn, init_params, max_iter: int, tol: float):
 
 
 @partial(jax.jit, static_argnames=("c", "max_iter", "tol"))
-def _fit_lbfgs(x, y, sample_weight, c: float, max_iter: int, tol: float):
-    d = x.shape[1]
+def _fit_lbfgs(x, y, sample_weight, init: LogisticParams, c: float,
+               max_iter: int, tol: float):
     y_pm = jnp.where(y > 0, 1.0, -1.0).astype(x.dtype)
-    init = LogisticParams(
-        coef=jnp.zeros((d,), dtype=x.dtype), intercept=jnp.zeros((), dtype=x.dtype)
-    )
     loss_fn = lambda p: _penalized_loss(p, x, y_pm, sample_weight, c)
     return _run_lbfgs(loss_fn, init, max_iter, tol)
 
@@ -145,19 +142,33 @@ def logistic_fit_lbfgs(
     class_weight: dict | str | None = None,
     mesh=None,
     sharded: bool = False,
+    warm_start: LogisticParams | None = None,
 ) -> LogisticParams:
     """Fit with sklearn-equivalent hyperparameters.
 
     ``class_weight`` accepts ``'balanced'`` or a ``{0: w0, 1: w1}`` dict
     (covers the reference's ``scale_pos_weight`` concept from
     train_model.py:52-54). With ``sharded=True`` rows are padded and sharded
-    over the mesh's data axis (padded rows get weight 0).
+    over the mesh's data axis (padded rows get weight 0). ``warm_start``
+    seeds the solver with existing params (the conductor's retrain
+    executor starts from the incumbent champion) — same optimum, far fewer
+    linesearch passes when the data shifted only at the margin.
     """
     # Only y comes to host (tiny — needed for class counts); X stays on
     # device when it already lives there (e.g. straight out of smote()).
     y_np = np.asarray(y)
     sw = _resolve_sample_weight(y_np, sample_weight, class_weight)
     x_in = as_device_f32(x)
+    if warm_start is None:
+        init = LogisticParams(
+            coef=jnp.zeros((x_in.shape[1],), jnp.float32),
+            intercept=jnp.zeros((), jnp.float32),
+        )
+    else:
+        init = LogisticParams(
+            coef=jnp.asarray(warm_start.coef, jnp.float32),
+            intercept=jnp.asarray(warm_start.intercept, jnp.float32),
+        )
 
     if sharded:
         x_dev, _ = shard_batch(x_in, mesh)
@@ -168,7 +179,9 @@ def logistic_fit_lbfgs(
     # Synchronous like the SGD path (sklearn contract + XLA-teardown
     # safety); sync_fetch's docstring has the tunneled-PJRT rationale.
     return sync_fetch(
-        _fit_lbfgs(x_dev, y_dev, sw_dev, float(c), int(max_iter), float(tol))
+        _fit_lbfgs(
+            x_dev, y_dev, sw_dev, init, float(c), int(max_iter), float(tol)
+        )
     )
 
 

@@ -112,9 +112,18 @@ def create_app(
         "db": None,
         "broker": None,
         "watchtower": None,
+        "slot": None,
+        "reloader": None,
+        "lifecycle_store": None,
         "started_at": None,
     }
     app.state = state  # exposed for tests/embedding
+
+    def _model():
+        # The slot is the single swappable reference (lifecycle/swap.py);
+        # state["model"] only seeds it at startup.
+        slot = state["slot"]
+        return slot.model if slot is not None else state["model"]
 
     # -- middleware: correlation ID + HTTP metrics -------------------------
     async def correlation_and_metrics(req: Request, nxt):
@@ -140,6 +149,18 @@ def create_app(
         state["db"] = ResultsDB(database_url)
         state["broker"] = Broker(broker_url)
         try:
+            # Durable labeled feedback (conductor's training replay). Must
+            # never take serving down: on failure /monitor/feedback still
+            # feeds the in-memory calibration window, just not the store.
+            from fraud_detection_tpu.lifecycle import open_lifecycle_store
+
+            state["lifecycle_store"] = open_lifecycle_store(
+                config.lifecycle_db_url(broker_url)
+            )
+        except Exception as e:
+            state["lifecycle_store"] = None
+            log.warning("lifecycle store unavailable (%s)", e)
+        try:
             model, source = load_production_model()
             state["model"], state["model_source"] = model, source
             try:
@@ -151,21 +172,44 @@ def create_app(
                 def _retrain_sender(reason: str) -> None:
                     state["broker"].send_task(RETRAIN_TASK, [reason])
 
+                def _action_sender(task: str, reason: str) -> None:
+                    state["broker"].send_task(task, [reason])
+
                 state["watchtower"] = build_watchtower(
-                    model, source, retrain_sender=_retrain_sender
+                    model, source,
+                    retrain_sender=_retrain_sender,
+                    action_sender=_action_sender,
                 )
             except Exception as e:
                 state["watchtower"] = None
                 log.warning("watchtower startup failed (%s); unmonitored", e)
+            from fraud_detection_tpu.lifecycle import ModelReloader, ModelSlot
+            from fraud_detection_tpu.service.loading import (
+                resolve_source_version,
+            )
+
+            state["slot"] = ModelSlot(
+                model, source, resolve_source_version(source)
+            )
+            metrics.lifecycle_active_model_version.set(
+                state["slot"].version or 0
+            )
             batcher = MicroBatcher(
-                model.scorer, watchtower=state["watchtower"]
+                slot=state["slot"], watchtower=state["watchtower"]
             )
             await batcher.start()  # warms the bucket ladder; can raise
             state["batcher"] = batcher
+            # Alias watcher: promotion flips reach this process without a
+            # restart (poll + POST /admin/reload).
+            reloader = ModelReloader(
+                state["slot"], watchtower=state["watchtower"]
+            )
+            reloader.start()
+            state["reloader"] = reloader
             metrics.model_loaded.set(1)
         except RuntimeError as e:
             metrics.model_loaded.set(0)
-            state["model"] = state["batcher"] = None  # all-or-nothing
+            state["model"] = state["batcher"] = state["slot"] = None
             if state["watchtower"]:  # built before the warmup failed — a
                 # degraded API must not keep an ingest thread (and shadow
                 # challenger) alive or report monitoring as enabled
@@ -174,10 +218,14 @@ def create_app(
             log.error("model load/warmup failed at startup: %s", e)
 
     async def shutdown():
+        if state["reloader"]:
+            state["reloader"].stop()
         if state["batcher"]:
             await state["batcher"].stop()
         if state["watchtower"]:
             state["watchtower"].close()
+        if state["lifecycle_store"]:
+            state["lifecycle_store"].close()
         if state["db"]:
             state["db"].close()
         if state["broker"]:
@@ -232,7 +280,7 @@ def create_app(
     async def predict(req: Request) -> Response:
         metrics.predictions_submitted.inc()
         corr_id = req.state["correlation_id"]
-        model = state["model"]
+        model = _model()
         if model is None or state["batcher"] is None:
             # batcher can be None with a loaded model if its startup warmup
             # raised (e.g. device compile failure) — degraded, not a 500.
@@ -334,7 +382,7 @@ def create_app(
         Rows land in the same non-blocking watchtower ingest queue as live
         traffic (labeled rows update calibration state alongside drift)."""
         wt = state["watchtower"]
-        model = state["model"]
+        model = _model()
         if wt is None or model is None:
             raise HTTPError(
                 409, "watchtower disabled — no baseline profile loaded"
@@ -381,10 +429,62 @@ def create_app(
         # double-count them (with a days-old distribution, via the labeled
         # subset only)
         queued = wt.observe(rows, scores_arr, labels_arr, calibration_only=True)
+        # Durable copy for the conductor's retrain replay (window +
+        # reservoir). Only on the 202 path: a 429 tells the client to
+        # retry, and persisting before a retry would duplicate the rows in
+        # the training window. Off-loop (sqlite/pg write) and best-effort:
+        # the calibration window got the rows either way.
+        persisted = False
+        if queued and state["lifecycle_store"] is not None:
+            try:
+                await asyncio.to_thread(
+                    state["lifecycle_store"].add_feedback,
+                    rows, scores_arr, labels_arr,
+                )
+                persisted = True
+            except Exception:
+                log.warning("feedback persistence failed", exc_info=True)
         return Response(
-            {"queued": queued, "rows": int(rows.shape[0])},
+            {"queued": queued, "rows": int(rows.shape[0]), "persisted": persisted},
             status_code=202 if queued else 429,
         )
+
+    @app.get("/lifecycle/status")
+    async def lifecycle_status(req: Request) -> Response:
+        """Conductor state machine + feedback-pool readback: where the
+        current episode stands (idle/retraining/gated/shadowing/promoting/
+        done/rolled_back), which versions are involved, and the gate
+        evidence — the runbook's first stop."""
+        store = state["lifecycle_store"]
+        if store is None:
+            return Response({"enabled": False, "state": "unavailable"})
+
+        def _read():
+            from fraud_detection_tpu import config as cfg
+
+            s = store.get_state(cfg.model_name())
+            s["feedback"] = store.feedback_counts()
+            slot = state["slot"]
+            s["serving_version"] = slot.version if slot else None
+            s["serving_source"] = slot.source if slot else state["model_source"]
+            s["enabled"] = True
+            return s
+
+        return Response(await asyncio.to_thread(_read))
+
+    @app.post("/admin/reload")
+    async def admin_reload(req: Request) -> Response:
+        """Force one registry alias sweep NOW (the poll-independent half of
+        hot swap): flips @prod/@shadow are loaded, warmed, and swapped in
+        before the response returns."""
+        reloader = state["reloader"]
+        if reloader is None:
+            raise HTTPError(503, "no reloader — model not loaded")
+        result = await asyncio.to_thread(reloader.check_once)
+        slot = state["slot"]
+        result["serving_version"] = slot.version if slot else None
+        result["serving_source"] = slot.source if slot else None
+        return Response(result)
 
     @app.get("/metrics")
     async def prom(req: Request) -> Response:

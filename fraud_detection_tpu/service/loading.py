@@ -68,6 +68,29 @@ def load_production_model() -> tuple[FraudLogisticModel, str]:
     )
 
 
+def resolve_source_version(source: str) -> int | None:
+    """Registry version number behind a ``load_production_model`` source
+    description (``registry:models:/fraud@prod`` → the aliased version);
+    None for local-artifact sources — the lifecycle reloader only hot-swaps
+    registry-served models, so unversioned sources stay pinned."""
+    kind, _, uri = source.partition(":")
+    if kind != "registry":
+        return None
+    try:
+        from fraud_detection_tpu.tracking import TrackingClient
+        from fraud_detection_tpu.tracking.registry import parse_model_uri
+
+        name, alias, version = parse_model_uri(uri)
+        if version is not None:
+            return version
+        if alias is None:
+            return TrackingClient().registry.latest_version(name)
+        return TrackingClient().registry.get_version_by_alias(name, alias)
+    except Exception as e:
+        log.debug("source version resolution failed for %s: %s", source, e)
+        return None
+
+
 def load_shadow_model() -> tuple[FraudLogisticModel, str] | None:
     """Resolve the challenger ``models:/{name}@{shadow_stage}`` for shadow
     scoring (watchtower). Registry-only — no local fallback: a challenger

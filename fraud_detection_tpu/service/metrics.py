@@ -161,6 +161,54 @@ retrain_requests = Counter(
     registry=registry,
 )
 
+# Conductor: closed-loop retrain → gate → promotion (lifecycle/). The
+# lifecycle_* names are the alerting contract for
+# monitoring/prometheus/rules/lifecycle-alerts.yml.
+lifecycle_model_swaps = Counter(
+    "lifecycle_model_swaps",
+    "Hot model swaps applied by the serving reloader (no restart)",
+    registry=registry,
+)
+lifecycle_active_model_version = Gauge(
+    "lifecycle_active_model_version",
+    "Registry version of the champion currently being served (0 = unversioned)",
+    registry=registry,
+)
+lifecycle_state = Gauge(
+    "lifecycle_state",
+    "1 for the conductor state machine's current state, 0 otherwise",
+    ["state"],
+    registry=registry,
+)
+lifecycle_retrains = Counter(
+    "lifecycle_retrains",
+    "Conductor retrain executions by outcome (gated/gate_failed/failed/skipped)",
+    ["outcome"],
+    registry=registry,
+)
+lifecycle_retrain_duration = Histogram(
+    "lifecycle_retrain_duration_seconds",
+    "Wall time of a conductor retrain (fit + gate evaluation)",
+    buckets=(1, 5, 15, 30, 60, 120, 300, 600, 1800, 3600),
+    registry=registry,
+)
+lifecycle_promotions = Counter(
+    "lifecycle_promotions",
+    "Challenger promotions completed (alias flipped to the challenger)",
+    registry=registry,
+)
+lifecycle_rollbacks = Counter(
+    "lifecycle_rollbacks",
+    "Rollbacks completed (challenger dropped or prior champion restored)",
+    registry=registry,
+)
+lifecycle_feedback_rows = Gauge(
+    "lifecycle_feedback_rows",
+    "Durable labeled-feedback rows by pool (window/reservoir)",
+    ["pool"],
+    registry=registry,
+)
+
 
 def render() -> bytes:
     return generate_latest(registry)

@@ -34,13 +34,21 @@ log = logging.getLogger("fraud_detection_tpu.microbatch")
 class MicroBatcher:
     def __init__(
         self,
-        scorer: BatchScorer,
+        scorer: BatchScorer | None = None,
         max_batch: int | None = None,
         max_wait_ms: float | None = None,
         max_inflight: int | None = None,
         watchtower=None,
+        slot=None,
     ):
-        self.scorer = scorer
+        # Either a fixed scorer (offline tools, tests) or a lifecycle
+        # ModelSlot (serving): with a slot, every flush re-reads the slot's
+        # current model, so a hot swap lands between batches — in-flight
+        # batches finish on the old params, the next scores with the new.
+        if scorer is None and slot is None:
+            raise ValueError("MicroBatcher needs a scorer or a model slot")
+        self.slot = slot
+        self.scorer = scorer if scorer is not None else slot.model.scorer
         # Optional monitor.Watchtower: every scored batch is handed to its
         # non-blocking observe() after the waiters resolve — drift/shadow
         # monitoring rides the batch boundary, zero per-row host work.
@@ -165,12 +173,17 @@ class MicroBatcher:
             # forever inside a detached task.
             rows = np.stack([r for r, _ in batch])
             metrics.microbatch_size.observe(len(batch))
+            # ONE slot read per flush: the scorer is pinned for this batch
+            # even if a promotion swaps the slot mid-dispatch.
+            scorer = (
+                self.slot.model.scorer if self.slot is not None else self.scorer
+            )
             # The device call is synchronous-but-fast; run it in the default
             # executor so the event loop keeps accepting requests while XLA
             # executes. annotate() is free when no device_trace is active.
             def _score() -> np.ndarray:
                 with annotate("microbatch-score"):
-                    return self.scorer.predict_proba(rows)
+                    return scorer.predict_proba(rows)
 
             probs = await asyncio.get_running_loop().run_in_executor(
                 None, _score

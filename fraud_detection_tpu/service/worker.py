@@ -63,6 +63,7 @@ class XaiWorker:
         self.poll_interval = poll_interval
         self.max_batch = max_batch
         self._stop = threading.Event()
+        self._conductor = None  # lazily built (lifecycle/)
         self.model, source = load_production_model()
         self.model.raw_explainer()  # build + cache at startup, not per task
         # Workers export the shared registry on :8001 — the gauge must be
@@ -90,24 +91,99 @@ class XaiWorker:
             correlation_id, transaction_id, score,
         )
 
+    # -- conductor (lifecycle/) -------------------------------------------
+    def _get_conductor(self):
+        """Lazily build the conductor: workers on hosts without a usable
+        lifecycle DB keep explaining transactions; lifecycle tasks fail
+        into the retry ladder with the real error."""
+        if self._conductor is None:
+            from fraud_detection_tpu.lifecycle import (
+                Conductor,
+                open_lifecycle_store,
+            )
+
+            # Lifecycle state lives beside THIS worker's queue
+            # (LIFECYCLE_DB_URL overrides — config.lifecycle_db_url).
+            self._conductor = Conductor(
+                store=open_lifecycle_store(
+                    config.lifecycle_db_url(self.broker.url)
+                ),
+                on_promote=self._on_promote,
+            )
+        return self._conductor
+
+    def _on_promote(self, version: int) -> None:
+        """A promotion this worker applied: hot-reload its OWN model so the
+        explanation path immediately matches what serving scores with."""
+        try:
+            # fully build (incl. the cached explainer) BEFORE publishing:
+            # if any step raises, self.model still IS the previous champion
+            # and the log below stays truthful
+            model, source = load_production_model()
+            model.raw_explainer()
+            self.model = model
+            log.warning(
+                "worker model hot-reloaded after promotion of v%s (%s)",
+                version, source,
+            )
+        except Exception:
+            log.warning(
+                "worker model reload after promotion failed — explaining "
+                "with the previous champion until restart", exc_info=True,
+            )
+
     def trigger_retrain(self, reason: str = "") -> None:
         """Watchtower drift episode (monitor/watchtower.py, one task per
-        episode when WATCHTOWER_RETRAIN_TRIGGER=1). The worker is the
-        operational anchor: it logs the request loudly with the drift
-        evidence — deployments chain their training pipeline off this task
-        (docs/runbooks/DriftDetected.md)."""
+        episode when WATCHTOWER_RETRAIN_TRIGGER=1): execute the conductor's
+        retrain → gate → @shadow pipeline (lifecycle/conductor.py). The
+        watchtower's in-process latch bounds one task per episode; the
+        conductor's persisted CAS additionally drops duplicates across API
+        replicas, so a drifting window can never stack retrains."""
         metrics.retrain_requests.inc()
         log.warning(
-            "RETRAIN REQUESTED by watchtower: %s — run "
-            "`python -m fraud_detection_tpu.train` and register the new "
-            "model at @shadow (see docs/runbooks/DriftDetected.md)",
+            "RETRAIN REQUESTED by watchtower: %s — running the conductor "
+            "pipeline (docs/runbooks/DriftDetected.md)",
             reason or "(no reason given)",
         )
+        result = self._get_conductor().handle_retrain(reason)
+        log.warning("conductor retrain finished: %s", result)
+
+    def promote_challenger(self, reason: str = "") -> None:
+        self._get_conductor().handle_promote(reason)
+
+    def rollback_challenger(self, reason: str = "") -> None:
+        self._get_conductor().handle_rollback(reason)
+
+    def record_feedback(self, features, scores, labels) -> None:
+        """Queue-delivered labeled feedback (deployments whose label joiner
+        publishes to the broker instead of POSTing /monitor/feedback)."""
+        n = self._get_conductor().record_feedback(features, scores, labels)
+        log.info("recorded %d feedback rows", n)
+
+    def resume_lifecycle(self) -> None:
+        """Finish any episode a dead worker left mid-step (run_forever calls
+        this before consuming; crash-resume is also unit-driven in tests)."""
+        try:
+            result = self._get_conductor().resume()
+        except Exception:
+            log.warning("lifecycle resume failed", exc_info=True)
+            return
+        if result is not None:
+            log.warning("resumed lifecycle episode: %s", result)
 
     def _execute(self, task: Task) -> None:
+        from fraud_detection_tpu.lifecycle.conductor import (
+            FEEDBACK_TASK,
+            PROMOTE_TASK,
+            ROLLBACK_TASK,
+        )
+
         handlers = {
             "xai_tasks.compute_shap": self.compute_shap,
             "watchtower.trigger_retrain": self.trigger_retrain,
+            PROMOTE_TASK: self.promote_challenger,
+            ROLLBACK_TASK: self.rollback_challenger,
+            FEEDBACK_TASK: self.record_feedback,
         }
         fn = handlers.get(task.name)
         if fn is None:
@@ -268,6 +344,7 @@ class XaiWorker:
         if max_batch:
             self.max_batch = max_batch
         self.warmup()
+        self.resume_lifecycle()  # crash recovery BEFORE consuming new work
         log.info("worker %s consuming (broker %s)", self.worker_id, self.broker.url)
         outage_backoff = max(5 * self.poll_interval, 1.0)
         while not self._stop.is_set():

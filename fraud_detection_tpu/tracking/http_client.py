@@ -177,10 +177,13 @@ class HttpModelRegistry:
         artifact_dir: str,
         run_id: str | None = None,
         metrics: dict | None = None,
+        lineage: dict | None = None,
     ) -> int:
         from fraud_detection_tpu.tracking.server import tar_bytes
 
         headers = {"x-metrics": json.dumps(metrics or {})}
+        if lineage:
+            headers["x-lineage"] = json.dumps(lineage)
         if run_id:
             headers["x-run-id"] = run_id
         resp = json.loads(
@@ -196,6 +199,24 @@ class HttpModelRegistry:
             "POST", f"{self.base}/api/registry/{name}/aliases",
             {"alias": alias, "version": int(version)},
         )
+
+    def delete_alias(self, name: str, alias: str) -> bool:
+        resp = _call_json(
+            "POST", f"{self.base}/api/registry/{name}/aliases",
+            {"alias": alias, "version": None},
+        )
+        return bool(resp.get("deleted"))
+
+    def get_meta(self, name: str, version: int) -> dict:
+        """meta.json of a cached/downloaded version ({} when absent)."""
+        try:
+            path = os.path.join(self.artifact_dir(name, version), "meta.json")
+        except TrackingHTTPError:
+            return {}
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            return json.load(f)
 
     def get_version_by_alias(self, name: str, alias: str) -> int | None:
         v = _call_json("GET", f"{self.base}/api/registry/{name}/aliases").get(alias)
@@ -252,12 +273,15 @@ class HttpModelRegistry:
         threshold: float,
         alias: str | None = None,
         run_id: str | None = None,
+        lineage: dict | None = None,
     ) -> int | None:
         """AUC promotion gate, same NaN-fails semantics as the file
         registry (registry.py:107-125)."""
         if not (auc >= threshold):
             return None
-        version = self.register(name, artifact_dir, run_id, {"auc": auc})
+        version = self.register(
+            name, artifact_dir, run_id, {"auc": auc}, lineage=lineage
+        )
         if alias:
             self.set_alias(name, alias, version)
         return version

@@ -64,9 +64,12 @@ class ModelRegistry:
         artifact_dir: str,
         run_id: str | None = None,
         metrics: dict | None = None,
+        lineage: dict | None = None,
     ) -> int:
         """Copy ``artifact_dir`` in as the next version; returns the version
-        number (MLflow register_model equivalent)."""
+        number (MLflow register_model equivalent). ``lineage`` carries the
+        conductor's provenance record (parent champion version, feedback
+        window, gate metrics) into ``meta.json``."""
         versions_dir = os.path.join(self._model_dir(name), "versions")
         os.makedirs(versions_dir, exist_ok=True)
         existing = [int(v) for v in os.listdir(versions_dir) if v.isdigit()]
@@ -80,6 +83,7 @@ class ModelRegistry:
                 "version": version,
                 "run_id": run_id,
                 "metrics": metrics or {},
+                "lineage": lineage or {},
                 "created_at": time.time(),
             },
         )
@@ -90,6 +94,18 @@ class ModelRegistry:
         aliases = _read_json(path, {})
         aliases[alias] = int(version)
         _atomic_write_json(path, aliases)
+
+    def delete_alias(self, name: str, alias: str) -> bool:
+        """Drop an alias (the challenger-rollback act: ``@shadow`` goes
+        away, the versioned artifacts stay). Returns False when the alias
+        did not exist — idempotent for the conductor's resume path."""
+        path = self._aliases_path(name)
+        aliases = _read_json(path, {})
+        if alias not in aliases:
+            return False
+        del aliases[alias]
+        _atomic_write_json(path, aliases)
+        return True
 
     # -- reads -------------------------------------------------------------
     def get_version_by_alias(self, name: str, alias: str) -> int | None:
@@ -106,6 +122,12 @@ class ModelRegistry:
 
     def artifact_dir(self, name: str, version: int) -> str:
         return os.path.join(self._model_dir(name), "versions", str(version))
+
+    def get_meta(self, name: str, version: int) -> dict:
+        """``meta.json`` for a version (lineage readback); {} when absent."""
+        return _read_json(
+            os.path.join(self.artifact_dir(name, version), "meta.json"), {}
+        )
 
     def resolve(self, model_uri: str) -> str:
         """``models:/name@alias`` | ``models:/name/3`` | ``models:/name/stage``
@@ -133,6 +155,7 @@ class ModelRegistry:
         threshold: float,
         alias: str | None = None,
         run_id: str | None = None,
+        lineage: dict | None = None,
     ) -> int | None:
         """The AUC promotion gate (train_model.py:152-163): register + alias
         only when ``auc >= threshold``; returns the version or None. Written
@@ -140,7 +163,9 @@ class ModelRegistry:
         instead of sailing through a ``<`` comparison."""
         if not (auc >= threshold):
             return None
-        version = self.register(name, artifact_dir, run_id, {"auc": auc})
+        version = self.register(
+            name, artifact_dir, run_id, {"auc": auc}, lineage=lineage
+        )
         if alias:
             self.set_alias(name, alias, version)
         return version

@@ -180,11 +180,13 @@ def create_app(root: str) -> App:
         import tempfile
 
         metrics = json.loads(req.headers.get("x-metrics", "{}") or "{}")
+        lineage = json.loads(req.headers.get("x-lineage", "{}") or "{}")
         with tempfile.TemporaryDirectory() as tmp:
             untar_bytes(req.body, tmp)
             version = registry.register(
                 _seg(req, "name"), tmp,
                 run_id=req.headers.get("x-run-id"), metrics=metrics,
+                lineage=lineage,
             )
         return Response({"version": version})
 
@@ -199,7 +201,16 @@ def create_app(root: str) -> App:
 
     @app.post("/api/registry/{name}/aliases")
     async def set_alias(req: Request) -> Response:
+        # An EXPLICIT version: null deletes the alias (the conductor's
+        # challenger rollback) — one route keeps the wire surface small. A
+        # missing version key stays an error: silently deleting @prod on a
+        # client that forgot the field would degrade serving with a 200.
         body = req.json()
+        if "version" not in body:
+            raise HTTPError(422, "'version' required (null deletes the alias)")
+        if body["version"] is None:
+            deleted = registry.delete_alias(_seg(req, "name"), body["alias"])
+            return Response({"ok": True, "deleted": deleted})
         registry.set_alias(
             _seg(req, "name"), body["alias"], int(body["version"])
         )

@@ -264,6 +264,12 @@ def train(
         run.log_param("auc_threshold", threshold)
         version = None
         if register:
+            # Same lineage record the conductor writes (lifecycle/), so a
+            # registry version always says where it came from — an offline
+            # run's parent is whatever @prod pointed at when it trained.
+            parent = client.registry.get_version_by_alias(
+                config.model_name(), config.model_stage()
+            )
             version = client.registry.register_if_gate(
                 config.model_name(),
                 model_artifact,
@@ -271,6 +277,12 @@ def train(
                 threshold,
                 alias=config.model_stage(),
                 run_id=run.run_id,
+                lineage={
+                    "trained_by": "offline",
+                    "parent_version": parent,
+                    "data_csv": data_csv,
+                    "n_rows": len(y),
+                },
             )
             if version:
                 run.set_tag("registered_version", version)

@@ -691,7 +691,7 @@ def test_monitor_feedback_feeds_calibration(monitored_app, rng):
         },
     )
     assert r.status_code == 202
-    assert r.json() == {"queued": True, "rows": 64}
+    assert r.json() == {"queued": True, "rows": 64, "persisted": True}
     wt = client.app.state["watchtower"]
     assert wt.drain(timeout=30.0)
     st = wt.status()

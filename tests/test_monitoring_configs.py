@@ -60,6 +60,44 @@ def test_watchtower_alert_metrics_exist_in_registry():
     assert not missing, f"alert rules reference unexported metrics: {missing}"
 
 
+def test_lifecycle_rules_file_ships():
+    path = os.path.join(RULES_DIR, "lifecycle-alerts.yml")
+    assert os.path.exists(path)
+    assert promlint.lint_rules_file(path) == []
+    with open(path) as f:
+        text = f.read()
+    # the two alerts the conductor PR promises (ISSUE 3)
+    assert "RetrainFailed" in text
+    assert "PromotionStuck" in text
+
+
+def test_lifecycle_alert_metrics_exist_in_registry():
+    """Every lifecycle_* metric an alert references must be exported by
+    service/metrics.py — same contract test as the watchtower rules."""
+    from fraud_detection_tpu.service import metrics as m
+
+    exported = set()
+    for line in m.render().decode().splitlines():
+        if line.startswith("# HELP "):
+            exported.add(line.split()[2])
+            continue
+        match = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{|\s)", line)
+        if match:
+            exported.add(match.group(1))
+    with open(os.path.join(RULES_DIR, "lifecycle-alerts.yml")) as f:
+        text = f.read()
+    referenced = set(re.findall(r"\b(lifecycle_[a-z_]+)\b", text))
+    referenced -= {"lifecycle_alerts"}  # the file's own name
+    assert referenced, "lifecycle rules reference no lifecycle metrics?"
+    missing = {
+        name for name in referenced
+        if name not in exported
+        and name.removesuffix("_total") not in exported
+        and f"{name}_total" not in exported
+    }
+    assert not missing, f"alert rules reference unexported metrics: {missing}"
+
+
 def test_grafana_watchtower_panels_present():
     errors = promlint.lint_grafana_dashboard(
         os.path.join(MONITORING, "grafana_dashboard.json")
