@@ -277,6 +277,9 @@ def watchtower_retrain_trigger() -> bool:
 # Conductor: closed-loop retrain → challenger gate → promotion (lifecycle/)
 # --------------------------------------------------------------------------
 
+_warned_local_lifecycle_db = False
+
+
 def lifecycle_db_url(broker: str | None = None) -> str:
     """Database holding the conductor's feedback + state tables.
     ``LIFECYCLE_DB_URL`` wins; otherwise the broker database (``broker``
@@ -284,13 +287,30 @@ def lifecycle_db_url(broker: str | None = None) -> str:
     its state beside its queue — else ``CELERY_BROKER_URL``) when that is
     a SQL backend, so lifecycle state shares the queue's durability story;
     the network-store broker (``fraud://``/``sentinel://``) has no generic
-    SQL surface, so the lifecycle tier falls back to its own local file."""
+    SQL surface, so the lifecycle tier falls back to its own local file —
+    with a loud once-per-process warning, because a process-local file
+    cannot carry feedback or the retrain/promotion latch across replicas."""
     explicit = os.environ.get("LIFECYCLE_DB_URL")
     if explicit:
         return explicit
     broker = broker or broker_url()
     if broker.startswith(("sqlite", "postgresql://", "postgres://")):
         return broker
+    global _warned_local_lifecycle_db
+    if not _warned_local_lifecycle_db:
+        _warned_local_lifecycle_db = True
+        import logging
+
+        logging.getLogger("fraud_detection_tpu.config").warning(
+            "LIFECYCLE_DB_URL is not set and broker %r has no SQL surface: "
+            "lifecycle state falls back to the PROCESS-LOCAL "
+            "sqlite:///lifecycle.db. Durable feedback and the cross-replica "
+            "retrain/promotion latch will NOT span replicas — each process "
+            "sees only its own file. Set LIFECYCLE_DB_URL to a shared "
+            "database before enabling WATCHTOWER_RETRAIN_TRIGGER or "
+            "CONDUCTOR_AUTO_PROMOTE in a multi-process deployment.",
+            broker,
+        )
     return "sqlite:///lifecycle.db"
 
 
@@ -340,6 +360,15 @@ def lifecycle_reload_interval() -> float:
     """Seconds between registry alias polls by the serving-side model
     reloader; 0 disables polling (``POST /admin/reload`` still works)."""
     return _get_float("LIFECYCLE_RELOAD_INTERVAL_S", 15.0)
+
+
+def lifecycle_retrain_stale_after() -> float:
+    """Seconds without a heartbeat after which a RETRAINING episode counts
+    as a dead worker's and resume() may reclaim it. The owning worker beats
+    every third of this, so a live fit is never stolen; set it above your
+    longest tolerable worker GC/IO stall, not above the fit duration (the
+    heartbeat runs on its own thread for the whole fit)."""
+    return _get_float("LIFECYCLE_RETRAIN_STALE_AFTER_S", 900.0)
 
 
 @dataclass

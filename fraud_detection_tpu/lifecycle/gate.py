@@ -18,7 +18,12 @@ All four statistics come out of ONE jitted program per slice
 (:func:`_gate_stats` — both models' scores go in, the AUCs/ECE/PSI come
 out), in the batched-on-device spirit of GPUTreeShap (PAPERS.md): the host
 never loops over rows, and the program is registered with graftcheck's
-virtual-mesh verifier so its shapes are proven at every mesh size.
+virtual-mesh verifier so its shapes are proven at every mesh size. Slices
+are padded up to a power-of-two bucket (floor ``_MIN_GATE_BUCKET``) before
+entering the program — the recent-labeled-window length varies every
+episode, and without bucketing each gate run would trigger a fresh XLA
+compile; the weights vector zeroes the padding rows so every statistic is
+exact (same warm-path discipline as the scorer's bucket ladder).
 
 NaN discipline matches ``registry.register_if_gate``: every criterion is
 written as ``not (ok_condition)`` so a NaN statistic (diverged fit,
@@ -42,6 +47,10 @@ log = logging.getLogger("fraud_detection_tpu.lifecycle")
 
 N_GATE_SCORE_BINS = 20
 N_GATE_CALIB_BINS = 10
+
+# Smallest padded slice length: caps the compile-cache ladder at
+# log2(window_size / _MIN_GATE_BUCKET) + 1 distinct _gate_stats programs.
+_MIN_GATE_BUCKET = 256
 
 
 @dataclass(frozen=True)
@@ -132,11 +141,21 @@ def _slice_stats(
     calib_edges = jnp.asarray(
         np.linspace(0.0, 1.0, N_GATE_CALIB_BINS + 1)[1:-1], jnp.float32
     )
+    # pad to the power-of-two bucket so _gate_stats compiles once per bucket
+    # instead of once per slice length; weight 0 keeps padding rows inert in
+    # all four statistics (AUC/ECE/PSI are weight-exact)
+    from fraud_detection_tpu.ops.scorer import _bucket
+
+    n = int(y.shape[0])
+    pad = _bucket(n, _MIN_GATE_BUCKET) - n
+    weights = np.concatenate(
+        [np.ones((n,), np.float32), np.zeros((pad,), np.float32)]
+    )
     champ_auc, chall_auc, ece, psi = _gate_stats(
-        jnp.asarray(champ),
-        jnp.asarray(chall),
-        jnp.asarray(y, jnp.float32),
-        jnp.ones((y.shape[0],), jnp.float32),
+        jnp.asarray(np.pad(champ, (0, pad))),
+        jnp.asarray(np.pad(chall, (0, pad))),
+        jnp.asarray(np.pad(np.asarray(y, np.float32), (0, pad))),
+        jnp.asarray(weights),
         score_edges,
         calib_edges,
     )

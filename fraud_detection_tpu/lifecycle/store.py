@@ -20,10 +20,15 @@ the built-in wire client, same dual-dialect pattern as taskq.py/pgclient.py):
 
 - ``lifecycle_state`` — one row per model name holding the conductor's
   state machine (``idle → retraining → gated → shadowing → promoting →
-  done/rolled_back``) plus the challenger/champion versions and gate
-  evidence. Transitions go through :meth:`LifecycleStore.transition` — a
-  guarded compare-and-set — so a crashed worker resumes mid-step without
-  double-promoting and two workers can't run the same step twice.
+  done/rolled_back``, with ``rolling_back`` as the persisted rollback
+  intent) plus the challenger/champion versions, gate evidence, and the
+  episode owner. Transitions go through :meth:`LifecycleStore.transition`
+  — a *single* guarded ``UPDATE ... WHERE state IN (...)`` so the
+  compare-and-set is atomic across processes, not just across threads:
+  under PG READ COMMITTED the post-lock predicate re-check makes a lost
+  race return rowcount 0, and under sqlite the one DML statement holds the
+  write lock for its whole evaluation. A crashed worker resumes mid-step
+  without double-promoting and two workers can't run the same step twice.
 """
 
 from __future__ import annotations
@@ -46,15 +51,26 @@ WINDOW = "window"
 RESERVOIR = "reservoir"
 
 # State machine vocabulary (ISSUE-pinned): terminal states re-arm to a new
-# episode via begin-retrain.
+# episode via begin-retrain. ROLLING_BACK is the persisted promotion-rollback
+# intent — recorded before any alias moves so a crash mid-rollback resumes.
 IDLE = "idle"
 RETRAINING = "retraining"
 GATED = "gated"
 SHADOWING = "shadowing"
 PROMOTING = "promoting"
+ROLLING_BACK = "rolling_back"
 DONE = "done"
 ROLLED_BACK = "rolled_back"
-STATES = (IDLE, RETRAINING, GATED, SHADOWING, PROMOTING, DONE, ROLLED_BACK)
+STATES = (
+    IDLE, RETRAINING, GATED, SHADOWING, PROMOTING, ROLLING_BACK, DONE,
+    ROLLED_BACK,
+)
+
+# Columns of lifecycle_state a transition may set (everything but the PK and
+# updated_at, which the CAS always stamps).
+_FIELD_COLS = (
+    "challenger_version", "champion_version", "reason", "gate", "owner",
+)
 
 _SCHEMA = [
     """
@@ -85,6 +101,7 @@ _SCHEMA = [
         champion_version INTEGER,
         reason TEXT,
         gate TEXT,
+        owner TEXT,
         updated_at REAL NOT NULL
     )
     """,
@@ -124,6 +141,19 @@ class LifecycleStore:
         with self._lock, self._conn:
             for stmt in _SCHEMA:
                 self._conn.executescript(stmt)
+        # stores created before the owner column existed: best-effort add
+        # (its own transaction — a PG error aborts the enclosing txn)
+        with self._lock:
+            try:
+                with self._conn:
+                    self._conn.execute(
+                        "ALTER TABLE lifecycle_state ADD COLUMN owner TEXT"
+                    )
+            except Exception:
+                # column already present (the common case: CREATE TABLE
+                # above ships it; only pre-owner stores need the ALTER)
+                log.debug("lifecycle owner column migration skipped",
+                          exc_info=True)
 
     def _connect(self) -> None:
         import os
@@ -279,7 +309,7 @@ class LifecycleStore:
             return {
                 "name": name, "state": IDLE, "challenger_version": None,
                 "champion_version": None, "reason": None, "gate": None,
-                "updated_at": None,
+                "owner": None, "updated_at": None,
             }
         d = dict(row)
         d["gate"] = json.loads(d["gate"]) if d.get("gate") else None
@@ -293,19 +323,20 @@ class LifecycleStore:
             fields.get("champion_version"),
             fields.get("reason"),
             json.dumps(gate) if gate is not None else None,
+            fields.get("owner"),
             time.time(),
         )
         cur = self._conn.execute(
             "UPDATE lifecycle_state SET state = ?, challenger_version = ?, "
-            "champion_version = ?, reason = ?, gate = ?, updated_at = ? "
-            "WHERE name = ?",
+            "champion_version = ?, reason = ?, gate = ?, owner = ?, "
+            "updated_at = ? WHERE name = ?",
             vals + (name,),
         )
         if cur.rowcount == 0:
             self._conn.execute(
                 "INSERT INTO lifecycle_state (state, challenger_version, "
-                "champion_version, reason, gate, updated_at, name) "
-                "VALUES (?, ?, ?, ?, ?, ?, ?)",
+                "champion_version, reason, gate, owner, updated_at, name) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
                 vals + (name,),
             )
 
@@ -318,27 +349,111 @@ class LifecycleStore:
             self._write_state(name, state, fields)
 
     def transition(
-        self, name: str, from_states: Iterable[str], to_state: str, **fields
+        self,
+        name: str,
+        from_states: Iterable[str],
+        to_state: str,
+        *,
+        owner_guard: str | None = None,
+        **fields,
     ) -> bool:
         """Compare-and-set: move to ``to_state`` only if the current state is
-        in ``from_states``; preserves unspecified fields. Returns False on a
-        lost race / wrong precondition — the caller's idempotency signal."""
+        in ``from_states``; fields not named keep their value. Returns False
+        on a lost race / wrong precondition — the caller's idempotency
+        signal.
+
+        The CAS is ONE guarded UPDATE (state — and owner, when
+        ``owner_guard`` is given — checked in the WHERE clause), so it is
+        atomic across processes and replicas, not merely under the
+        per-process lock: concurrent callers serialize on the row and the
+        loser's re-checked predicate yields rowcount 0 in both dialects
+        (sqlite holds the write lock for the whole statement; PG READ
+        COMMITTED re-evaluates the predicate after the row lock). A name
+        never written before is implicitly IDLE; it is materialized with a
+        PK-guarded insert (``ON CONFLICT DO NOTHING`` — a lost race
+        collapses to a no-op) so the UPDATE stays the single decision
+        point."""
         if to_state not in STATES:
             raise ValueError(f"unknown lifecycle state {to_state!r}")
+        unknown = set(fields) - set(_FIELD_COLS)
+        if unknown:
+            raise ValueError(
+                f"unknown lifecycle_state fields {sorted(unknown)}"
+            )
+        froms = tuple(from_states)
+        # database clock, same as heartbeat/reclaim: the stamp a transition
+        # into RETRAINING writes is the first value the staleness predicate
+        # reads, so it must not come from a (possibly skewed) host clock
+        now = self._db_now()
+        sets, vals = ["state = ?", "updated_at = ?"], [to_state, now]
+        for col in _FIELD_COLS:
+            if col in fields:
+                v = fields[col]
+                if col == "gate" and v is not None:
+                    v = json.dumps(v)
+                sets.append(f"{col} = ?")
+                vals.append(v)
+        where = f"name = ? AND state IN ({', '.join('?' * len(froms))})"
+        vals += [name, *froms]
+        if owner_guard is not None:
+            where += " AND owner = ?"
+            vals.append(owner_guard)
         with self._lock, self._conn:
-            row = self._conn.execute(
-                "SELECT * FROM lifecycle_state WHERE name = ?", (name,)
-            ).fetchone()
-            current = row["state"] if row is not None else IDLE
-            if current not in tuple(from_states):
-                return False
-            merged = dict(row) if row is not None else {}
-            merged.pop("gate", None)
-            if row is not None and row["gate"]:
-                merged["gate"] = json.loads(row["gate"])
-            merged.update(fields)
-            self._write_state(name, to_state, merged)
-            return True
+            if IDLE in froms and owner_guard is None:
+                self._conn.execute(
+                    "INSERT INTO lifecycle_state (name, state, updated_at) "
+                    "VALUES (?, ?, ?) ON CONFLICT (name) DO NOTHING",
+                    (name, IDLE, now),
+                )
+            cur = self._conn.execute(
+                f"UPDATE lifecycle_state SET {', '.join(sets)} WHERE {where}",
+                vals,
+            )
+            return cur.rowcount == 1
+
+    def _db_now(self) -> float:
+        """Epoch seconds on the DATABASE's clock. Heartbeat stamps and the
+        staleness predicate must read one clock — comparing two hosts'
+        ``time.time()`` lets clock skew eat into (or inflate) the stale
+        threshold. A sqlite file is host-local, so the host clock IS the
+        database clock; :class:`PgLifecycleStore` asks the server."""
+        return time.time()
+
+    def heartbeat(self, name: str, owner: str) -> bool:
+        """Refresh the liveness stamp of an owned RETRAINING episode. The
+        retrain executor beats immediately and then every ``stale_after /
+        3`` seconds; resume() treats a row whose stamp is older than
+        ``stale_after`` as a dead owner's."""
+        now = self._db_now()
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                "UPDATE lifecycle_state SET updated_at = ? "
+                "WHERE name = ? AND state = ? AND owner = ?",
+                (now, name, RETRAINING, owner),
+            )
+            return cur.rowcount == 1
+
+    def reclaim_stale_retrain(self, name: str, stale_after: float) -> bool:
+        """Atomically reset a RETRAINING row to IDLE iff its heartbeat is at
+        least ``stale_after`` seconds old — the guarded steal resume() uses
+        so only a provably dead owner's episode gets re-run. The staleness
+        predicate lives inside the UPDATE: a live owner's concurrent
+        heartbeat makes the steal lose (rowcount 0) instead of hijacking a
+        running fit. Both sides of the comparison come from the database's
+        clock (:meth:`_db_now`), so cross-replica host skew cannot fake or
+        mask staleness."""
+        now = self._db_now()
+        with self._lock, self._conn:
+            cur = self._conn.execute(
+                "UPDATE lifecycle_state SET state = ?, owner = NULL, "
+                "updated_at = ?, reason = ? WHERE name = ? AND state = ? "
+                "AND updated_at <= ?",
+                (
+                    IDLE, now, "reclaimed stale retrain episode", name,
+                    RETRAINING, now - float(stale_after),
+                ),
+            )
+            return cur.rowcount == 1
 
     # -- plumbing ----------------------------------------------------------
     def ping(self) -> bool:
@@ -362,6 +477,20 @@ class PgLifecycleStore(LifecycleStore):
         from fraud_detection_tpu.service.pgclient import _PgAdapter
 
         self._conn = _PgAdapter(self.url)
+
+    def _db_now(self) -> float:
+        try:
+            with self._lock:
+                row = self._conn.execute(
+                    "SELECT EXTRACT(EPOCH FROM now()) AS t"
+                ).fetchone()
+            return float(row["t"])
+        except Exception:
+            # protocol emulator / exotic servers without EXTRACT: host time
+            # (same behavior as the sqlite store — skew risk returns only
+            # where the shared-server guarantee was absent anyway)
+            log.debug("db clock unavailable; using host clock", exc_info=True)
+            return time.time()
 
 
 def open_lifecycle_store(url: str | None = None, **kw) -> LifecycleStore:
