@@ -165,6 +165,23 @@ def _build_scorer(mesh: Mesh):
     return (lambda c, i, xx: _score(c, i, xx)), (coef, intercept, x)
 
 
+@register_entrypoint("telemetry.instrumented_score")
+def _build_instrumented_scorer(mesh: Mesh):
+    """The scorer as serving actually dispatches it once the compile
+    sentinel is installed: proves the instrumentation wrapper is
+    transparent to abstract evaluation (and therefore to tracing/sharding)
+    at every mesh size — a sentinel that broke eval_shape would also break
+    jit tracing in production."""
+    from fraud_detection_tpu.ops.scorer import _score
+    from fraud_detection_tpu.telemetry.compile_sentinel import instrument
+
+    wrapped = instrument("meshcheck.scorer", _score)
+    coef = sds((_FEATURES,), jnp.float32, mesh, P())
+    intercept = sds((), jnp.float32, mesh, P())
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, P(DATA_AXIS))
+    return (lambda c, i, xx: wrapped(c, i, xx)), (coef, intercept, x)
+
+
 @register_entrypoint("logistic.lbfgs_fit")
 def _build_lbfgs(mesh: Mesh):
     from fraud_detection_tpu.ops.logistic import LogisticParams, _fit_lbfgs

@@ -183,6 +183,105 @@ def check_thread_daemon(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+#: prometheus_client metric constructors the registry rule watches.
+_PROM_METRIC_CLASSES = {
+    "Counter", "Gauge", "Histogram", "Summary", "Info", "Enum",
+}
+
+#: the one module allowed to mint metrics on the shared service registry.
+_CANONICAL_METRICS_MODULE = "service/metrics.py"
+
+
+def _prometheus_bindings(mod: ModuleInfo) -> dict[str, str]:
+    """Local name → prometheus_client class, for names bound via
+    ``from prometheus_client import Counter [as C]``. Import-tracked so a
+    ``collections.Counter`` can never false-positive."""
+    out: dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.ImportFrom)
+            and node.module == "prometheus_client"
+        ):
+            for alias in node.names:
+                if alias.name in _PROM_METRIC_CLASSES:
+                    out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def _local_registry_names(mod: ModuleInfo) -> set[str]:
+    """Names bound to a ``CollectorRegistry(...)`` call in this module —
+    private registries are the sanctioned way to export metrics outside
+    the shared-registry module (netserver's store gauges)."""
+    out: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and (dotted_name(node.func) or "").split(".")[-1]
+            == "CollectorRegistry"
+        ):
+            target = _assign_target_name(mod, node)
+            if target:
+                out.add(target)
+    return out
+
+
+@register_rule(
+    "prom-foreign-registry",
+    Severity.WARNING,
+    "prometheus metric constructed without registry= (the default REGISTRY "
+    "double-registers under gunicorn/module re-import) or minted on the "
+    "shared service registry outside service/metrics.py (the registry "
+    "contract tests and alert-rule cross-checks only see metrics.py)",
+)
+def check_prom_foreign_registry(mod: ModuleInfo) -> Iterator[Finding]:
+    rule = check_prom_foreign_registry.rule
+    bindings = _prometheus_bindings(mod)
+    local_registries = _local_registry_names(mod)
+    is_canonical = mod.rel_path.replace("\\", "/").endswith(
+        _CANONICAL_METRICS_MODULE
+    )
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        if callee is None:
+            continue
+        if callee in bindings:
+            cls = bindings[callee]
+        elif (
+            callee.startswith("prometheus_client.")
+            and callee.split(".")[-1] in _PROM_METRIC_CLASSES
+        ):
+            cls = callee.split(".")[-1]
+        else:
+            continue
+        registry_kw = next(
+            (kw for kw in node.keywords if kw.arg == "registry"), None
+        )
+        if registry_kw is None:
+            yield mod.finding(
+                rule, node,
+                f"{cls}() without registry= lands on the global default "
+                "REGISTRY — duplicate-metric crash on re-import and "
+                "per-process double counting under gunicorn; pass an "
+                "explicit registry",
+            )
+            continue
+        if is_canonical:
+            continue
+        reg_name = dotted_name(registry_kw.value) or ""
+        if reg_name in local_registries:
+            continue  # module-private CollectorRegistry: sanctioned
+        yield mod.finding(
+            rule, node,
+            f"{cls}(registry={reg_name or '...'}) minted outside "
+            "service/metrics.py — shared-registry metrics must be declared "
+            "there (the alerting-contract tests and /metrics exposition "
+            "only audit that module), or use a module-local "
+            "CollectorRegistry",
+        )
+
+
 def _join_targets(mod: ModuleInfo) -> set[str]:
     out: set[str] = set()
     for node in ast.walk(mod.tree):

@@ -274,6 +274,59 @@ def watchtower_retrain_trigger() -> bool:
 
 
 # --------------------------------------------------------------------------
+# Spyglass: deep observability (telemetry/)
+# --------------------------------------------------------------------------
+
+def spyglass_enabled() -> bool:
+    """``SPYGLASS_ENABLED=0`` turns off the request-path stage decomposition
+    and flight recorder (the compile sentinel stays wherever it was
+    installed). Default on — the bench-bounded overhead is the price of
+    being able to see the serving path at all."""
+    return env_flag("SPYGLASS_ENABLED") is not False
+
+
+def flightrecorder_capacity() -> int:
+    """Ring capacity of the in-memory flight recorder; 0 disables it."""
+    return _get_int("FLIGHTRECORDER_CAPACITY", 512)
+
+
+def admin_token() -> str:
+    """Shared secret for the ``/admin/*`` surface (reload, profile). When
+    set, requests must carry it as ``Authorization: Bearer <token>`` or
+    ``X-Admin-Token``; empty (default) leaves admin endpoints open —
+    loopback/dev only, like FRAUD_STORE_TOKEN."""
+    return _get("ADMIN_TOKEN", "")
+
+
+def device_profile_dir() -> str:
+    """Where ``POST /admin/profile`` writes trace captures."""
+    return _get("DEVICE_PROFILE_DIR", "device_traces")
+
+
+def device_profile_default_s() -> float:
+    return _get_float("DEVICE_PROFILE_DEFAULT_S", 5.0)
+
+
+def device_profile_max_s() -> float:
+    """Hard ceiling on one on-demand capture window — a forgotten profile
+    must not trace the device for hours."""
+    return _get_float("DEVICE_PROFILE_MAX_S", 60.0)
+
+
+def recompile_storm_window_s() -> float:
+    """Sliding window of the compile sentinel's jump detector."""
+    return _get_float("RECOMPILE_STORM_WINDOW_S", 600.0)
+
+
+def recompile_storm_threshold() -> int:
+    """Unexpected compiles within the window that flag a storm. The
+    default (8) sits above any legitimate first-touch compile burst (a
+    single cold jit costs ~3 backend compiles) while a per-request-shape
+    recompile bug crosses it within a handful of requests."""
+    return _get_int("RECOMPILE_STORM_THRESHOLD", 8)
+
+
+# --------------------------------------------------------------------------
 # Conductor: closed-loop retrain → challenger gate → promotion (lifecycle/)
 # --------------------------------------------------------------------------
 

@@ -64,16 +64,21 @@ class ModelSlot:
 def warm_scorer(scorer, max_batch: int | None = None) -> None:
     """Pre-compile the bucket ladder for a freshly loaded model so the swap
     pause is a pointer write, not an XLA compile (same ladder the
-    micro-batcher warms at startup)."""
+    micro-batcher warms at startup). Marked expected for the compile
+    sentinel — a reload's ladder is not a RecompileStorm."""
     from fraud_detection_tpu.ops.scorer import _bucket
+    from fraud_detection_tpu.telemetry.compile_sentinel import (
+        expected_compiles,
+    )
 
     max_batch = max_batch or config.scorer_max_batch()
     d = scorer.n_features
     b = scorer.min_bucket
     top = _bucket(max_batch, b)
-    while b <= top:
-        scorer.predict_proba(np.zeros((b, d), np.float32))
-        b *= 2
+    with expected_compiles():
+        while b <= top:
+            scorer.predict_proba(np.zeros((b, d), np.float32))
+            b *= 2
 
 
 class ModelReloader:

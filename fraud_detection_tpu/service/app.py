@@ -17,9 +17,17 @@ Endpoint-for-endpoint rebuild of the reference's FastAPI app (api/app.py):
   reference scores blind — SURVEY.md §5)
 - ``POST /monitor/feedback`` — delayed fraud-label feedback for the
   watchtower's windowed-calibration (ECE) monitoring
+- ``GET /debug/flightrecorder`` — the spyglass ring of the last N scored
+  requests (stage timelines, batch/bucket, model version, drift flag)
+- ``POST /admin/profile`` — duration-bounded, single-flight on-demand
+  device trace of the live service (auth-gated like ``/admin/reload``)
 
 Middleware: per-request correlation ID propagated to the response header,
-logs, and the task args (api/app.py:121-128, 244-245).
+logs, and the task args (api/app.py:121-128, 244-245). Each scored request
+carries a telemetry RequestTimeline through the micro-batcher; its six
+stages export as histograms + OTEL child spans under ``predict``, and the
+request's traceparent rides the task args so the worker's ``compute_shap``
+span links back (docs/OBSERVABILITY.md).
 
 Differences from the reference, by design:
 - the scorer is the scaler-folded jitted XLA program behind an async
@@ -54,6 +62,13 @@ from fraud_detection_tpu.service.schemas import (
 )
 from fraud_detection_tpu.service.taskq import Broker
 from fraud_detection_tpu.service.tracing import setup_tracing, span
+from fraud_detection_tpu.service import tracing
+from fraud_detection_tpu.telemetry import (
+    FlightRecorder,
+    RequestTimeline,
+    compile_sentinel,
+)
+from fraud_detection_tpu.telemetry import devicemem
 
 log = logging.getLogger("fraud_detection_tpu.api")
 
@@ -115,9 +130,32 @@ def create_app(
         "slot": None,
         "reloader": None,
         "lifecycle_store": None,
+        "flightrecorder": None,
+        "profiler": None,
         "started_at": None,
     }
     app.state = state  # exposed for tests/embedding
+
+    def _require_admin(req: Request) -> None:
+        """Admin surface gate (``/admin/reload``, ``/admin/profile``): when
+        ADMIN_TOKEN is set, the request must carry it; empty token leaves
+        admin open (loopback/dev), mirroring FRAUD_STORE_TOKEN."""
+        token = config.admin_token()
+        if not token:
+            return
+        supplied = req.headers.get("x-admin-token")
+        if supplied is None:
+            auth = req.headers.get("authorization", "")
+            if auth.lower().startswith("bearer "):
+                supplied = auth[7:].strip()
+        import hmac
+
+        # bytes, not str: compare_digest raises on non-ASCII str input,
+        # which would turn a garbled token header into a 500
+        if supplied is None or not hmac.compare_digest(
+            supplied.encode(), token.encode()
+        ):
+            raise HTTPError(401, "admin token required")
 
     def _model():
         # The slot is the single swappable reference (lifecycle/swap.py);
@@ -146,6 +184,19 @@ def create_app(
     async def startup():
         state["started_at"] = time.time()
         setup_tracing()
+        # Spyglass: the compile sentinel wraps the jitted entrypoints BEFORE
+        # any model/scorer is constructed (GBTBatchScorer binds its predict
+        # fn at init); the flight recorder rides the micro-batcher.
+        compile_sentinel.install()
+        cap = config.flightrecorder_capacity()
+        state["flightrecorder"] = (
+            FlightRecorder(cap)
+            if cap > 0 and config.spyglass_enabled()
+            else None
+        )
+        from fraud_detection_tpu.telemetry.profiler import DeviceProfiler
+
+        state["profiler"] = DeviceProfiler()
         state["db"] = ResultsDB(database_url)
         state["broker"] = Broker(broker_url)
         try:
@@ -195,7 +246,9 @@ def create_app(
                 state["slot"].version or 0
             )
             batcher = MicroBatcher(
-                slot=state["slot"], watchtower=state["watchtower"]
+                slot=state["slot"],
+                watchtower=state["watchtower"],
+                recorder=state["flightrecorder"],
             )
             await batcher.start()  # warms the bucket ladder; can raise
             state["batcher"] = batcher
@@ -291,9 +344,21 @@ def create_app(
         except ValueError as e:
             raise HTTPError(422, str(e)) from e
 
+        timeline = (
+            RequestTimeline(correlation_id=corr_id)
+            if state["batcher"].telemetry
+            else None
+        )
         with span("predict", correlation_id=corr_id):
             with metrics.timed(metrics.inference_duration):
-                score = await state["batcher"].score(row)
+                score = await state["batcher"].score(row, timeline=timeline)
+            if timeline is not None:
+                # re-emit the stage decomposition as child spans of this
+                # predict span (explicit timestamps from the timeline)
+                tracing.emit_stage_spans(timeline)
+            # serialize the trace context NOW (inside the span) — it rides
+            # the task args so the worker's compute_shap span links back
+            traceparent = tracing.current_traceparent()
         prediction = int(score >= 0.5)
 
         # Persist the PENDING row and enqueue the async explanation.
@@ -307,7 +372,9 @@ def create_app(
             with metrics.timed(metrics.db_latency):
                 state["db"].create_pending(tx_id, feature_dict, corr_id)
             state["broker"].send_task(
-                TASK_NAME, [tx_id, feature_dict, corr_id], correlation_id=corr_id
+                TASK_NAME,
+                [tx_id, feature_dict, corr_id, traceparent],
+                correlation_id=corr_id,
             )
 
         try:
@@ -472,11 +539,60 @@ def create_app(
 
         return Response(await asyncio.to_thread(_read))
 
+    @app.get("/debug/flightrecorder")
+    async def flightrecorder(req: Request) -> Response:
+        """Spyglass flight recorder dump: the last N scored requests with
+        their full stage timelines — the post-incident first stop
+        (docs/OBSERVABILITY.md explains how to read one)."""
+        rec = state["flightrecorder"]
+        if rec is None:
+            return Response(
+                {"enabled": False, "records": [],
+                 "hint": "FLIGHTRECORDER_CAPACITY=0 or SPYGLASS_ENABLED=0"}
+            )
+        return Response(
+            {
+                "enabled": True,
+                "capacity": rec.capacity,
+                "total_recorded": rec.total_recorded,
+                "records": rec.dump(),
+            }
+        )
+
+    @app.post("/admin/profile")
+    async def admin_profile(req: Request) -> Response:
+        """On-demand device trace of the live service: capture everything
+        the device executes for ``duration_s`` seconds (bounded by
+        DEVICE_PROFILE_MAX_S, single-flight) and return the trace path.
+        Auth-gated like /admin/reload (ADMIN_TOKEN)."""
+        _require_admin(req)
+        profiler = state["profiler"]
+        if profiler is None:
+            raise HTTPError(503, "profiler unavailable")
+        body = req.json() if req.body else {}
+        if body is None:
+            body = {}
+        if not isinstance(body, dict):
+            raise HTTPError(422, "body must be a JSON object")
+        duration = body.get("duration_s")
+        from fraud_detection_tpu.telemetry.profiler import ProfileBusy
+
+        try:
+            # capture() blocks for the whole window — off-loop, so scoring
+            # (the thing being profiled) keeps flowing
+            result = await asyncio.to_thread(profiler.capture, duration)
+        except ProfileBusy as e:
+            raise HTTPError(409, str(e)) from e
+        except (TypeError, ValueError) as e:
+            raise HTTPError(422, str(e)) from e
+        return Response(result)
+
     @app.post("/admin/reload")
     async def admin_reload(req: Request) -> Response:
         """Force one registry alias sweep NOW (the poll-independent half of
         hot swap): flips @prod/@shadow are loaded, warmed, and swapped in
-        before the response returns."""
+        before the response returns. Auth-gated by ADMIN_TOKEN when set."""
+        _require_admin(req)
         reloader = state["reloader"]
         if reloader is None:
             raise HTTPError(503, "no reloader — model not loaded")
@@ -503,6 +619,18 @@ def create_app(
                 metrics.queue_depth.set(state["broker"].depth())
             except Exception:  # scrape must not fail on a down broker
                 log.debug("queue depth refresh failed", exc_info=True)
+        # Spyglass scrape-time refreshes: device-memory watermark gauges
+        # (memory_stats can be an RPC on tunneled backends — pay it per
+        # scrape, not per request) and the recompile-storm windows (so a
+        # storm clears once its window drains even with no new compiles).
+        def _telemetry_refresh():
+            devicemem.refresh()
+            compile_sentinel.refresh_storm_gauges()
+
+        try:
+            await asyncio.to_thread(_telemetry_refresh)
+        except Exception:
+            log.debug("telemetry gauge refresh failed", exc_info=True)
         return Response(
             metrics.render(), media_type=metrics.CONTENT_TYPE_LATEST
         )

@@ -86,8 +86,9 @@ Middleware = Callable[[Request, Handler], Awaitable[Response]]
 _PARAM_RE = re.compile(r"\{(\w+)\}")
 
 _STATUS_PHRASES = {
-    200: "OK", 202: "Accepted", 400: "Bad Request", 404: "Not Found",
-    405: "Method Not Allowed", 422: "Unprocessable Entity",
+    200: "OK", 202: "Accepted", 400: "Bad Request", 401: "Unauthorized",
+    403: "Forbidden", 404: "Not Found", 405: "Method Not Allowed",
+    409: "Conflict", 422: "Unprocessable Entity", 429: "Too Many Requests",
     500: "Internal Server Error", 503: "Service Unavailable",
 }
 

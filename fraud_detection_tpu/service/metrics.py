@@ -161,6 +161,69 @@ retrain_requests = Counter(
     registry=registry,
 )
 
+# Spyglass: request-path latency decomposition + XLA compile sentinel +
+# device watermarks (telemetry/). The request_stage_*/xla_* names are the
+# alerting contract for monitoring/prometheus/rules/telemetry-alerts.yml
+# and the Grafana latency-waterfall row.
+request_stage_duration = Histogram(
+    "request_stage_duration_seconds",
+    "Per-stage latency of a scored request inside the micro-batcher "
+    "(enqueue/flush_wait/pad_bucket/device_compute/d2h/respond)",
+    ["stage"],
+    buckets=(
+        5e-05, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+        0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    ),
+    registry=registry,
+)
+xla_compiles = Counter(
+    "xla_compiles",
+    "XLA executable-cache misses per instrumented jitted entrypoint "
+    "(_unattributed = backend compiles outside any instrumented call)",
+    ["entrypoint"],
+    registry=registry,
+)
+xla_compile_duration = Histogram(
+    "xla_compile_duration_seconds",
+    "Backend compile time attributed to the instrumented entrypoint",
+    ["entrypoint"],
+    buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 15, 30, 60, 120),
+    registry=registry,
+)
+xla_recompile_storm = Gauge(
+    "xla_recompile_storm",
+    "1 while an entrypoint's unexpected-compile rate exceeds the storm "
+    "threshold (RecompileStorm alert input; warmups never count)",
+    ["entrypoint"],
+    registry=registry,
+)
+device_memory_bytes_in_use = Gauge(
+    "device_memory_bytes_in_use",
+    "Accelerator memory in use, summed over local devices (0 when the "
+    "backend reports no memory stats)",
+    registry=registry,
+)
+device_memory_bytes_limit = Gauge(
+    "device_memory_bytes_limit",
+    "Accelerator memory capacity, summed over local devices",
+    registry=registry,
+)
+device_memory_peak_bytes_in_use = Gauge(
+    "device_memory_peak_bytes_in_use",
+    "High-water mark of accelerator memory in use",
+    registry=registry,
+)
+device_profiles = Counter(
+    "device_profiles",
+    "On-demand device trace captures completed (POST /admin/profile)",
+    registry=registry,
+)
+device_profile_active = Gauge(
+    "device_profile_active",
+    "1 while an on-demand device trace capture is running",
+    registry=registry,
+)
+
 # Conductor: closed-loop retrain → gate → promotion (lifecycle/). The
 # lifecycle_* names are the alerting contract for
 # monitoring/prometheus/rules/lifecycle-alerts.yml.

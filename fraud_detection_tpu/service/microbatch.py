@@ -14,21 +14,41 @@ scored concurrently (executor threads; JAX dispatch is thread-safe), so on a
 high-RTT link (a tunneled chip) transfers pipeline instead of serializing —
 the device still runs batches back-to-back. Knobs from config
 (``SCORER_MAX_BATCH``, ``SCORER_MAX_WAIT_MS``).
+
+Spyglass (telemetry/): with telemetry on (default), each flush runs the
+decomposed scoring path — host pad/encode, device dispatch fenced with ONE
+``block_until_ready`` per flush, then the d2h fetch — and stamps any
+:class:`~fraud_detection_tpu.telemetry.timeline.RequestTimeline` riding the
+queue items. Stage durations export as
+``request_stage_duration_seconds{stage}`` histograms (row-level stages per
+row, flush-level stages once per flush) and completed timelines land in the
+flight recorder for ``GET /debug/flightrecorder``. ``SPYGLASS_ENABLED=0``
+(or ``telemetry=False``) restores the opaque single-call path — no fence,
+no stamps. Overhead with everything on is bench-bounded ≤5% of the flush
+path (``bench.py`` ``telemetry`` section).
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 import numpy as np
 
 from fraud_detection_tpu import config
-from fraud_detection_tpu.ops.scorer import BatchScorer
-from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.ops.scorer import BatchScorer, _bucket
+from fraud_detection_tpu.service import metrics, tracing
+from fraud_detection_tpu.telemetry.timeline import STAGES, FlushInfo
 from fraud_detection_tpu.utils.profiling import annotate
 
 log = logging.getLogger("fraud_detection_tpu.microbatch")
+
+# Bound stage observers, resolved once: Histogram.labels() costs ~0.6µs a
+# lookup — per-flush that's real money on the ≤5% telemetry budget.
+_OBSERVE_STAGE = {
+    s: metrics.request_stage_duration.labels(s).observe for s in STAGES
+}
 
 
 class MicroBatcher:
@@ -40,6 +60,8 @@ class MicroBatcher:
         max_inflight: int | None = None,
         watchtower=None,
         slot=None,
+        recorder=None,
+        telemetry: bool | None = None,
     ):
         # Either a fixed scorer (offline tools, tests) or a lifecycle
         # ModelSlot (serving): with a slot, every flush re-reads the slot's
@@ -53,11 +75,17 @@ class MicroBatcher:
         # non-blocking observe() after the waiters resolve — drift/shadow
         # monitoring rides the batch boundary, zero per-row host work.
         self.watchtower = watchtower
+        # Optional telemetry.FlightRecorder: completed request timelines
+        # land here (lock-light ring; /debug/flightrecorder reads it).
+        self.recorder = recorder
+        self.telemetry = (
+            telemetry if telemetry is not None else config.spyglass_enabled()
+        )
         self.max_batch = max_batch or config.scorer_max_batch()
         self.max_wait = (
             max_wait_ms if max_wait_ms is not None else config.scorer_max_wait_ms()
         ) / 1000.0
-        self._queue: asyncio.Queue[tuple[np.ndarray, asyncio.Future]] = asyncio.Queue()
+        self._queue: asyncio.Queue[tuple] = asyncio.Queue()
         self._collector: asyncio.Task | None = None
         self._starting = False
         self._inflight = asyncio.Semaphore(
@@ -77,14 +105,20 @@ class MicroBatcher:
             # of seconds on a remote-tunneled chip), and with pipelined
             # flushes several shapes would compile concurrently. Warm the
             # bucket a full batch actually pads to, not max_batch itself
-            # (which may not be a power of two).
-            from fraud_detection_tpu.ops.scorer import _bucket
-
-            await asyncio.get_running_loop().run_in_executor(
-                None,
-                self.scorer.warmup,
-                _bucket(self.max_batch, self.scorer.min_bucket),
+            # (which may not be a power of two). The warmup runs under the
+            # compile sentinel's expected-compiles mark so the deploy-time
+            # ladder can't trip the RecompileStorm detector.
+            from fraud_detection_tpu.telemetry.compile_sentinel import (
+                expected_compiles,
             )
+
+            def _warm() -> None:
+                with expected_compiles():
+                    self.scorer.warmup(
+                        _bucket(self.max_batch, self.scorer.min_bucket)
+                    )
+
+            await asyncio.get_running_loop().run_in_executor(None, _warm)
             self._collector = asyncio.create_task(self._run())
         finally:
             self._starting = False
@@ -102,22 +136,33 @@ class MicroBatcher:
             await asyncio.gather(*self._flushes, return_exceptions=True)
         # Fail anything still enqueued so no request awaits forever.
         while not self._queue.empty():
-            _, fut = self._queue.get_nowait()
+            _, fut, _ = self._queue.get_nowait()
             if not fut.done():
                 fut.set_exception(RuntimeError("scorer shutting down"))
 
-    async def score(self, row: np.ndarray) -> float:
-        """Submit one feature row; returns P(fraud)."""
+    async def score(self, row: np.ndarray, timeline=None) -> float:
+        """Submit one feature row; returns P(fraud). ``timeline`` (a
+        RequestTimeline) rides along and is stamped at every stage
+        boundary — pass one to get the request into the stage histograms,
+        child spans, and the flight recorder."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((row, fut))
+        await self._queue.put((row, fut, timeline))
         return await fut
 
+    @staticmethod
+    def _stamp_collected(item: tuple) -> tuple:
+        tl = item[2]
+        if tl is not None:
+            tl.t_collected = time.perf_counter()
+        return item
+
     async def _run(self) -> None:
-        batch: list[tuple[np.ndarray, asyncio.Future]] = []
+        batch: list[tuple] = []
         loop = asyncio.get_running_loop()
+        stamp = self._stamp_collected
         try:
             while True:
-                batch = [await self._queue.get()]
+                batch = [stamp(await self._queue.get())]
                 # Collect more rows until the window closes or the batch
                 # fills. Greedy drain first: under load the queue already
                 # holds rows, and one timer-armed wait_for PER ROW (a Task +
@@ -127,7 +172,7 @@ class MicroBatcher:
                 while len(batch) < self.max_batch:
                     try:
                         while len(batch) < self.max_batch:
-                            batch.append(self._queue.get_nowait())
+                            batch.append(stamp(self._queue.get_nowait()))
                         break
                     except asyncio.QueueEmpty:
                         pass
@@ -136,7 +181,7 @@ class MicroBatcher:
                         break
                     try:
                         batch.append(
-                            await asyncio.wait_for(self._queue.get(), timeout)
+                            stamp(await asyncio.wait_for(self._queue.get(), timeout))
                         )
                     except asyncio.TimeoutError:
                         break
@@ -152,50 +197,112 @@ class MicroBatcher:
         except asyncio.CancelledError:
             # Cancellation mid-collection: fail the partial batch so its
             # waiters don't hang, then propagate.
-            for _, f in batch:
+            for _, f, _ in batch:
                 if not f.done():
                     f.set_exception(RuntimeError("scorer shutting down"))
             raise
 
-    async def _flush_one(
-        self, batch: list[tuple[np.ndarray, asyncio.Future]]
-    ) -> None:
+    async def _flush_one(self, batch: list[tuple]) -> None:
         try:
             await self._flush(batch)
         finally:
             self._inflight.release()
 
-    async def _flush(self, batch: list[tuple[np.ndarray, asyncio.Future]]) -> None:
+    def _score_decomposed(
+        self, scorer, rows: np.ndarray
+    ) -> tuple[np.ndarray, float, float, float, float]:
+        """The flush's device call, decomposed for the stage timeline:
+        host pad/encode → dispatch fenced with ONE ``block_until_ready``
+        per flush (never per row) → d2h fetch. Returns
+        (probs, t_flush_start, t_padded, t_synced, t_fetched).
+
+        Note: on tunneled PJRT platforms ``block_until_ready`` can report
+        early (see bench.py `_window_barrier`); there the residue shows up
+        in the d2h stage — the *sum* device_compute + d2h is always honest.
+        """
+        import jax.numpy as jnp
+
+        n = rows.shape[0]
+        with annotate("microbatch-score"):
+            t_flush_start = time.perf_counter()
+            hx = scorer._prepare_host(scorer._pad(rows))
+            t_padded = time.perf_counter()
+            out = scorer._score_padded(jnp.asarray(hx))
+            out.block_until_ready()
+            t_synced = time.perf_counter()
+            probs = np.asarray(out, dtype=np.float32)[:n]
+            t_fetched = time.perf_counter()
+        return probs, t_flush_start, t_padded, t_synced, t_fetched
+
+    async def _flush(self, batch: list[tuple]) -> None:
+        telemetry = self.telemetry
         try:
             # Everything that can fail stays inside this try — a raise
             # before the waiters are resolved (e.g. np.stack on a
             # mixed-shape batch) would otherwise leave clients awaiting
             # forever inside a detached task.
-            rows = np.stack([r for r, _ in batch])
+            rows = np.stack([r for r, _, _ in batch])
             metrics.microbatch_size.observe(len(batch))
             # ONE slot read per flush: the scorer is pinned for this batch
             # even if a promotion swaps the slot mid-dispatch.
-            scorer = (
-                self.slot.model.scorer if self.slot is not None else self.scorer
-            )
+            if self.slot is not None:
+                model, source, version = self.slot.get()
+                scorer = model.scorer
+            else:
+                scorer, source, version = self.scorer, None, None
             # The device call is synchronous-but-fast; run it in the default
             # executor so the event loop keeps accepting requests while XLA
-            # executes. annotate() is free when no device_trace is active.
-            def _score() -> np.ndarray:
-                with annotate("microbatch-score"):
-                    return scorer.predict_proba(rows)
+            # executes. annotate() is free when no trace is active.
+            if telemetry and hasattr(scorer, "_score_padded"):
+                probs, t_flush, t_padded, t_synced, t_fetched = (
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._score_decomposed, scorer, rows
+                    )
+                )
+            else:
+                def _score() -> np.ndarray:
+                    with annotate("microbatch-score"):
+                        return scorer.predict_proba(rows)
 
-            probs = await asyncio.get_running_loop().run_in_executor(
-                None, _score
-            )
+                probs = await asyncio.get_running_loop().run_in_executor(
+                    None, _score
+                )
+                telemetry = False
         except Exception as e:  # resolve all waiters with the failure
-            for _, f in batch:
+            for _, f, _ in batch:
                 if not f.done():
                     f.set_exception(e)
             return
-        for (_, f), p in zip(batch, probs):
-            if not f.done():
-                f.set_result(float(p))
+        fi = None
+        if telemetry:
+            n = len(batch)
+            try:
+                drift = bool(metrics.watchtower_drift_detected._value.get())
+            except Exception:  # graftcheck: ignore[silent-except] — private gauge attr probe; absence just means "no drift info"
+                drift = False
+            fi = FlushInfo(
+                t_flush_start=t_flush, t_padded=t_padded, t_synced=t_synced,
+                t_fetched=t_fetched, batch_size=n,
+                bucket=_bucket(n, scorer.min_bucket),
+                model_version=version, model_source=source, drift=drift,
+            )
+        if fi is not None and tracing._tracer is not None:
+            # Link rows to the flush ONLY when a tracer will read the
+            # timelines back (emit_stage_spans): one ref per row is ~60ns
+            # and the telemetry budget lives and dies on this loop — the
+            # flight recorder gets the FlushInfo through its entry instead.
+            for (_, f, tl), p in zip(batch, probs):
+                if not f.done():
+                    f.set_result(float(p))
+                if tl is not None:
+                    tl.flush = fi
+        else:
+            for (_, f, _), p in zip(batch, probs):
+                if not f.done():
+                    f.set_result(float(p))
+        if fi is not None:
+            fi.t_resolved = time.perf_counter()
+            self._export_flush(fi, batch)
         if self.watchtower is not None:
             # Waiters are already resolved; observe() only enqueues onto the
             # watchtower's own ingest thread (bounded, drop-under-pressure),
@@ -204,3 +311,57 @@ class MicroBatcher:
                 self.watchtower.observe(rows, probs)
             except Exception:
                 log.debug("watchtower observe failed", exc_info=True)
+
+    #: at most this many (+1: the last row always observes) per-row
+    #: histogram observations per flush for the
+    #: row-level stages (enqueue/flush_wait): a prometheus observe costs
+    #: ~0.7µs, so observing all 1024 rows of a big flush would alone blow
+    #: the ≤5% overhead bound. Rows are sampled evenly across the batch
+    #: (first and last included), which preserves the within-flush spread;
+    #: every flush still contributes, so the histograms stay unbiased
+    #: across flushes. Timelines + flight-recorder records stay exact for
+    #: EVERY row — sampling applies only to the histogram export.
+    ROW_STAGE_SAMPLES = 8
+
+    def _export_flush(self, fi: FlushInfo, batch) -> None:
+        """Per-flush stage export + flight-recorder append. Runs after the
+        waiters resolved — everything here is off the response's critical
+        path except its share of the flush task (bench-bounded ≤5%)."""
+        obs = _OBSERVE_STAGE
+        # flush-level stages: one observation per flush (every row shares
+        # the same device work)
+        obs["pad_bucket"](max(0.0, fi.t_padded - fi.t_flush_start))
+        obs["device_compute"](max(0.0, fi.t_synced - fi.t_padded))
+        obs["d2h"](max(0.0, fi.t_fetched - fi.t_synced))
+        obs["respond"](max(0.0, fi.t_resolved - fi.t_fetched))
+        # row-level stages: sampled (see ROW_STAGE_SAMPLES) — only the
+        # sampled rows are even touched
+        n = len(batch)
+        observe_enqueue = obs["enqueue"]
+        observe_flush_wait = obs["flush_wait"]
+        # ceil division keeps the sample count ≤ ROW_STAGE_SAMPLES (+1 for
+        # the explicit last row — the longest-waiting tail must be in the
+        # enqueue histogram, not systematically excluded)
+        step = -(-n // self.ROW_STAGE_SAMPLES)
+        last = n - 1
+        for i in range(0, n, step):
+            tl = batch[i][2]
+            if tl is not None:
+                observe_enqueue(max(0.0, tl.t_collected - tl.t_enqueued))
+                observe_flush_wait(
+                    max(0.0, fi.t_flush_start - tl.t_collected)
+                )
+        if last % step:
+            tl = batch[last][2]
+            if tl is not None:
+                observe_enqueue(max(0.0, tl.t_collected - tl.t_enqueued))
+                observe_flush_wait(
+                    max(0.0, fi.t_flush_start - tl.t_collected)
+                )
+        if self.recorder is not None:
+            try:
+                # the batch list goes in AS-IS (no per-row scan here);
+                # timelines are extracted at dump time
+                self.recorder.record_flush_batch(fi, batch)
+            except Exception:
+                log.debug("flight recorder append failed", exc_info=True)

@@ -108,6 +108,13 @@ class SqliteBroker:
         ``task_id`` may be supplied by the caller (network clients generate
         it client-side so an ambiguous retry — connection lost between send
         and response — lands on DO NOTHING instead of enqueuing a duplicate).
+
+        ``args`` is an opaque JSON list; by convention (spyglass trace
+        propagation, docs/OBSERVABILITY.md) ``xai_tasks.compute_shap``
+        producers append the originating request's W3C ``traceparent``
+        string as a 4th element so the worker's span links to the request's
+        trace — consumers treat it as optional, so 3-arg tasks from older
+        producers stay compatible across all broker backends.
         """
         task_id = task_id or uuid.uuid4().hex
         now = time.time()

@@ -41,6 +41,7 @@ from fraud_detection_tpu.service.errors import (
 from fraud_detection_tpu.service.loading import load_production_model
 from fraud_detection_tpu.service.taskq import Broker, Task
 from fraud_detection_tpu.service.tracing import setup_tracing, span
+from fraud_detection_tpu.telemetry import devicemem
 
 log = logging.getLogger("fraud_detection_tpu.worker")
 
@@ -73,9 +74,21 @@ class XaiWorker:
 
     # -- task bodies -------------------------------------------------------
     def compute_shap(
-        self, transaction_id: str, input_data: dict, correlation_id: str | None
+        self,
+        transaction_id: str,
+        input_data: dict,
+        correlation_id: str | None,
+        traceparent: str | None = None,
     ) -> None:
-        with span("compute_shap", correlation_id=correlation_id or ""):
+        # ``traceparent`` is the optional 4th task arg (W3C header string
+        # captured inside the API's predict span): it links this worker
+        # span to the originating request's trace. Tasks enqueued by older
+        # producers carry 3 args and still work.
+        with span(
+            "compute_shap",
+            traceparent=traceparent,
+            correlation_id=correlation_id or "",
+        ):
             row = self.model.prepare_row(input_data)
             score = float(self.model.scorer.predict_proba(row[None, :])[0])
             phi, expected_value = self.model.explain_one(row)
@@ -229,9 +242,13 @@ class XaiWorker:
             return outcome
         names = self.model.feature_names
         for (t, _), score, phi in zip(prepared, scores, phis):
-            tx_id, _, corr_id = (t.args + [None, None, None])[:3]
+            tx_id, _, corr_id, traceparent = (t.args + [None] * 4)[:4]
             try:
-                with span("compute_shap", correlation_id=corr_id or ""):
+                with span(
+                    "compute_shap",
+                    traceparent=traceparent,
+                    correlation_id=corr_id or "",
+                ):
                     self.db.complete(
                         tx_id,
                         dict(zip(names, phi.astype(float))),
@@ -328,17 +345,23 @@ class XaiWorker:
     def warmup(self) -> None:
         """Pre-compile the scorer + explainer bucket ladders up to max_batch
         so the first claimed batch doesn't stall on XLA compiles (run by
-        run_forever before consuming; tests drive run_once/run_batch cold)."""
+        run_forever before consuming; tests drive run_once/run_batch cold).
+        Runs under the compile sentinel's expected-compiles mark — a
+        deploy's ladder warmup must never read as a RecompileStorm."""
         from fraud_detection_tpu.ops.scorer import _bucket
+        from fraud_detection_tpu.telemetry.compile_sentinel import (
+            expected_compiles,
+        )
 
         d = len(self.model.feature_names)
         b = self.model.scorer.min_bucket
         top = _bucket(self.max_batch, b)
-        while b <= top:
-            zeros = np.zeros((b, d), np.float32)
-            self.model.scorer.predict_proba(zeros)
-            self.model.explain_batch(zeros)
-            b *= 2
+        with expected_compiles():
+            while b <= top:
+                zeros = np.zeros((b, d), np.float32)
+                self.model.scorer.predict_proba(zeros)
+                self.model.explain_batch(zeros)
+                b *= 2
 
     def run_forever(self, max_batch: int | None = None) -> None:
         if max_batch:
@@ -355,6 +378,9 @@ class XaiWorker:
             # correct response is to back off and poll again.
             try:
                 metrics.queue_depth.set(self.broker.depth())
+                # device-memory watermark for the worker's :8001 exposition
+                # (the API refreshes at scrape; workers have no scrape hook)
+                devicemem.maybe_refresh()
                 handled = self.run_batch(max_batch)
             except StoreAuthError:
                 raise  # misconfigured credentials: crash loudly, don't spin
@@ -388,7 +414,15 @@ def main():
     )
     args = ap.parse_args()
 
-    setup_tracing(service_name="fraud-xai-worker")
+    # force=True: a failed/endpoint-less setup earlier in this process (an
+    # imported module initializing tracing before env was ready) must not
+    # latch tracing off for the worker's lifetime.
+    setup_tracing(service_name="fraud-xai-worker", force=True)
+    # compile sentinel BEFORE the model loads (scorers bind jitted fns at
+    # construction): SHAP/scorer recompiles on the worker count too.
+    from fraud_detection_tpu.telemetry import compile_sentinel
+
+    compile_sentinel.install()
     if args.metrics_port:
         from prometheus_client import start_http_server
 

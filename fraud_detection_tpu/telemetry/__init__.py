@@ -1,0 +1,46 @@
+"""spyglass: deep observability for the TPU serving path.
+
+The service shell's OTEL/Prometheus wiring (service/metrics, service/tracing)
+mirrors the reference's — and stops where the reference stopped: one opaque
+``api_inference_duration_seconds`` observation per request, and nothing at
+all about XLA. On TPU the time that matters lives *below* that number: queue
+wait in the micro-batcher, batch formation, bucket padding, the device
+dispatch itself, the d2h readback — and, catastrophically, recompiles (PR 3
+shipped a compile-once-per-slice-length bug that one counter would have
+paged on immediately). ``telemetry/`` is the layer that makes those visible:
+
+- :mod:`.timeline` — per-request ``RequestTimeline`` carried through the
+  micro-batcher; six stages (enqueue → flush_wait → pad_bucket →
+  device_compute → d2h → respond) exported as per-stage Prometheus
+  histograms and OTEL child spans under the ``predict`` span;
+- :mod:`.compile_sentinel` — wraps the registered jitted entrypoints so
+  every XLA cache miss is counted per entrypoint
+  (``xla_compiles_total{entrypoint}``) with real backend-compile durations,
+  plus a jump detector that raises ``xla_recompile_storm`` (the
+  RecompileStorm alert input);
+- :mod:`.flightrecorder` — an always-on, lock-light ring of the last N
+  request records for ``GET /debug/flightrecorder`` post-incident forensics;
+- :mod:`.profiler` — duration-bounded, single-flight on-demand device
+  tracing for ``POST /admin/profile``;
+- :mod:`.devicemem` — device-memory watermark gauges refreshed at scrape
+  time.
+
+Everything degrades to near-zero cost when disabled (``SPYGLASS_ENABLED=0``)
+and the hot-path overhead with everything on is bench-bounded (``bench.py``
+``telemetry`` section, ≤5% on the micro-batch flush path).
+"""
+
+from fraud_detection_tpu.telemetry.compile_sentinel import (  # noqa: F401
+    expected_compiles,
+    install,
+    instrument,
+    refresh_storm_gauges,
+    uninstall,
+)
+from fraud_detection_tpu.telemetry.flightrecorder import (  # noqa: F401
+    FlightRecorder,
+)
+from fraud_detection_tpu.telemetry.timeline import (  # noqa: F401
+    STAGES,
+    RequestTimeline,
+)

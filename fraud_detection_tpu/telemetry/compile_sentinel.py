@@ -1,0 +1,280 @@
+"""Compile sentinel: per-entrypoint XLA recompile counting + storm detection.
+
+``jit`` recompiling per request shape is the single worst latency failure
+mode on TPU — a cold compile costs seconds-to-tens-of-seconds and stalls
+every request behind it — and it is *invisible* to request-level metrics:
+the time just shows up as a fat tail. PR 3's gate compiled once per eval
+slice *length* and nothing paged; this module is the mechanical detector
+that bug demanded.
+
+Two layers:
+
+- :func:`instrument` wraps one jitted callable. Cache misses are detected
+  exactly via the jitted function's own executable cache
+  (``fn._cache_size()`` before/after each call) and exported as
+  ``xla_compiles_total{entrypoint}``; the *real* backend-compile time is
+  attributed to the entrypoint via a ``jax.monitoring`` duration listener
+  (events fire in the calling thread) and exported as
+  ``xla_compile_duration_seconds{entrypoint}``. The wrapper is transparent
+  to tracing/``jax.eval_shape`` — the virtual-mesh verifier proves this
+  (``telemetry.instrumented_score`` in analysis/meshcheck.py) — and costs
+  two host calls + a few attribute reads per invocation on the hit path.
+- a **jump detector**: every unexpected compile lands in a per-entrypoint
+  sliding window; when a window holds ``RECOMPILE_STORM_THRESHOLD`` compiles
+  within ``RECOMPILE_STORM_WINDOW_S`` seconds the
+  ``xla_recompile_storm{entrypoint}`` gauge latches 1 (and clears as the
+  window drains — :func:`refresh_storm_gauges` is called at scrape time).
+  The RecompileStorm alert (monitoring/prometheus/rules/telemetry-alerts.yml)
+  ANDs this gauge with an ``increase(xla_compiles_total[...])`` clause so
+  deploy-time warmups — which run under :func:`expected_compiles` and never
+  feed the detector — cannot page.
+
+:func:`install` instruments the registered serving/worker entrypoints in
+place (scorer kernels, drift window update, lifecycle gate, linear/tree
+SHAP, GBT forest scoring). Call it once at service startup, *before* models
+are constructed (``GBTBatchScorer`` binds ``gbt_predict_proba`` at init).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+import time
+from collections import deque
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.service import metrics
+
+log = logging.getLogger("fraud_detection_tpu.telemetry")
+
+_local = threading.local()
+
+_storm_lock = threading.Lock()
+_storm_windows: dict[str, deque] = {}
+
+_listener_registered = False
+
+#: entrypoint label → list of (module, attribute) bindings to wrap. Several
+#: bindings can alias one function (models/logistic imports linear_shap at
+#: module top, so both the defining and the importing module are patched).
+WRAP_TARGETS: dict[str, list[tuple[str, str]]] = {
+    "scorer": [
+        ("fraud_detection_tpu.ops.scorer", "_score"),
+        ("fraud_detection_tpu.ops.scorer", "_cast_scores"),
+        ("fraud_detection_tpu.ops.pallas_kernels", "fused_score"),
+    ],
+    "drift_window": [("fraud_detection_tpu.monitor.drift", "_window_update")],
+    "gate": [("fraud_detection_tpu.lifecycle.gate", "_gate_stats")],
+    "linear_shap": [
+        ("fraud_detection_tpu.ops.linear_shap", "linear_shap"),
+        ("fraud_detection_tpu.models.logistic", "linear_shap"),
+    ],
+    "tree_shap": [("fraud_detection_tpu.ops.tree_shap", "tree_shap")],
+    "gbt_predict": [("fraud_detection_tpu.ops.gbt", "gbt_predict_proba")],
+}
+
+
+# -- thread-local call stack ------------------------------------------------
+
+def _stack() -> list:
+    s = getattr(_local, "stack", None)
+    if s is None:
+        s = _local.stack = []
+    return s
+
+
+class expected_compiles:
+    """Context manager marking compiles as *expected* (warmups, first-touch
+    precompiles): they still count in ``xla_compiles_total`` but never feed
+    the storm detector — a deploy's bucket-ladder warmup must not page."""
+
+    def __enter__(self):
+        self._prev = getattr(_local, "expected", False)
+        _local.expected = True
+        return self
+
+    def __exit__(self, *exc):
+        _local.expected = self._prev
+        return False
+
+
+# -- jax.monitoring attribution ---------------------------------------------
+
+def _on_event_duration(name: str, secs: float, **kw) -> None:
+    if "backend_compile" not in name:
+        return
+    stack = getattr(_local, "stack", None)
+    if stack:
+        stack[-1][1] += secs  # attribute to the innermost instrumented call
+    else:
+        # an uninstrumented jit compiled somewhere; keep the global signal
+        # (counts XLA backend compiles, not entrypoint calls) AND feed the
+        # storm detector — a per-request-shape recompile bug in code nobody
+        # registered in WRAP_TARGETS must still be able to page
+        try:
+            metrics.xla_compile_duration.labels("_unattributed").observe(secs)
+            metrics.xla_compiles.labels("_unattributed").inc()
+            if not getattr(_local, "expected", False):
+                _note_compiles("_unattributed", 1)
+        except Exception:
+            log.debug("unattributed compile metric failed", exc_info=True)
+
+
+def _ensure_listener() -> None:
+    global _listener_registered
+    if _listener_registered:
+        return
+    _listener_registered = True
+    try:
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration
+        )
+    except Exception as e:
+        log.warning(
+            "jax.monitoring unavailable (%s); compile durations fall back "
+            "to wall time of the compiling call", e,
+        )
+
+
+# -- storm detector ---------------------------------------------------------
+
+def _note_compiles(entrypoint: str, n: int, now: float | None = None) -> None:
+    """Feed ``n`` unexpected compiles into the entrypoint's sliding window
+    and refresh its storm gauge."""
+    now = now if now is not None else time.monotonic()
+    window_s = config.recompile_storm_window_s()
+    threshold = config.recompile_storm_threshold()
+    with _storm_lock:
+        dq = _storm_windows.setdefault(entrypoint, deque())
+        for _ in range(n):
+            dq.append(now)
+        while dq and dq[0] < now - window_s:
+            dq.popleft()
+        storming = len(dq) >= threshold
+    metrics.xla_recompile_storm.labels(entrypoint).set(1 if storming else 0)
+    if storming:
+        log.error(
+            "RECOMPILE STORM on %r: %d XLA compiles in the last %.0fs — "
+            "an input shape is not hitting the executable cache "
+            "(docs/runbooks/RecompileStorm.md)",
+            entrypoint, len(dq), window_s,
+        )
+
+
+def refresh_storm_gauges() -> None:
+    """Prune every window and re-derive the storm gauges — called at scrape
+    time so a storm clears once the window drains even with no new calls."""
+    now = time.monotonic()
+    window_s = config.recompile_storm_window_s()
+    threshold = config.recompile_storm_threshold()
+    with _storm_lock:
+        states = {}
+        for ep, dq in _storm_windows.items():
+            while dq and dq[0] < now - window_s:
+                dq.popleft()
+            states[ep] = len(dq) >= threshold
+    for ep, storming in states.items():
+        metrics.xla_recompile_storm.labels(ep).set(1 if storming else 0)
+
+
+def _reset_for_tests() -> None:
+    with _storm_lock:
+        _storm_windows.clear()
+
+
+# -- the wrapper ------------------------------------------------------------
+
+def instrument(entrypoint: str, fn):
+    """Wrap a jitted callable so its XLA cache misses are counted and timed
+    under ``entrypoint``. Transparent for non-jitted callables (no
+    ``_cache_size``) and under abstract evaluation (``jax.eval_shape``
+    never compiles, so the before/after cache sizes match)."""
+    if getattr(fn, "_spyglass_entrypoint", None) is not None:
+        return fn  # already instrumented
+    cache_size = getattr(fn, "_cache_size", None)
+    _ensure_listener()
+
+    if cache_size is None:
+        log.debug(
+            "instrument(%r): no _cache_size on %r — cannot observe cache "
+            "misses; passing through", entrypoint, fn,
+        )
+        return fn
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        stack = _stack()
+        stack.append([entrypoint, 0.0])
+        before = cache_size()
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _, compile_secs = stack.pop()
+            misses = cache_size() - before
+            if misses > 0:
+                dur = (
+                    compile_secs
+                    if compile_secs > 0
+                    else time.perf_counter() - t0
+                )
+                metrics.xla_compiles.labels(entrypoint).inc(misses)
+                metrics.xla_compile_duration.labels(entrypoint).observe(dur)
+                if not getattr(_local, "expected", False):
+                    _note_compiles(entrypoint, misses)
+            elif compile_secs > 0 and stack:
+                # inner jits compiled but our cache hit (nested wrap):
+                # re-attribute to the enclosing instrumented call
+                stack[-1][1] += compile_secs
+
+    wrapped._spyglass_entrypoint = entrypoint
+    wrapped.__wrapped__ = fn
+    # keep cache introspection usable through the wrapper
+    wrapped._cache_size = cache_size
+    return wrapped
+
+
+# -- in-place installation --------------------------------------------------
+
+def install() -> list[str]:
+    """Instrument every registered serving entrypoint in place; returns the
+    list of bindings wrapped. Idempotent. Must run before scorer/model
+    construction (GBTBatchScorer binds ``gbt_predict_proba`` at init)."""
+    import importlib
+
+    wrapped: list[str] = []
+    for entrypoint, bindings in WRAP_TARGETS.items():
+        for mod_name, attr in bindings:
+            try:
+                mod = importlib.import_module(mod_name)
+                fn = getattr(mod, attr)
+            except Exception as e:
+                log.warning("sentinel: cannot bind %s.%s (%s)", mod_name,
+                            attr, e)
+                continue
+            new = instrument(entrypoint, fn)
+            if new is not fn:
+                setattr(mod, attr, new)
+                wrapped.append(f"{mod_name}.{attr}")
+    if wrapped:
+        log.info("compile sentinel installed on %d bindings", len(wrapped))
+    return wrapped
+
+
+def uninstall() -> None:
+    """Restore the original callables (tests)."""
+    import importlib
+
+    for bindings in WRAP_TARGETS.values():
+        for mod_name, attr in bindings:
+            try:
+                mod = importlib.import_module(mod_name)
+                fn = getattr(mod, attr)
+            except Exception:  # graftcheck: ignore[silent-except] — uninstall mirrors install, which already warned
+                continue
+            orig = getattr(fn, "__wrapped__", None)
+            if orig is not None and getattr(fn, "_spyglass_entrypoint", None):
+                setattr(mod, attr, orig)

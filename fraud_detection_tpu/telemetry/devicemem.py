@@ -1,0 +1,79 @@
+"""Device-memory watermark gauges.
+
+HBM pressure is invisible to host metrics until an allocation fails mid
+serving; PJRT exposes per-device ``memory_stats()`` (bytes in use, limit,
+allocator peak) that this module aggregates into Prometheus gauges:
+
+- ``device_memory_bytes_in_use``      — sum over local devices
+- ``device_memory_bytes_limit``       — sum over local devices
+- ``device_memory_peak_bytes_in_use`` — allocator peak when the backend
+  reports one, else a process-lifetime high-water mark of the in-use sum
+
+Refreshes are pull-driven (the API refreshes at ``/metrics`` scrape, the
+worker every ~30 s in its poll loop via :func:`maybe_refresh`) because
+``memory_stats`` can be an RPC on tunneled PJRT backends — a fixed-rate
+thread would pay that cost even with nobody scraping. Backends without
+memory stats (CPU) leave the gauges at 0.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from fraud_detection_tpu.service import metrics
+
+log = logging.getLogger("fraud_detection_tpu.telemetry")
+
+_lock = threading.Lock()
+_last_refresh = 0.0
+_peak_seen = 0
+
+
+def refresh() -> dict | None:
+    """Poll every local device and update the gauges. Returns the aggregate
+    stats dict, or None when the backend reports no memory stats."""
+    global _peak_seen
+    try:
+        import jax
+
+        in_use = limit = peak = 0
+        saw_stats = False
+        for dev in jax.local_devices():
+            stats = dev.memory_stats()
+            if not stats:
+                continue
+            saw_stats = True
+            in_use += int(stats.get("bytes_in_use", 0))
+            limit += int(stats.get("bytes_limit", 0))
+            peak += int(
+                stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+            )
+    except Exception:
+        log.debug("device memory stats unavailable", exc_info=True)
+        return None
+    if not saw_stats:
+        return None
+    with _lock:
+        _peak_seen = max(_peak_seen, in_use, peak)
+        peak_out = _peak_seen
+    metrics.device_memory_bytes_in_use.set(in_use)
+    metrics.device_memory_bytes_limit.set(limit)
+    metrics.device_memory_peak_bytes_in_use.set(peak_out)
+    return {
+        "bytes_in_use": in_use,
+        "bytes_limit": limit,
+        "peak_bytes_in_use": peak_out,
+    }
+
+
+def maybe_refresh(min_interval_s: float = 30.0) -> None:
+    """Rate-limited :func:`refresh` for polling loops (the worker)."""
+    global _last_refresh
+    now = time.monotonic()
+    with _lock:
+        if now - _last_refresh < min_interval_s:
+            return
+        _last_refresh = now
+    refresh()

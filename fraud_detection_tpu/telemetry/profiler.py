@@ -1,0 +1,97 @@
+"""On-demand device profiling for the live service (``POST /admin/profile``).
+
+``utils/profiling.device_trace`` existed for offline use; this drives it
+against a *serving* process: capture whatever the device executes for a
+bounded window while live traffic keeps flowing (the micro-batcher's
+``annotate("microbatch-score")`` host regions line the trace up with the
+XLA ops), then hand back the trace directory for
+``tensorboard --logdir`` / Perfetto.
+
+Operational guardrails, because the profiler is not free on the device:
+
+- **duration-bounded** — requests are clamped to
+  ``DEVICE_PROFILE_MAX_S`` (a forgotten trace must not run for hours);
+- **single-flight** — one capture at a time per process
+  (``jax.profiler`` cannot nest traces anyway; concurrent requests get a
+  409 via :class:`ProfileBusy`);
+- **auth-gated** like the other ``/admin/*`` surface (``ADMIN_TOKEN``,
+  enforced in service/app.py).
+
+Each capture also snapshots the device-memory watermark
+(:mod:`.devicemem`) into the response.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.telemetry import devicemem
+from fraud_detection_tpu.utils.profiling import device_trace
+
+log = logging.getLogger("fraud_detection_tpu.telemetry")
+
+
+class ProfileBusy(RuntimeError):
+    """A capture is already in flight (single-flight guard)."""
+
+
+class DeviceProfiler:
+    def __init__(self, base_dir: str | None = None):
+        self.base_dir = base_dir or config.device_profile_dir()
+        self._lock = threading.Lock()
+
+    @property
+    def busy(self) -> bool:
+        return self._lock.locked()
+
+    def capture(self, duration_s: float | None = None) -> dict:
+        """Blocking capture (run it off-loop): trace everything the device
+        executes for ``duration_s`` seconds, return the trace path +
+        memory watermark. Raises :class:`ProfileBusy` when a capture is
+        already running and ValueError for an out-of-bounds duration."""
+        max_s = config.device_profile_max_s()
+        if duration_s is None:
+            duration_s = config.device_profile_default_s()
+        duration_s = float(duration_s)
+        if not (0 < duration_s <= max_s):
+            raise ValueError(
+                f"duration_s must be in (0, {max_s}] "
+                f"(DEVICE_PROFILE_MAX_S), got {duration_s}"
+            )
+        if not self._lock.acquire(blocking=False):
+            raise ProfileBusy("a device profile capture is already running")
+        try:
+            metrics.device_profile_active.set(1)
+            # ns suffix: sequential sub-second captures (single-flight only
+            # blocks CONCURRENT ones) must not share a directory
+            trace_dir = os.path.join(
+                self.base_dir,
+                f"{time.strftime('%Y%m%d-%H%M%S')}-{time.time_ns() % 1_000_000_000:09d}",
+            )
+            t0 = time.perf_counter()
+            with device_trace(trace_dir):
+                # the capture window: live traffic keeps flowing through
+                # the micro-batcher while the profiler records it
+                time.sleep(duration_s)
+            wall = time.perf_counter() - t0
+            metrics.device_profiles.inc()
+            mem = devicemem.refresh()
+            log.warning(
+                "device profile captured: %s (%.2fs window)",
+                trace_dir, duration_s,
+            )
+            return {
+                "trace_dir": trace_dir,
+                "duration_s": duration_s,
+                "wall_s": round(wall, 3),
+                "device_memory": mem,
+                "hint": f"tensorboard --logdir {trace_dir}",
+            }
+        finally:
+            metrics.device_profile_active.set(0)
+            self._lock.release()

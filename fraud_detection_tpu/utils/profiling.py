@@ -64,6 +64,39 @@ def device_trace(log_dir: str, create_perfetto_link: bool = False):
                 log.warning("profiler stop failed: %s", e)
 
 
+# jax's own profiler-session state, resolved lazily on first annotate():
+# the object itself (annotations key off its .profile_session attribute),
+# or False when this jax build doesn't expose it. Raw
+# ``jax.profiler.start_trace`` callers (and the admin-triggered capture
+# before it was routed through device_trace) don't touch _active_traces,
+# so without this probe their traces silently lost every host annotation.
+_jax_profile_state = None
+
+
+def _raw_trace_active() -> bool:
+    """True when a profiler session is live that ``device_trace`` didn't
+    start. One attribute read on the resolved state object — cheap enough
+    for the per-call gate in :func:`annotate`."""
+    global _jax_profile_state
+    state = _jax_profile_state
+    if state is None:
+        try:
+            from jax._src.profiler import _profile_state as state
+        except Exception:  # private API; absent on some jax versions
+            state = False
+            log.info(
+                "jax profiler state not introspectable on this version: "
+                "annotations require tracing through device_trace()"
+            )
+        _jax_profile_state = state
+    if state is False:
+        return False
+    try:
+        return state.profile_session is not None
+    except Exception:  # graftcheck: ignore[silent-except] — state attr drift across jax versions = fallback off
+        return False
+
+
 class _NullAnnotation:
     """Shared no-op context manager for the trace-off path."""
 
@@ -81,14 +114,17 @@ _NULL_ANNOTATION = _NullAnnotation()
 
 def annotate(name: str, **kwargs):
     """Name a host-side region in the device timeline
-    (``jax.profiler.TraceAnnotation``). Outside an active ``device_trace``
-    this returns a shared no-op context manager — zero allocations, so
+    (``jax.profiler.TraceAnnotation``). Outside an active trace this
+    returns a shared no-op context manager — zero allocations, so
     annotations can sit on serving hot paths (the micro-batch flush loop)
-    at no cost when nobody is tracing. The gate keys on ``device_trace``'s
-    own counter: traces started via raw ``jax.profiler.start_trace`` are
-    invisible to it and get no annotations — always profile through
-    :func:`device_trace`."""
-    if _active_traces == 0:
+    at no cost when nobody is tracing. The gate checks ``device_trace``'s
+    own counter first, then falls back to jax's profiler-session state, so
+    traces started via raw ``jax.profiler.start_trace`` (or any path that
+    bypasses :func:`device_trace`) get named host regions too. On jax
+    builds whose profiler state isn't introspectable the fallback degrades
+    to the old behavior (logged once): only :func:`device_trace` traces
+    see annotations."""
+    if _active_traces == 0 and not _raw_trace_active():
         return _NULL_ANNOTATION
     import jax
 

@@ -1,0 +1,17 @@
+"""Fixture: prometheus metrics the prom-foreign-registry rule must flag."""
+
+from prometheus_client import Counter, Gauge
+from prometheus_client import Histogram as Hist
+
+from fraud_detection_tpu.service.metrics import registry
+
+# default-registry leak: no registry= kwarg → global REGISTRY, which
+# double-registers under gunicorn preload / module re-import
+requests_seen = Counter("requests_seen", "requests seen")
+
+# same leak through an aliased import
+latency = Hist("latency_seconds", "latency")
+
+# shared service registry minted outside service/metrics.py: invisible to
+# the alerting-contract tests
+rogue_gauge = Gauge("rogue_gauge", "rogue", registry=registry)

@@ -1,0 +1,13 @@
+"""Fixture: prometheus usage the prom-foreign-registry rule must accept."""
+
+from collections import Counter  # stdlib Counter: never a prometheus metric
+
+from prometheus_client import CollectorRegistry, Gauge
+
+# module-private registry: the sanctioned pattern for exporting metrics
+# outside service/metrics.py (e.g. netserver's store gauges)
+registry = CollectorRegistry()
+
+depth = Gauge("store_depth", "queue depth", registry=registry)
+
+word_counts = Counter(["a", "b", "a"])

@@ -72,11 +72,35 @@ def test_service_rules_true_positives():
         "good_jit_closure.py",
         "good_donate.py",
         "good_service.py",
+        "good_prometheus.py",
     ],
 )
 def test_good_fixtures_are_clean(good):
     counts, findings = rule_counts(good)
     assert not findings, f"false positives in {good}: {findings}"
+
+
+def test_prom_foreign_registry_true_positives():
+    counts, findings = rule_counts("bad_prometheus.py")
+    assert counts["prom-foreign-registry"] == 3, findings
+    msgs = [f.message for f in findings if f.rule_id == "prom-foreign-registry"]
+    # two default-registry leaks (one through an aliased import) + one
+    # shared-registry mint outside service/metrics.py
+    assert sum("without registry=" in m for m in msgs) == 2
+    assert sum("outside service/metrics.py" in m for m in msgs) == 1
+
+
+def test_prom_foreign_registry_allows_canonical_module():
+    """service/metrics.py itself (registry= on the shared registry) and the
+    module-private-registry pattern must both stay clean."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = analyze_file(
+        os.path.join(
+            repo_root, "fraud_detection_tpu", "service", "metrics.py"
+        ),
+        root=repo_root,
+    )
+    assert not [f for f in findings if f.rule_id == "prom-foreign-registry"]
 
 
 # -- suppression mechanics --------------------------------------------------

@@ -30,21 +30,27 @@ def test_watchtower_rules_file_ships():
     assert promlint.lint_rules_file(path) == []
 
 
-def test_watchtower_alert_metrics_exist_in_registry():
-    """Every watchtower_* metric an alert references must be exported by
-    service/metrics.py (counters get a _total suffix in exposition)."""
+def _exported_metric_names():
+    """Metric names service/metrics.py exposes. HELP lines cover labeled
+    metrics with no live children yet (the recommendation gauge has no
+    series until status() runs)."""
     from fraud_detection_tpu.service import metrics as m
 
     exported = set()
     for line in m.render().decode().splitlines():
         if line.startswith("# HELP "):
-            # HELP lines cover labeled metrics with no live children yet
-            # (the recommendation gauge has no series until status() runs)
             exported.add(line.split()[2])
             continue
         match = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{|\s)", line)
         if match:
             exported.add(match.group(1))
+    return exported
+
+
+def test_watchtower_alert_metrics_exist_in_registry():
+    """Every watchtower_* metric an alert references must be exported by
+    service/metrics.py (counters get a _total suffix in exposition)."""
+    exported = _exported_metric_names()
     with open(os.path.join(RULES_DIR, "watchtower-alerts.yml")) as f:
         text = f.read()
     referenced = set(re.findall(r"\b(watchtower_[a-z_]+)\b", text))
@@ -74,16 +80,7 @@ def test_lifecycle_rules_file_ships():
 def test_lifecycle_alert_metrics_exist_in_registry():
     """Every lifecycle_* metric an alert references must be exported by
     service/metrics.py — same contract test as the watchtower rules."""
-    from fraud_detection_tpu.service import metrics as m
-
-    exported = set()
-    for line in m.render().decode().splitlines():
-        if line.startswith("# HELP "):
-            exported.add(line.split()[2])
-            continue
-        match = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{|\s)", line)
-        if match:
-            exported.add(match.group(1))
+    exported = _exported_metric_names()
     with open(os.path.join(RULES_DIR, "lifecycle-alerts.yml")) as f:
         text = f.read()
     referenced = set(re.findall(r"\b(lifecycle_[a-z_]+)\b", text))
@@ -96,6 +93,63 @@ def test_lifecycle_alert_metrics_exist_in_registry():
         and f"{name}_total" not in exported
     }
     assert not missing, f"alert rules reference unexported metrics: {missing}"
+
+
+def test_telemetry_rules_file_ships():
+    path = os.path.join(RULES_DIR, "telemetry-alerts.yml")
+    assert os.path.exists(path)
+    assert promlint.lint_rules_file(path) == []
+    with open(path) as f:
+        text = f.read()
+    # the alerts the spyglass PR promises (ISSUE 4)
+    assert "RecompileStorm" in text
+    assert "xla_compiles_total" in text
+    assert "xla_recompile_storm" in text
+
+
+def test_telemetry_alert_metrics_exist_in_registry():
+    """Every spyglass metric the telemetry rules reference must be exported
+    by service/metrics.py — same drift-proofing contract as the watchtower
+    and lifecycle rules. Histogram _bucket/_sum/_count and counter _total
+    suffixes are normalized before the check."""
+    exported = _exported_metric_names()
+    with open(os.path.join(RULES_DIR, "telemetry-alerts.yml")) as f:
+        text = f.read()
+    referenced = set(
+        re.findall(
+            r"\b((?:xla_|request_stage_|device_memory_|device_profile)"
+            r"[a-z0-9_]+)\b",
+            text,
+        )
+    )
+    assert referenced, "telemetry rules reference no spyglass metrics?"
+
+    def base(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count", "_total"):
+            name = name.removesuffix(suffix)
+        return name
+
+    missing = {
+        name for name in referenced
+        if base(name) not in exported
+        and name not in exported
+        and f"{base(name)}_total" not in exported
+    }
+    assert not missing, f"alert rules reference unexported metrics: {missing}"
+
+
+def test_grafana_waterfall_row_present():
+    """The latency-waterfall row must ship in the dashboard with the stage
+    histogram + compile counter exprs (promlint checks expr balance)."""
+    for rel in (
+        "grafana_dashboard.json",
+        os.path.join("grafana_provisioning", "dashboards", "fraud-tpu.json"),
+    ):
+        with open(os.path.join(MONITORING, rel)) as f:
+            text = f.read()
+        assert "request_stage_duration_seconds_bucket" in text, rel
+        assert "xla_compiles_total" in text, rel
+        assert "device_memory_bytes_in_use" in text, rel
 
 
 def test_grafana_watchtower_panels_present():

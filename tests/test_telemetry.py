@@ -1,0 +1,675 @@
+"""spyglass (telemetry/): stage decomposition, compile sentinel, flight
+recorder, on-demand profiling, and trace-context propagation.
+
+The acceptance spine of ISSUE 4:
+
+- a deliberately shape-unstable jitted function trips the compile sentinel
+  (``xla_compiles_total`` jump) and the RecompileStorm condition from the
+  promlint-parsed rule file evaluates true against the observed values;
+- ``GET /debug/flightrecorder`` returns the last-N records with all six
+  timeline stages populated for a scored request;
+- correlation id + trace context propagate HTTP header → ``predict`` span →
+  taskq row → worker span attributes, with OTEL absent (no-op path) and
+  with a stub tracer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import re
+import threading
+import time
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import scaler_fit
+from fraud_detection_tpu.service import metrics, tracing
+from fraud_detection_tpu.service.app import create_app
+from fraud_detection_tpu.service.http import TestClient
+from fraud_detection_tpu.service.worker import XaiWorker
+from fraud_detection_tpu.telemetry import (
+    STAGES,
+    FlightRecorder,
+    RequestTimeline,
+    compile_sentinel,
+)
+from fraud_detection_tpu.telemetry import devicemem
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TELEMETRY_RULES = os.path.join(
+    REPO_ROOT, "monitoring", "prometheus", "rules", "telemetry-alerts.yml"
+)
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def _counter_value(counter, *labels) -> float:
+    return counter.labels(*labels)._value.get()
+
+
+def _gauge_value(gauge, *labels) -> float:
+    if labels:
+        return gauge.labels(*labels)._value.get()
+    return gauge._value.get()
+
+
+class StubSpan:
+    def __init__(self, name, trace_id, span_id, start_time=None):
+        self.name = name
+        self.attributes: dict = {}
+        self.start_time = start_time
+        self.end_time = None
+        self._ctx = SimpleNamespace(
+            trace_id=trace_id, span_id=span_id, trace_flags=1
+        )
+
+    def set_attribute(self, k, v):
+        self.attributes[k] = v
+
+    def get_span_context(self):
+        return self._ctx
+
+    def end(self, end_time=None):
+        self.end_time = end_time
+
+
+class StubTracer:
+    """Duck-typed stand-in for an OTEL tracer (the SDK isn't installed in
+    this environment) — records every span it hands out."""
+
+    TRACE_ID = 0x0AF7651916CD43DD8448EB211C80319C
+
+    def __init__(self):
+        self.spans: list[StubSpan] = []
+        self._n = 0
+
+    def _new(self, name, start_time=None):
+        self._n += 1
+        s = StubSpan(name, self.TRACE_ID, self._n, start_time=start_time)
+        self.spans.append(s)
+        return s
+
+    @contextlib.contextmanager
+    def start_as_current_span(self, name, **kw):
+        yield self._new(name)
+
+    def start_span(self, name, start_time=None, **kw):
+        return self._new(name, start_time=start_time)
+
+    def named(self, name):
+        return [s for s in self.spans if s.name == name]
+
+
+@pytest.fixture()
+def stub_tracer(monkeypatch):
+    stub = StubTracer()
+    monkeypatch.setattr(tracing, "_tracer", stub)
+    monkeypatch.setattr(tracing, "_initialized", True)
+    return stub
+
+
+@pytest.fixture()
+def served(tmp_path, rng, monkeypatch):
+    """A trained model on disk + app wired to temp DB/broker/tracking —
+    the test_service_api fixture, with telemetry surfaces exposed."""
+    d = 30
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32), intercept=np.float32(-1.0)
+    )
+    x = rng.standard_normal((200, d)).astype(np.float32)
+    scaler = scaler_fit(x)
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    model_dir = str(tmp_path / "models")
+    FraudLogisticModel(params, scaler, names).save(model_dir, joblib_too=False)
+
+    monkeypatch.setenv("MODEL_PATH", os.path.join(model_dir, "logistic_model.joblib"))
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    monkeypatch.setenv("DEVICE_PROFILE_DIR", str(tmp_path / "traces"))
+    db_url = f"sqlite:///{tmp_path}/fraud.db"
+    broker_url = f"sqlite:///{tmp_path}/taskq.db"
+    app = create_app(database_url=db_url, broker_url=broker_url)
+    client = TestClient(app)
+    yield client, db_url, broker_url
+    client.close()
+    compile_sentinel.uninstall()
+
+
+# -- timeline + flight recorder units ---------------------------------------
+
+
+def test_timeline_stages_and_spans():
+    from fraud_detection_tpu.telemetry.timeline import FlushInfo
+
+    tl = RequestTimeline(correlation_id="c1")
+    t = tl.t_enqueued
+    tl.t_collected = t + 0.001
+    tl.flush = FlushInfo(
+        t_flush_start=t + 0.002, t_padded=t + 0.003, t_synced=t + 0.007,
+        t_fetched=t + 0.008, batch_size=4, bucket=8,
+    )
+    tl.flush.t_resolved = t + 0.009
+    stages = tl.stages()
+    assert tuple(stages) == STAGES
+    assert tl.complete()
+    assert abs(stages["device_compute"] - 0.004) < 1e-9
+    assert abs(tl.total_seconds() - 0.009) < 1e-9
+    spans = tl.stage_spans_ns()
+    assert [s[0] for s in spans] == list(STAGES)
+    for _, start_ns, end_ns in spans:
+        assert end_ns >= start_ns
+    # spans tile the timeline contiguously
+    for (_, _, prev_end), (_, nxt_start, _) in zip(spans, spans[1:]):
+        assert abs(prev_end - nxt_start) <= 1
+
+
+def test_timeline_incomplete_stages_read_zero():
+    tl = RequestTimeline()
+    assert not tl.complete()
+    assert set(tl.stages().values()) == {0.0}
+    assert tl.stage_spans_ns() == []
+
+
+def test_flightrecorder_ring_wraps_and_dumps_newest_first():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record((float(i), f"c{i}", 1, 8, None, None, False, {}, 0.0))
+    assert len(rec) == 4
+    assert rec.total_recorded == 10
+    dump = rec.dump()
+    assert [r["correlation_id"] for r in dump] == ["c9", "c8", "c7", "c6"]
+    assert rec.dump(limit=2)[0]["ts"] == 9.0
+    assert set(dump[0]) == {
+        "ts", "correlation_id", "batch_size", "bucket", "model_version",
+        "model_source", "drift", "stages", "total_s",
+    }
+
+
+def test_flightrecorder_concurrent_records():
+    rec = FlightRecorder(capacity=64)
+
+    def spam(k):
+        for i in range(200):
+            rec.record((time.time(), f"t{k}-{i}", 1, 8, None, None, False,
+                        {}, 0.0))
+
+    threads = [threading.Thread(target=spam, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rec.total_recorded == 800
+    assert len(rec.dump()) == 64
+
+
+# -- compile sentinel -------------------------------------------------------
+
+
+def test_shape_unstable_function_trips_sentinel_and_storm_rule():
+    """THE ISSUE-4 acceptance: a deliberately shape-unstable jitted function
+    (every call a new shape → a new executable — exactly the PR 3 gate bug)
+    jumps ``xla_compiles_total`` and makes the RecompileStorm condition from
+    the promlint-parsed rule file evaluate true."""
+    compile_sentinel._reset_for_tests()
+    f = jax.jit(lambda x: x * 2.0)
+    wrapped = compile_sentinel.instrument("test_unstable", f)
+    before = _counter_value(metrics.xla_compiles, "test_unstable")
+    for n in range(1, 21):  # 20 distinct shapes → 20 cache misses
+        out = wrapped(jnp.ones((n,), jnp.float32))
+        assert out.shape == (n,)
+    jump = _counter_value(metrics.xla_compiles, "test_unstable") - before
+    assert jump == 20
+
+    # the in-process jump detector raised the storm gauge
+    storm = _gauge_value(metrics.xla_recompile_storm, "test_unstable")
+    assert storm == 1
+
+    # ...and the observed values satisfy the shipped alert condition
+    import yaml
+
+    with open(TELEMETRY_RULES) as fh:
+        rules = yaml.safe_load(fh)
+    exprs = [
+        r["expr"]
+        for g in rules["groups"]
+        for r in g["rules"]
+        if r.get("alert") == "RecompileStorm"
+    ]
+    assert len(exprs) == 1, "exactly one RecompileStorm rule"
+    expr = exprs[0]
+    m = re.search(r"increase\(xla_compiles_total\[\d+m\]\)\)\s*>\s*(\d+)", expr)
+    assert m, f"counter-jump clause missing from {expr!r}"
+    assert jump > int(m.group(1))  # clause 1: the counter jump
+    m = re.search(r"xla_recompile_storm\)\s*==\s*(\d+)", expr)
+    assert m, f"storm-gauge clause missing from {expr!r}"
+    assert storm == int(m.group(1))  # clause 2: the detector gauge
+
+    # real compile time was attributed to the entrypoint
+    hist = metrics.xla_compile_duration.labels("test_unstable")
+    assert hist._sum.get() > 0
+
+
+def test_sentinel_cache_hits_are_free_of_compile_counts():
+    compile_sentinel._reset_for_tests()
+    f = jax.jit(lambda x: x + 1.0)
+    wrapped = compile_sentinel.instrument("test_stable", f)
+    wrapped(jnp.ones((8,), jnp.float32))  # the one compile
+    before = _counter_value(metrics.xla_compiles, "test_stable")
+    for _ in range(50):
+        wrapped(jnp.ones((8,), jnp.float32))
+    assert _counter_value(metrics.xla_compiles, "test_stable") == before
+
+
+def test_expected_compiles_never_feed_the_storm_detector():
+    """Warmups (bucket ladders at deploy/reload) count in the counter but
+    must not page: the detector ignores compiles under expected_compiles."""
+    compile_sentinel._reset_for_tests()
+    f = jax.jit(lambda x: x - 1.0)
+    wrapped = compile_sentinel.instrument("test_warmup", f)
+    before = _counter_value(metrics.xla_compiles, "test_warmup")
+    with compile_sentinel.expected_compiles():
+        for n in range(1, 21):
+            wrapped(jnp.ones((n,), jnp.float32))
+    assert _counter_value(metrics.xla_compiles, "test_warmup") - before == 20
+    assert _gauge_value(metrics.xla_recompile_storm, "test_warmup") == 0
+
+
+def test_storm_clears_when_the_window_drains(monkeypatch):
+    compile_sentinel._reset_for_tests()
+    monkeypatch.setattr(config, "recompile_storm_window_s", lambda: 0.05)
+    monkeypatch.setattr(config, "recompile_storm_threshold", lambda: 3)
+    f = jax.jit(lambda x: x * 3.0)
+    wrapped = compile_sentinel.instrument("test_drain", f)
+    for n in range(1, 5):
+        wrapped(jnp.ones((n,), jnp.float32))
+    assert _gauge_value(metrics.xla_recompile_storm, "test_drain") == 1
+    time.sleep(0.1)
+    compile_sentinel.refresh_storm_gauges()  # the scrape-time prune
+    assert _gauge_value(metrics.xla_recompile_storm, "test_drain") == 0
+
+
+def test_instrument_passthrough_for_plain_callables():
+    def plain(x):
+        return x
+
+    assert compile_sentinel.instrument("nope", plain) is plain
+
+
+def test_install_wraps_in_place_transparently_and_uninstalls():
+    import fraud_detection_tpu.ops.scorer as scorer_mod
+    from fraud_detection_tpu.ops.scaler import ScalerParams
+    from fraud_detection_tpu.ops.scorer import BatchScorer
+
+    compile_sentinel.uninstall()
+    orig = scorer_mod._score
+    rng = np.random.default_rng(5)
+    coef = rng.standard_normal(30).astype(np.float32)
+    scaler = ScalerParams(
+        mean=np.zeros(30, np.float32), scale=np.ones(30, np.float32),
+        var=np.ones(30, np.float32), n_samples=np.float32(1),
+    )
+    x = rng.standard_normal((17, 30)).astype(np.float32)
+    want = BatchScorer(
+        LogisticParams(coef=coef, intercept=np.float32(-1.0)), scaler
+    ).predict_proba(x)
+    try:
+        wrapped_bindings = compile_sentinel.install()
+        assert "fraud_detection_tpu.ops.scorer._score" in wrapped_bindings
+        assert scorer_mod._score is not orig
+        assert scorer_mod._score._spyglass_entrypoint == "scorer"
+        assert scorer_mod._score.__wrapped__ is orig
+        # cache introspection survives the wrap (test_lifecycle relies on it)
+        assert scorer_mod._score._cache_size() >= 0
+        # numerics through the wrapper are bit-identical
+        got = BatchScorer(
+            LogisticParams(coef=coef, intercept=np.float32(-1.0)), scaler
+        ).predict_proba(x)
+        np.testing.assert_array_equal(got, want)
+        # idempotent
+        assert compile_sentinel.install() == []
+    finally:
+        compile_sentinel.uninstall()
+    assert scorer_mod._score is orig
+
+
+# -- traceparent helpers ----------------------------------------------------
+
+
+def test_traceparent_roundtrip_and_validation():
+    hdr = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+    assert tracing.parse_traceparent(hdr) == (
+        0x0AF7651916CD43DD8448EB211C80319C, 0xB7AD6B7169203331, 1
+    )
+    span = StubSpan("s", 0x0AF7651916CD43DD8448EB211C80319C, 0xB7AD6B7169203331)
+    assert tracing.format_traceparent(span) == hdr
+    for bad in (
+        None, "", "garbage",
+        "00-00000000000000000000000000000000-b7ad6b7169203331-01",  # zero trace
+        "00-0af7651916cd43dd8448eb211c80319c-0000000000000000-01",  # zero span
+    ):
+        assert tracing.parse_traceparent(bad) is None
+
+
+def test_current_traceparent_requires_open_span(stub_tracer):
+    assert tracing.current_traceparent() is None
+    with tracing.span("outer"):
+        hdr = tracing.current_traceparent()
+        assert hdr is not None
+        parsed = tracing.parse_traceparent(hdr)
+        assert parsed and parsed[0] == StubTracer.TRACE_ID
+    assert tracing.current_traceparent() is None
+
+
+def test_span_links_remote_parent_as_attribute_with_stub(stub_tracer):
+    hdr = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+    with tracing.span("child", traceparent=hdr, correlation_id="c9") as s:
+        assert s.attributes["trace.parent"] == hdr
+        assert s.attributes["correlation_id"] == "c9"
+
+
+# -- end-to-end: flight recorder + stage histograms -------------------------
+
+
+def test_flightrecorder_endpoint_returns_all_six_stages(served):
+    """ISSUE-4 acceptance: a scored request lands in the flight recorder
+    with all six timeline stages populated."""
+    client, *_ = served
+    r = client.post(
+        "/predict",
+        json={"features": [0.3] * 30},
+        headers={"X-Correlation-ID": "fr-1"},
+    )
+    assert r.status_code == 200
+
+    fr = client.get("/debug/flightrecorder")
+    assert fr.status_code == 200
+    body = fr.json()
+    assert body["enabled"] is True
+    assert body["capacity"] == config.flightrecorder_capacity()
+    records = body["records"]
+    assert records, "scored request missing from the flight recorder"
+    rec = next(r_ for r_ in records if r_["correlation_id"] == "fr-1")
+    assert set(rec["stages"]) == set(STAGES)
+    for stage_name, duration in rec["stages"].items():
+        assert duration > 0.0, f"stage {stage_name} not populated: {rec}"
+    assert rec["batch_size"] >= 1
+    assert rec["bucket"] >= rec["batch_size"]
+    assert rec["total_s"] > 0
+    assert rec["drift"] is False
+
+    # per-stage histograms observed the same request
+    text = client.get("/metrics").text
+    for stage_name in STAGES:
+        m = re.search(
+            rf'request_stage_duration_seconds_count{{stage="{stage_name}"}} (\d+)',
+            text,
+        )
+        assert m and int(float(m.group(1))) >= 1, stage_name
+    # scrape also refreshed the spyglass gauges without error
+    assert "device_memory_bytes_in_use" in text
+    assert "xla_recompile_storm" in text
+
+
+def test_flightrecorder_disabled_path(served, monkeypatch):
+    client, *_ = served
+    client.get("/status")  # startup
+    client.app.state["flightrecorder"] = None
+    body = client.get("/debug/flightrecorder").json()
+    assert body["enabled"] is False and body["records"] == []
+
+
+def test_spyglass_disabled_serves_opaque_path(tmp_path, rng, monkeypatch):
+    monkeypatch.setenv("SPYGLASS_ENABLED", "0")
+    d = 30
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32), intercept=np.float32(-1.0)
+    )
+    x = rng.standard_normal((50, d)).astype(np.float32)
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    model_dir = str(tmp_path / "models")
+    FraudLogisticModel(params, scaler_fit(x), names).save(
+        model_dir, joblib_too=False
+    )
+    monkeypatch.setenv("MODEL_PATH", os.path.join(model_dir, "logistic_model.joblib"))
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    app = create_app(
+        database_url=f"sqlite:///{tmp_path}/fraud.db",
+        broker_url=f"sqlite:///{tmp_path}/taskq.db",
+    )
+    with TestClient(app) as client:
+        r = client.post("/predict", json={"features": [0.1] * 30})
+        assert r.status_code == 200
+        assert client.get("/debug/flightrecorder").json()["enabled"] is False
+    compile_sentinel.uninstall()
+
+
+# -- end-to-end: correlation id + trace context propagation -----------------
+
+
+def test_propagation_noop_without_otel(served):
+    """OTEL absent and no tracer: the traceparent task arg is None, worker
+    still explains the transaction (the no-op path of satellite 4)."""
+    import json as jsonlib
+    import sqlite3
+
+    client, db_url, broker_url = served
+    r = client.post(
+        "/predict",
+        json={"features": [0.2] * 30},
+        headers={"X-Correlation-ID": "noop-1"},
+    )
+    tx_id = r.json()["transaction_id"]
+
+    conn = sqlite3.connect(broker_url[len("sqlite:///"):])
+    (args_json,) = conn.execute(
+        "SELECT args FROM tasks WHERE correlation_id='noop-1'"
+    ).fetchone()
+    conn.close()
+    args = jsonlib.loads(args_json)
+    assert len(args) == 4
+    assert args[0] == tx_id
+    assert args[2] == "noop-1"
+    assert args[3] is None  # no tracer → no trace context
+
+    worker = XaiWorker(broker_url=broker_url, database_url=db_url)
+    assert worker.run_once() is True
+    assert client.get(f"/explain/{tx_id}").status_code == 200
+
+
+def test_propagation_with_stub_tracer(served, stub_tracer):
+    """Header → predict span (+ 6 stage child spans) → taskq row carries a
+    valid traceparent of the predict trace → worker compute_shap span links
+    it via attributes."""
+    import json as jsonlib
+    import sqlite3
+
+    client, db_url, broker_url = served
+    r = client.post(
+        "/predict",
+        json={"features": [0.4] * 30},
+        headers={"X-Correlation-ID": "prop-1"},
+    )
+    assert r.status_code == 200
+    assert r.headers["x-correlation-id"] == "prop-1"
+
+    predict_spans = stub_tracer.named("predict")
+    assert len(predict_spans) == 1
+    assert predict_spans[0].attributes["correlation_id"] == "prop-1"
+    # the six stage child spans, explicitly timestamped, in stage order
+    stage_spans = [s for s in stub_tracer.spans if s.name.startswith("stage:")]
+    assert [s.name for s in stage_spans] == [f"stage:{n}" for n in STAGES]
+    for s in stage_spans:
+        assert s.start_time is not None and s.end_time >= s.start_time
+        assert s.attributes["duration_ms"] >= 0
+
+    conn = sqlite3.connect(broker_url[len("sqlite:///"):])
+    (args_json,) = conn.execute(
+        "SELECT args FROM tasks WHERE correlation_id='prop-1'"
+    ).fetchone()
+    conn.close()
+    traceparent = jsonlib.loads(args_json)[3]
+    parsed = tracing.parse_traceparent(traceparent)
+    assert parsed is not None, traceparent
+    assert parsed[0] == StubTracer.TRACE_ID  # same trace as the predict span
+
+    worker = XaiWorker(broker_url=broker_url, database_url=db_url)
+    assert worker.run_once() is True
+    (shap_span,) = stub_tracer.named("compute_shap")
+    assert shap_span.attributes["correlation_id"] == "prop-1"
+    # stub mode: the remote link is surfaced as an attribute
+    assert shap_span.attributes["trace.parent"] == traceparent
+
+
+def test_batched_worker_path_links_traceparent(served, stub_tracer):
+    client, db_url, broker_url = served
+    for i in range(3):
+        client.post(
+            "/predict",
+            json={"features": [0.1 * i] * 30},
+            headers={"X-Correlation-ID": f"batch-{i}"},
+        )
+    worker = XaiWorker(broker_url=broker_url, database_url=db_url)
+    assert worker.run_batch() == 3
+    shap_spans = stub_tracer.named("compute_shap")
+    assert len(shap_spans) == 3
+    for s in shap_spans:
+        assert tracing.parse_traceparent(s.attributes["trace.parent"])
+
+
+# -- tracing force reset (satellite 1) --------------------------------------
+
+
+def test_setup_tracing_force_resets_the_latch(monkeypatch):
+    monkeypatch.setattr(tracing, "_initialized", False)
+    monkeypatch.setattr(tracing, "_tracer", None)
+    monkeypatch.delenv("OTEL_EXPORTER_OTLP_ENDPOINT", raising=False)
+    assert tracing.setup_tracing() is False
+    # the old latch: a live tracer appearing later was impossible. Simulate
+    # a successful earlier init, then force-reset without an endpoint — the
+    # stale tracer must be dropped and the endpoint re-read.
+    stub = StubTracer()
+    monkeypatch.setattr(tracing, "_tracer", stub)
+    assert tracing.setup_tracing() is True  # latched: returns the old answer
+    assert tracing.setup_tracing(force=True) is False  # re-ran the init
+    assert tracing._tracer is None  # the reset actually happened
+
+
+# -- /admin/profile + auth gate ---------------------------------------------
+
+
+def test_admin_profile_captures_and_is_single_flight(served):
+    client, *_ = served
+    client.get("/status")  # startup
+    r = client.post("/admin/profile", json={"duration_s": 0.2})
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert os.path.isdir(body["trace_dir"])
+    assert body["duration_s"] == 0.2
+    assert "tensorboard" in body["hint"]
+    assert _gauge_value(metrics.device_profile_active) == 0
+
+    # single-flight: a capture in progress turns concurrent requests away
+    profiler = client.app.state["profiler"]
+    assert profiler._lock.acquire(blocking=False)
+    try:
+        assert client.post("/admin/profile", json={}).status_code == 409
+    finally:
+        profiler._lock.release()
+
+    # duration bound
+    r = client.post(
+        "/admin/profile",
+        json={"duration_s": config.device_profile_max_s() + 1},
+    )
+    assert r.status_code == 422
+
+
+def test_admin_endpoints_auth_gate(served, monkeypatch):
+    client, *_ = served
+    client.get("/status")
+    monkeypatch.setenv("ADMIN_TOKEN", "sekret")
+    assert client.post("/admin/profile", json={}).status_code == 401
+    assert client.post("/admin/reload").status_code == 401
+    assert (
+        client.post(
+            "/admin/profile",
+            json={"duration_s": 0.05},
+            headers={"X-Admin-Token": "sekret"},
+        ).status_code
+        == 200
+    )
+    # bearer form + reload passes the gate (200: reloader is live)
+    assert (
+        client.post(
+            "/admin/reload", headers={"Authorization": "Bearer sekret"}
+        ).status_code
+        == 200
+    )
+
+
+# -- device memory gauges ---------------------------------------------------
+
+
+def test_devicemem_refresh_with_backend_stats(monkeypatch):
+    fake = SimpleNamespace(
+        memory_stats=lambda: {
+            "bytes_in_use": 1000, "bytes_limit": 4000,
+            "peak_bytes_in_use": 2500,
+        }
+    )
+    monkeypatch.setattr(jax, "local_devices", lambda: [fake, fake])
+    out = devicemem.refresh()
+    assert out == {
+        "bytes_in_use": 2000, "bytes_limit": 8000, "peak_bytes_in_use": 5000,
+    }
+    assert _gauge_value(metrics.device_memory_bytes_in_use) == 2000
+    assert _gauge_value(metrics.device_memory_bytes_limit) == 8000
+    assert _gauge_value(metrics.device_memory_peak_bytes_in_use) >= 5000
+
+
+def test_devicemem_refresh_none_without_stats(monkeypatch):
+    monkeypatch.setattr(
+        jax, "local_devices",
+        lambda: [SimpleNamespace(memory_stats=lambda: None)],
+    )
+    assert devicemem.refresh() is None
+
+
+# -- annotate fallback (satellite 2) ----------------------------------------
+
+
+def test_annotate_sees_raw_jax_profiler_traces(monkeypatch, tmp_path):
+    """annotate() must produce real annotations when the trace was started
+    via raw jax.profiler.start_trace — the blind spot this PR closes."""
+    from fraud_detection_tpu.utils import profiling
+
+    assert isinstance(
+        profiling.annotate("idle"), profiling._NullAnnotation
+    )  # no trace active → shared no-op
+
+    jax.profiler.start_trace(str(tmp_path / "rawtrace"))
+    try:
+        cm = profiling.annotate("raw-region")
+        assert not isinstance(cm, profiling._NullAnnotation)
+        with cm:
+            jnp.ones((4,)).block_until_ready()
+    finally:
+        jax.profiler.stop_trace()
+    # and back to the free path once the raw trace stops
+    assert isinstance(profiling.annotate("idle2"), profiling._NullAnnotation)
+
+
+def test_annotate_fallback_degrades_without_profiler_state(monkeypatch):
+    from fraud_detection_tpu.utils import profiling
+
+    monkeypatch.setattr(profiling, "_jax_profile_state", False)
+    monkeypatch.setattr(profiling, "_active_traces", 0)
+    assert isinstance(profiling.annotate("x"), profiling._NullAnnotation)
