@@ -351,6 +351,42 @@ def _build_window_update(mesh: Mesh):
     )
 
 
+@register_entrypoint("fastlane.flush")
+def _build_fastlane_flush(mesh: Mesh):
+    """The fused single-dispatch flush program (scores + drift-window fold,
+    window donated through): the serving hot path once a watchtower is
+    attached, so its shapes/shardings must compose at every mesh size."""
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import (
+        N_CALIB_BINS,
+        DriftWindow,
+        _fused_flush,
+    )
+    from fraud_detection_tpu.ops.scorer import _raw_score_linear
+
+    window = DriftWindow(
+        feature_counts=sds((_FEATURES, N_FEATURE_BINS), jnp.float32, mesh, P()),
+        score_counts=sds((N_SCORE_BINS,), jnp.float32, mesh, P()),
+        calib_count=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_conf=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_label=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        n_rows=sds((), jnp.float32, mesh, P()),
+    )
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, P(DATA_AXIS))
+    valid = sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((_FEATURES, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = (
+        sds((_FEATURES,), jnp.float32, mesh, P()),
+        sds((), jnp.float32, mesh, P()),
+    )
+    fn = lambda w, xx, vv, dd, fe, se, sa: _fused_flush(  # noqa: E731
+        w, xx, vv, dd, fe, se, sa, score_fn=_raw_score_linear
+    )
+    return fn, (window, x, valid, decay, feature_edges, score_edges, score_args)
+
+
 @register_entrypoint("lifecycle.gate_eval")
 def _build_gate_eval(mesh: Mesh):
     from fraud_detection_tpu.lifecycle.gate import (

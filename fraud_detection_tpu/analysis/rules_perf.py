@@ -1,0 +1,122 @@
+"""Performance lint rules: allocation churn on marked hot paths.
+
+The fastlane work (service/microbatch flush, monitor/drift fused update,
+service/worker batched explain) replaced per-flush ``np.zeros``/``np.stack``
+churn with preallocated per-bucket staging buffers
+(:class:`fraud_detection_tpu.ops.scorer.StagingPool`). This rule is the
+mechanical guard that keeps fresh allocations from creeping back: a
+``# graftcheck: hot-path`` comment anywhere inside a function marks that
+function (innermost enclosing one) as a steady-state hot region, and every
+array-constructor call inside it is flagged. Reviewed exceptions use the
+standard ``# graftcheck: ignore[hot-path-alloc]`` tag.
+
+The marker is a comment, not a decorator, so it costs nothing at runtime
+and can sit directly on the line that explains WHY the region is hot.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import tokenize
+from io import StringIO
+from typing import Iterator
+
+from fraud_detection_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Severity,
+    dotted_name,
+    register_rule,
+)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_HOT_PATH_RE = re.compile(r"#\s*graftcheck:\s*hot-path\b")
+
+#: array constructors that always materialize a fresh buffer. Reshapes,
+#: views, and in-place ops are the sanctioned replacements and deliberately
+#: not listed; ``asarray``/``array`` stay off the list too — the d2h fetch
+#: of device results legitimately materializes its output on the hot path.
+_ALLOC_FNS = {
+    "zeros", "empty", "ones", "full",
+    "zeros_like", "empty_like", "ones_like", "full_like",
+}
+#: combinators that allocate UNLESS redirected into a preallocated buffer
+#: with ``out=`` — ``np.stack(rows)`` per flush is the exact churn fastlane
+#: removed, ``np.stack(rows, out=slot.f32[:n])`` is its replacement.
+_ALLOC_UNLESS_OUT_FNS = {"stack", "concatenate", "vstack", "hstack"}
+_ALLOC_MODULES = {"np", "numpy", "jnp", "onp"}
+
+
+def _hot_path_lines(mod: ModuleInfo) -> list[int]:
+    """Line numbers carrying a ``# graftcheck: hot-path`` marker, found via
+    tokenize (same discipline as the suppression scan: a '#' inside a
+    string can't fake a marker)."""
+    out: list[int] = []
+    try:
+        for tok in tokenize.generate_tokens(StringIO(mod.source).readline):
+            if tok.type == tokenize.COMMENT and _HOT_PATH_RE.search(tok.string):
+                out.append(tok.start[0])
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _marked_functions(mod: ModuleInfo) -> set[ast.AST]:
+    """The innermost function enclosing each marker line. A marker outside
+    every function body (module level) marks nothing — hot paths are
+    functions."""
+    lines = _hot_path_lines(mod)
+    if not lines:
+        return set()
+    funcs = [n for n in ast.walk(mod.tree) if isinstance(n, _FuncDef)]
+    marked: set[ast.AST] = set()
+    for ln in lines:
+        best = None
+        for fn in funcs:
+            end = getattr(fn, "end_lineno", fn.lineno)
+            if fn.lineno <= ln <= end and (
+                best is None or fn.lineno > best.lineno
+            ):
+                best = fn
+        if best is not None:
+            marked.add(best)
+    return marked
+
+
+@register_rule(
+    "hot-path-alloc",
+    Severity.WARNING,
+    "fresh array allocation (np.zeros/np.empty/jnp.zeros/...) inside a "
+    "region marked '# graftcheck: hot-path' — steady-state hot paths must "
+    "reuse preallocated staging buffers (ops/scorer.StagingPool)",
+)
+def check_hot_path_alloc(mod: ModuleInfo) -> Iterator[Finding]:
+    rule = check_hot_path_alloc.rule
+    for fn in _marked_functions(mod):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee is None:
+                continue
+            parts = callee.split(".")
+            if len(parts) != 2 or parts[0] not in _ALLOC_MODULES:
+                continue
+            if parts[1] in _ALLOC_FNS:
+                yield mod.finding(
+                    rule, node,
+                    f"{callee}(...) allocates a fresh array inside hot-path "
+                    f"region {fn.name!r} — stage into a preallocated buffer "
+                    "(ops/scorer.StagingPool) instead",
+                )
+            elif parts[1] in _ALLOC_UNLESS_OUT_FNS and not any(
+                kw.arg == "out" for kw in node.keywords
+            ):
+                yield mod.finding(
+                    rule, node,
+                    f"{callee}(...) without out= allocates a fresh batch "
+                    f"array inside hot-path region {fn.name!r} — pass "
+                    "out=<staging slot> (ops/scorer.StagingPool) instead",
+                )

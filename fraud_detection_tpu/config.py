@@ -210,6 +210,23 @@ def scorer_max_inflight() -> int:
     return _get_int("SCORER_MAX_INFLIGHT", 4)
 
 
+def scorer_fused_flush() -> bool:
+    """``SCORER_FUSED_FLUSH`` (default on): fuse the drift-window update
+    into the scoring dispatch — one device call per flush instead of two
+    (the fastlane hot path). ``0`` restores the split path (score dispatch
+    + watchtower ingest-thread window update) for A/B measurement."""
+    return env_flag("SCORER_FUSED_FLUSH") is not False
+
+
+def scorer_adaptive_wait() -> bool:
+    """``SCORER_ADAPTIVE_WAIT=1``: scale the micro-batcher's collection
+    deadline with an arrival-rate EWMA — light traffic flushes almost
+    immediately (p50 ≈ one dispatch), heavy traffic waits up to
+    ``SCORER_MAX_WAIT_MS`` to fill buckets. Default off: the fixed
+    ``SCORER_MAX_WAIT_MS`` deadline."""
+    return env_flag("SCORER_ADAPTIVE_WAIT") is True
+
+
 # --------------------------------------------------------------------------
 # Watchtower: online drift & quality monitoring + shadow scoring (monitor/)
 # --------------------------------------------------------------------------

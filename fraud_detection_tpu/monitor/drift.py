@@ -1,10 +1,15 @@
 """Online drift accumulators: jitted sliding-window statistics.
 
-Driven from the microbatch scorer path: every scored batch lands in ONE
-fused device call (``_window_update``, window state donated so XLA updates
-the buffers in place) that bins the batch against the baseline edges and
-folds it into exponentially-decayed window histograms. No per-row host
-work; the host only computes the scalar decay factor.
+Driven from the microbatch scorer path. On the fastlane hot path the drift
+fold doesn't even get its own device call: ``_fused_flush`` traces the
+scorer's raw score body together with the histogram update into ONE
+donated, multi-output program per shape bucket, so a serving flush pays a
+single dispatch for scores *and* monitoring (see service/microbatch).
+Feedback replays and direct updates use ``_window_update`` (window state
+donated so XLA updates the buffers in place), which bins the batch against
+the baseline edges and folds it into exponentially-decayed window
+histograms. No per-row host work; the host only computes the scalar decay
+factor.
 
 Statistics are derived lazily (``_drift_stats``, a second small jitted
 program) when ``/monitor/status`` or a Prometheus scrape asks:
@@ -77,6 +82,53 @@ def init_window(
         calib_conf=jnp.zeros((n_calib_bins,), jnp.float32),
         calib_label=jnp.zeros((n_calib_bins,), jnp.float32),
         n_rows=jnp.zeros((), jnp.float32),
+    )
+
+
+@partial(jax.jit, static_argnames=("score_fn",), donate_argnums=(0,))
+def _fused_flush(
+    window: DriftWindow,
+    x: jax.Array,  # (b, d) staged batch, possibly narrow-IO encoded
+    valid: jax.Array,  # (b,) 1.0 for real rows, 0.0 for bucket padding
+    decay: jax.Array,  # () drift forgetting factor (live rows this batch)
+    feature_edges: jax.Array,
+    score_edges: jax.Array,
+    score_args,  # pytree: the scorer's device params
+    *,
+    score_fn,  # static: module-level raw score body (ops/scorer)
+) -> tuple[jax.Array, DriftWindow]:
+    """The fastlane flush program: scores **and** the drift-window update in
+    ONE device dispatch per shape bucket.
+
+    The serving flush previously paid two dispatches — the scorer's
+    ``_score`` and, on the watchtower ingest thread, ``_window_update`` —
+    plus a second h2d upload of the same batch. Here ``score_fn`` (a
+    module-level raw score body, static so jit caches one executable per
+    (bucket, scorer-family)) traces inline with the histogram fold, the
+    window state is donated through, and the scores come back as the only
+    fetched output. Serving flushes carry no feedback labels, so the
+    calibration state passes through untouched (exactly what
+    ``_window_update`` computes for an unlabeled batch: zero label weights,
+    calibration decay 1.0) — delayed-feedback replays keep using
+    ``_window_update`` off the hot path.
+
+    For the bf16 wire the drift histograms bin the bf16-rounded values
+    rather than the raw f32 rows — the same values the model actually
+    scored, which is the distribution drift must monitor. (int8 wire codes
+    are not raw-space, so the int8 scorer opts out of fusion entirely —
+    ``BatchScorer.fused_spec`` returns None there.)
+    """
+    xf = x.astype(jnp.float32)
+    scores = score_fn(score_args, x).astype(jnp.float32)
+    fc = feature_histogram(xf, feature_edges, weights=valid)
+    sc = score_histogram(scores, score_edges, weights=valid)
+    return scores, DriftWindow(
+        feature_counts=window.feature_counts * decay + fc,
+        score_counts=window.score_counts * decay + sc,
+        calib_count=window.calib_count,
+        calib_conf=window.calib_conf,
+        calib_label=window.calib_label,
+        n_rows=window.n_rows * decay + jnp.sum(valid),
     )
 
 
@@ -233,6 +285,58 @@ class DriftMonitor:
             decay = jnp.float32(0.5 ** (n / self.halflife_rows))
             self._decay_cache[n] = decay
         return decay
+
+    def fused_flush(
+        self, x: jax.Array, valid: jax.Array, n_live: int, score_args, score_fn
+    ) -> jax.Array:
+        """Score one staged batch AND fold it into the drift window in ONE
+        device dispatch (the fastlane hot path — ``_fused_flush``). ``x`` and
+        ``valid`` are already device-resident and bucket-padded; returns the
+        device score vector (padded; caller slices to the live rows).
+
+        The lock covers only {read window → dispatch → store new window}:
+        dispatch is asynchronous, so the critical section is microseconds
+        and a concurrent ``stats()`` reader still can't see donated buffers.
+        With pipelined flushes the device executes the chained updates in
+        dispatch order — each flush's input window is the previous flush's
+        output future."""
+        # graftcheck: hot-path
+        decay = self._decay_for(n_live)
+        with self._lock:
+            scores, self.window = _fused_flush(
+                self.window,
+                x,
+                valid,
+                decay,
+                self._feature_edges,
+                self._score_edges,
+                score_args,
+                score_fn=score_fn,
+            )
+            self.rows_seen += n_live
+        return scores
+
+    def warm_fused(self, scorer, bucket: int) -> None:
+        """Pre-compile the fused flush executable for one bucket without
+        touching the window: an all-padding batch (valid = 0) with decay 1.0
+        (``n_live = 0``) folds exact zeros into every histogram, so the
+        window state is bitwise unchanged while XLA compiles and caches the
+        executable. Stages through the scorer's real staging/encode path so
+        the warmed executable matches the serving wire dtype. Run under the
+        compile sentinel's expected-compiles mark by the micro-batcher's
+        startup warmup."""
+        score_fn, score_args = scorer.fused_spec()
+        slot = scorer.staging.acquire(bucket)
+        try:
+            slot.f32[:] = 0.0
+            hx = scorer._encode_slot(slot)
+            slot.valid[:] = 0.0
+            self.fused_flush(
+                jnp.asarray(hx), jnp.asarray(slot.valid), 0,
+                score_args, score_fn,
+            ).block_until_ready()
+        finally:
+            scorer.staging.release(slot)
 
     def update(self, x, scores, labels=None, calibration_only=False) -> None:
         """Fold one scored batch in — one fused device call.

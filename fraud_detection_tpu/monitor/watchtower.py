@@ -143,16 +143,33 @@ class Watchtower:
         self._thread.start()
 
     # -- ingest (request path adjacent; must never block) -------------------
-    def observe(self, rows, scores, labels=None, calibration_only=False) -> bool:
+    def wants_rows(self) -> bool:
+        """True when a fastlane flush (drift already folded on-device) still
+        needs the raw rows queued — i.e. a shadow challenger is bound. When
+        False, the flush can skip the per-batch row copy entirely."""
+        return self.shadow is not None
+
+    def observe(
+        self, rows, scores, labels=None, calibration_only=False,
+        drift_done=False,
+    ) -> bool:
         """Queue one scored batch for monitoring. Non-blocking; returns
         False when the backlog bound forced a drop (counted).
 
         ``calibration_only=True`` marks a delayed-feedback replay
         (/monitor/feedback): the rows were already observed live, so they
         update only calibration state and skip the shadow comparison (the
-        recorded champion scores may predate the current champion)."""
+        recorded champion scores may predate the current champion).
+
+        ``drift_done=True`` is the fastlane flush path: the drift window
+        was already folded inside the scoring dispatch itself
+        (drift.fused_flush), so the ingest thread only runs the sampled
+        shadow comparison — ``rows`` may be None when no challenger is
+        bound (see :meth:`wants_rows`)."""
         try:
-            self._queue.put_nowait((rows, scores, labels, calibration_only))
+            self._queue.put_nowait(
+                (rows, scores, labels, calibration_only, drift_done)
+            )
         except queue.Full:
             metrics.watchtower_batches_dropped.inc()
             return False
@@ -164,13 +181,15 @@ class Watchtower:
             try:
                 if item is None or self._stop:
                     return
-                rows, scores, labels, calibration_only = item
-                self.drift.update(
-                    rows, scores, labels, calibration_only=calibration_only
-                )
+                rows, scores, labels, calibration_only, drift_done = item
+                if not drift_done:
+                    self.drift.update(
+                        rows, scores, labels, calibration_only=calibration_only
+                    )
                 metrics.watchtower_batches_observed.inc()
                 if (
                     self.shadow is not None
+                    and rows is not None
                     and not calibration_only
                     and self.shadow.maybe_observe(rows, scores)
                 ):

@@ -17,6 +17,7 @@ TPU-first design decisions (SURVEY.md §7 hard part c):
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 
 import jax
@@ -74,6 +75,106 @@ def _bucket(n: int, min_bucket: int = 8) -> int:
     return b
 
 
+# --------------------------------------------------------------------------
+# Raw (un-jitted) score bodies — the fastlane fusion surface
+# --------------------------------------------------------------------------
+# The fused flush program (monitor/drift._fused_flush) traces ONE of these
+# inside its own jit so scoring and the drift-window update compile into a
+# single XLA executable per shape bucket — one device dispatch per flush
+# instead of two. They are module-level (stable identity) because jit hashes
+# static callables by id: a per-scorer lambda would recompile per instance.
+
+
+def _raw_score_linear(score_args, x: jax.Array) -> jax.Array:
+    """``sigmoid(x @ coef + intercept)`` over a (possibly narrow-IO) batch;
+    ``score_args = (coef, intercept)``. Traced inside the fused flush."""
+    coef, intercept = score_args
+    return jax.nn.sigmoid(x.astype(jnp.float32) @ coef + intercept)
+
+
+def _raw_score_linear_pallas(score_args, x: jax.Array) -> jax.Array:
+    """Pallas fused-GEMV variant (USE_PALLAS=1): the inner pallas_call jit
+    traces inline under the fused flush program."""
+    from fraud_detection_tpu.ops.pallas_kernels import fused_score
+
+    coef, intercept = score_args
+    return fused_score(coef, intercept, x)
+
+
+def _raw_score_gbt(model, x: jax.Array) -> jax.Array:
+    """Forest traversal body; ``score_args`` is the GBTModel pytree."""
+    from fraud_detection_tpu.ops.gbt import gbt_predict_proba
+
+    return gbt_predict_proba(model, x)
+
+
+# --------------------------------------------------------------------------
+# Zero-allocation staging: reusable per-bucket host buffers
+# --------------------------------------------------------------------------
+
+
+class _StagingSlot:
+    """One bucket's worth of host staging: the f32 row buffer, the
+    wire-encoded view/buffer the device transfer ships, and the validity
+    mask (1.0 for real rows, 0.0 for bucket padding)."""
+
+    __slots__ = ("bucket", "f32", "io", "scratch", "valid")
+
+    def __init__(self, bucket: int, n_features: int, io_dtype):
+        self.bucket = bucket
+        self.f32 = np.zeros((bucket, n_features), np.float32)
+        # f32 wire: encode is the identity, io aliases f32 (no second copy)
+        self.io = (
+            self.f32
+            if io_dtype == np.float32
+            else np.zeros((bucket, n_features), io_dtype)
+        )
+        # int8 quantization needs a float workspace separate from f32 (the
+        # raw rows must survive encode for the shadow/monitoring copy)
+        self.scratch = (
+            np.zeros((bucket, n_features), np.float32)
+            if io_dtype == np.int8
+            else None
+        )
+        self.valid = np.zeros((bucket,), np.float32)
+
+
+class StagingPool:
+    """Thread-safe freelist of :class:`_StagingSlot` per shape bucket.
+
+    The serving flush path (service/microbatch) and the worker's batched
+    explain path (service/worker.compute_shap_many) acquire a slot, stack
+    their rows into it (``np.stack(..., out=)`` — no fresh batch array),
+    dispatch, and release it after the device fence. With pipelined flushes
+    (SCORER_MAX_INFLIGHT > 1) concurrent flushes of one bucket draw distinct
+    slots, so a flush can never stomp another's staged bytes.
+
+    ``allocations`` counts slot creations: in steady state it is constant —
+    bench.py's ``microbatch_flush`` section asserts exactly that, and the
+    ``hot-path-alloc`` graftcheck rule keeps fresh ``np.zeros`` from
+    creeping back into the marked flush regions.
+    """
+
+    def __init__(self, n_features: int, io_dtype=np.float32):
+        self.n_features = n_features
+        self.io_dtype = io_dtype
+        self._free: dict[int, list[_StagingSlot]] = {}
+        self._lock = threading.Lock()
+        self.allocations = 0
+
+    def acquire(self, bucket: int) -> _StagingSlot:
+        with self._lock:
+            free = self._free.get(bucket)
+            if free:
+                return free.pop()
+            self.allocations += 1
+        return _StagingSlot(bucket, self.n_features, self.io_dtype)
+
+    def release(self, slot: _StagingSlot) -> None:
+        with self._lock:
+            self._free.setdefault(slot.bucket, []).append(slot)
+
+
 class _BucketedScorer:
     """Shared serving mechanics: pad request batches up to power-of-two shape
     buckets (one cached XLA executable per bucket) and score on device.
@@ -95,6 +196,43 @@ class _BucketedScorer:
         """Host-side wire encoding (cast/quantize) — the transfer ships
         ``_io_np_dtype`` bytes."""
         return x.astype(self._io_np_dtype, copy=False)
+
+    # -- fastlane: fusion + zero-allocation staging -------------------------
+
+    def fused_spec(self):
+        """``(score_fn, score_args)`` for the fused flush program, or None
+        when this scorer can't be traced into it. ``score_fn`` must be a
+        module-level callable (jit hashes statics by identity) and
+        ``score_args`` a pytree of device arrays."""
+        return None
+
+    @property
+    def staging(self) -> StagingPool:
+        """Lazy per-scorer staging pool (per-bucket reusable host buffers)."""
+        pool = getattr(self, "_staging", None)
+        if pool is None:
+            pool = self._staging = StagingPool(
+                self.n_features, self._io_np_dtype
+            )
+        return pool
+
+    def _encode_slot(self, slot: _StagingSlot) -> np.ndarray:
+        """Wire-encode the staged f32 rows into the slot's io buffer —
+        allocation-free counterpart of :meth:`_prepare_host`. Identity for
+        f32 wire (io aliases f32)."""
+        if slot.io is not slot.f32:
+            np.copyto(slot.io, slot.f32, casting="unsafe")
+        return slot.io
+
+    def stage_rows(self, slot: _StagingSlot, rows: list) -> np.ndarray:
+        # graftcheck: hot-path — runs once per micro-batch flush; every
+        # buffer below is preallocated pool state, never a fresh array
+        n = len(rows)
+        np.stack(rows, out=slot.f32[:n])
+        slot.f32[n:] = 0.0
+        slot.valid[:n] = 1.0
+        slot.valid[n:] = 0.0
+        return self._encode_slot(slot)
 
     def warmup(self, max_bucket: int = 4096) -> None:
         """Pre-compile the bucket ladder so first requests don't pay XLA
@@ -245,6 +383,28 @@ class BatchScorer(_BucketedScorer):
         np.clip(buf, -127.0, 127.0, out=buf)
         return buf.astype(np.int8)
 
+    def _encode_slot(self, slot: _StagingSlot) -> np.ndarray:
+        if self._quant_scale is None:
+            return super()._encode_slot(slot)
+        # graftcheck: hot-path — quantize via the slot's preallocated f32
+        # scratch (the raw rows in slot.f32 must survive for monitoring)
+        np.multiply(slot.f32, self._inv_quant_scale, out=slot.scratch)
+        np.rint(slot.scratch, out=slot.scratch)
+        np.clip(slot.scratch, -127.0, 127.0, out=slot.scratch)
+        np.copyto(slot.io, slot.scratch, casting="unsafe")
+        return slot.io
+
+    def fused_spec(self):
+        if self._quant_scale is not None:
+            # int8 wire ships quantization CODES (the dequant scale is
+            # folded into coef): the fused program's drift histograms would
+            # bin codes against raw-space edges — opt out of fusion
+            return None
+        fn = (
+            _raw_score_linear_pallas if self._use_pallas else _raw_score_linear
+        )
+        return fn, (self.coef, self.intercept)
+
     def _score_padded(self, x: jax.Array, out_dtype=jnp.float32) -> jax.Array:
         # bf16/int8-IO inputs ship narrow; the f32 upcast happens inside the
         # jitted kernels so it compiles into the same executable.
@@ -274,3 +434,6 @@ class GBTBatchScorer(_BucketedScorer):
     def _score_padded(self, x: jax.Array, out_dtype=jnp.float32) -> jax.Array:
         p = self._predict(self._model, x)
         return _cast_scores(p, out_dtype) if out_dtype != jnp.float32 else p
+
+    def fused_spec(self):
+        return _raw_score_gbt, self._model

@@ -80,6 +80,39 @@ microbatch_size = Histogram(
     registry=registry,
 )
 
+# Fastlane: fused-flush hot path (service/microbatch + monitor/drift).
+# These names are part of the alerting contract — the
+# FlushDispatchRegression alert and the fastlane Grafana panels read them.
+scorer_device_calls_per_flush = Gauge(
+    "scorer_device_calls_per_flush",
+    "Device dispatches the last flush issued (1 = fused fastlane path; "
+    "2 = split score + drift-window dispatches) — instant view for the "
+    "fastlane dashboard panel; the FlushDispatchRegression alert reads "
+    "the scorer_flushes_total path counters instead (a last-write gauge "
+    "latches on one stray split flush over idle periods)",
+    registry=registry,
+)
+scorer_flushes = Counter(
+    "scorer_flushes",
+    "Micro-batch flushes by dispatch path: fused = ONE fused score+drift "
+    "dispatch; split = score dispatch + ingest-thread drift dispatch; "
+    "solo = score-only (no watchtower). FlushDispatchRegression fires on "
+    "a sustained split fraction",
+    ["path"],
+    registry=registry,
+)
+scorer_queue_depth = Gauge(
+    "scorer_queue_depth",
+    "Rows waiting in the micro-batcher queue at the last collection cycle",
+    registry=registry,
+)
+scorer_effective_wait = Gauge(
+    "scorer_effective_wait_seconds",
+    "Collection deadline the micro-batcher is currently applying "
+    "(= SCORER_MAX_WAIT_MS unless SCORER_ADAPTIVE_WAIT scales it down)",
+    registry=registry,
+)
+
 # Watchtower: online drift / quality / shadow monitoring (monitor/).
 # These names are part of the alerting contract —
 # monitoring/prometheus/rules/watchtower-alerts.yml and the Grafana drift

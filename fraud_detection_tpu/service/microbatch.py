@@ -8,24 +8,46 @@ to ``max_batch`` rows or waits at most ``max_wait_ms``, launches ONE device
 call for the batch (shape-bucketed, so a handful of cached executables serve
 all sizes), and resolves each request's future.
 
-p50 for a lone request = max_wait_ms + one dispatch; throughput under load =
-device batch rate × the in-flight window. Up to ``max_inflight`` batches are
-scored concurrently (executor threads; JAX dispatch is thread-safe), so on a
-high-RTT link (a tunneled chip) transfers pipeline instead of serializing —
-the device still runs batches back-to-back. Knobs from config
-(``SCORER_MAX_BATCH``, ``SCORER_MAX_WAIT_MS``).
+**Fastlane** (this module + ops/scorer + monitor/drift): the steady-state
+flush issues exactly ONE device dispatch. With a watchtower attached, the
+drift-window update no longer rides a second device call on the ingest
+thread — the scorer's raw score body and the histogram fold compile into a
+single donated multi-output program per shape bucket
+(``monitor/drift._fused_flush``, sentinel entrypoint ``fastlane.flush``),
+so scores and monitoring share one dispatch and one h2d upload. Host-side
+pad/encode is zero-allocation: rows stack into preallocated per-bucket
+staging buffers (``ops/scorer.StagingPool``) reused across flushes —
+bench.py's ``microbatch_flush`` section asserts steady-state flushes
+allocate no new batch arrays. ``SCORER_FUSED_FLUSH=0`` restores the split
+two-dispatch path for A/B measurement;
+``scorer_device_calls_per_flush`` exports which path served the last flush
+(the FlushDispatchRegression alert input).
+
+p50 for a lone request = the collection deadline + one dispatch; throughput
+under load = device batch rate × the in-flight window. Up to
+``max_inflight`` flushes run concurrently in executor threads, so the
+fence + d2h of flush N runs OFF the event loop while flush N+1 stages and
+dispatches — on a high-RTT link (a tunneled chip) transfers pipeline
+instead of serializing. The fused window state is donated through the
+chain: each flush's input window is the previous flush's output future, so
+pipelining never copies monitoring state. The collection deadline itself
+adapts when ``SCORER_ADAPTIVE_WAIT=1``: an arrival-rate EWMA scales it
+between 0 and ``SCORER_MAX_WAIT_MS`` (light traffic flushes immediately,
+heavy traffic fills buckets); the applied deadline exports as
+``scorer_effective_wait_seconds``.
 
 Spyglass (telemetry/): with telemetry on (default), each flush runs the
 decomposed scoring path — host pad/encode, device dispatch fenced with ONE
 ``block_until_ready`` per flush, then the d2h fetch — and stamps any
 :class:`~fraud_detection_tpu.telemetry.timeline.RequestTimeline` riding the
-queue items. Stage durations export as
+queue items. The ``device_compute`` stage covers the whole fused program
+(scores + drift fold — they are one dispatch). Stage durations export as
 ``request_stage_duration_seconds{stage}`` histograms (row-level stages per
 row, flush-level stages once per flush) and completed timelines land in the
 flight recorder for ``GET /debug/flightrecorder``. ``SPYGLASS_ENABLED=0``
-(or ``telemetry=False``) restores the opaque single-call path — no fence,
-no stamps. Overhead with everything on is bench-bounded ≤5% of the flush
-path (``bench.py`` ``telemetry`` section).
+(or ``telemetry=False``) drops the fence and stamps — the flush is still
+fused. Overhead with everything on is bench-bounded ≤5% of the flush path
+(``bench.py`` ``telemetry`` section).
 """
 
 from __future__ import annotations
@@ -50,6 +72,11 @@ _OBSERVE_STAGE = {
     s: metrics.request_stage_duration.labels(s).observe for s in STAGES
 }
 
+#: EWMA smoothing for the adaptive-deadline arrival-rate estimate: ~0.3
+#: converges within a handful of collection cycles while damping
+#: single-burst spikes.
+_RATE_ALPHA = 0.3
+
 
 class MicroBatcher:
     def __init__(
@@ -62,6 +89,8 @@ class MicroBatcher:
         slot=None,
         recorder=None,
         telemetry: bool | None = None,
+        fused: bool | None = None,
+        adaptive_wait: bool | None = None,
     ):
         # Either a fixed scorer (offline tools, tests) or a lifecycle
         # ModelSlot (serving): with a slot, every flush re-reads the slot's
@@ -71,9 +100,11 @@ class MicroBatcher:
             raise ValueError("MicroBatcher needs a scorer or a model slot")
         self.slot = slot
         self.scorer = scorer if scorer is not None else slot.model.scorer
-        # Optional monitor.Watchtower: every scored batch is handed to its
-        # non-blocking observe() after the waiters resolve — drift/shadow
-        # monitoring rides the batch boundary, zero per-row host work.
+        # Optional monitor.Watchtower: on the fused fastlane path its drift
+        # window updates INSIDE the scoring dispatch; its ingest thread only
+        # handles the sampled shadow comparison. On the split path every
+        # scored batch is handed to its non-blocking observe() after the
+        # waiters resolve.
         self.watchtower = watchtower
         # Optional telemetry.FlightRecorder: completed request timelines
         # land here (lock-light ring; /debug/flightrecorder reads it).
@@ -81,10 +112,18 @@ class MicroBatcher:
         self.telemetry = (
             telemetry if telemetry is not None else config.spyglass_enabled()
         )
+        self.fused = fused if fused is not None else config.scorer_fused_flush()
+        self.adaptive_wait = (
+            adaptive_wait
+            if adaptive_wait is not None
+            else config.scorer_adaptive_wait()
+        )
         self.max_batch = max_batch or config.scorer_max_batch()
         self.max_wait = (
             max_wait_ms if max_wait_ms is not None else config.scorer_max_wait_ms()
         ) / 1000.0
+        self._rate = 0.0  # rows/s arrival EWMA (adaptive deadline input)
+        self._last_cycle: float | None = None
         self._queue: asyncio.Queue[tuple] = asyncio.Queue()
         self._collector: asyncio.Task | None = None
         self._starting = False
@@ -105,18 +144,31 @@ class MicroBatcher:
             # of seconds on a remote-tunneled chip), and with pipelined
             # flushes several shapes would compile concurrently. Warm the
             # bucket a full batch actually pads to, not max_batch itself
-            # (which may not be a power of two). The warmup runs under the
-            # compile sentinel's expected-compiles mark so the deploy-time
-            # ladder can't trip the RecompileStorm detector.
+            # (which may not be a power of two). The fused flush program
+            # warms the same ladder through all-padding batches (valid = 0,
+            # decay 1.0 — the window state is bitwise untouched). The warmup
+            # runs under the compile sentinel's expected-compiles mark so
+            # the deploy-time ladder can't trip the RecompileStorm detector.
             from fraud_detection_tpu.telemetry.compile_sentinel import (
                 expected_compiles,
             )
 
             def _warm() -> None:
+                scorer = (
+                    self.slot.model.scorer
+                    if self.slot is not None
+                    else self.scorer
+                )
+                top = _bucket(self.max_batch, scorer.min_bucket)
                 with expected_compiles():
-                    self.scorer.warmup(
-                        _bucket(self.max_batch, self.scorer.min_bucket)
-                    )
+                    scorer.warmup(top)
+                    target = self._fused_target(scorer)
+                    if target is not None:
+                        drift = target[0]
+                        b = scorer.min_bucket
+                        while b <= top:
+                            drift.warm_fused(scorer, b)
+                            b *= 2
 
             await asyncio.get_running_loop().run_in_executor(None, _warm)
             self._collector = asyncio.create_task(self._run())
@@ -156,6 +208,24 @@ class MicroBatcher:
             tl.t_collected = time.perf_counter()
         return item
 
+    def _effective_wait(self) -> float:
+        """The collection deadline for this cycle. Fixed = the knob;
+        adaptive = the knob scaled by how much of a full bucket the arrival
+        EWMA predicts within the window: a lone request (< 1 expected
+        arrival) flushes immediately, traffic that would fill ``max_batch``
+        inside ``max_wait`` gets the whole window. Always within
+        [0, max_wait] — the existing knob stays the hard bound."""
+        if not self.adaptive_wait:
+            w = self.max_wait
+        else:
+            expected_rows = self._rate * self.max_wait
+            if expected_rows <= 1.0:
+                w = 0.0
+            else:
+                w = self.max_wait * min(1.0, expected_rows / self.max_batch)
+        metrics.scorer_effective_wait.set(w)
+        return w
+
     async def _run(self) -> None:
         batch: list[tuple] = []
         loop = asyncio.get_running_loop()
@@ -163,12 +233,13 @@ class MicroBatcher:
         try:
             while True:
                 batch = [stamp(await self._queue.get())]
+                metrics.scorer_queue_depth.set(self._queue.qsize())
                 # Collect more rows until the window closes or the batch
                 # fills. Greedy drain first: under load the queue already
                 # holds rows, and one timer-armed wait_for PER ROW (a Task +
                 # TimerHandle each) was measured to cap the whole pipeline
                 # at ~2.7k rows/s on CPU — get_nowait costs ~1µs.
-                deadline = loop.time() + self.max_wait
+                deadline = loop.time() + self._effective_wait()
                 while len(batch) < self.max_batch:
                     try:
                         while len(batch) < self.max_batch:
@@ -185,6 +256,7 @@ class MicroBatcher:
                         )
                     except asyncio.TimeoutError:
                         break
+                n_collected = len(batch)
                 # Bounded pipeline: hand the batch to a flush task and go
                 # straight back to collecting. The semaphore caps in-flight
                 # batches (memory + fairness); awaiting it applies
@@ -194,6 +266,22 @@ class MicroBatcher:
                 self._flushes.add(task)
                 task.add_done_callback(self._flushes.discard)
                 batch = []
+                # Arrival-rate EWMA over collection cycles (idle gaps decay
+                # it, so the adaptive deadline relaxes to immediate-flush
+                # when traffic goes quiet). Stamped AFTER the backpressure
+                # block: time spent blocked on the in-flight semaphore is
+                # device drain time, not arrival time — folding it into dt
+                # would underestimate the rate exactly when the device is
+                # behind and shrink the deadline (more, smaller dispatches)
+                # instead of letting heavy traffic fill buckets.
+                now = loop.time()
+                if self._last_cycle is not None:
+                    dt = now - self._last_cycle
+                    if dt > 0:
+                        self._rate += _RATE_ALPHA * (
+                            n_collected / dt - self._rate
+                        )
+                self._last_cycle = now
         except asyncio.CancelledError:
             # Cancellation mid-collection: fail the partial batch so its
             # waiters don't hang, then propagate.
@@ -208,40 +296,96 @@ class MicroBatcher:
         finally:
             self._inflight.release()
 
-    def _score_decomposed(
-        self, scorer, rows: np.ndarray
-    ) -> tuple[np.ndarray, float, float, float, float]:
-        """The flush's device call, decomposed for the stage timeline:
-        host pad/encode → dispatch fenced with ONE ``block_until_ready``
-        per flush (never per row) → d2h fetch. Returns
-        (probs, t_flush_start, t_padded, t_synced, t_fetched).
+    def _fused_target(self, scorer):
+        """(drift_monitor, score_fn, score_args) when this flush can run the
+        single-dispatch fused program, else None — re-resolved per flush
+        because promotions rebind both the slot's scorer and the
+        watchtower's drift monitor."""
+        if not self.fused or self.watchtower is None:
+            return None
+        drift = getattr(self.watchtower, "drift", None)
+        if drift is None or not hasattr(drift, "fused_flush"):
+            return None
+        spec = getattr(scorer, "fused_spec", lambda: None)()
+        if spec is None:
+            return None
+        return drift, spec[0], spec[1]
+
+    def _flush_device(
+        self, scorer, target, batch: list[tuple], telemetry: bool
+    ):
+        """The flush's device call — the fastlane hot path, run in an
+        executor thread so the event loop keeps accepting requests (and so
+        the fence + d2h of flush N overlaps the staging + dispatch of flush
+        N+1 on another thread). Stages rows into the scorer's preallocated
+        per-bucket staging slot (zero fresh batch arrays), then either:
+
+        - fused (``target`` set): ONE dispatch computing scores AND the
+          drift-window fold (window donated through); or
+        - split: the scoring dispatch alone (the watchtower ingest thread
+          pays the second, split-path dispatch afterwards).
+
+        Returns (probs, t_flush_start, t_padded, t_synced, t_fetched,
+        device_calls, monitor_rows). ``monitor_rows`` is a copy of the raw
+        f32 rows when the watchtower still needs them (split drift update,
+        or shadow sampling), else None — the staging slot is recycled the
+        moment this returns, so views must never escape.
 
         Note: on tunneled PJRT platforms ``block_until_ready`` can report
         early (see bench.py `_window_barrier`); there the residue shows up
         in the d2h stage — the *sum* device_compute + d2h is always honest.
         """
+        # graftcheck: hot-path — steady-state flushes must not allocate
+        # fresh batch arrays (bench.py microbatch_flush asserts this)
         import jax.numpy as jnp
 
-        n = rows.shape[0]
-        with annotate("microbatch-score"):
-            t_flush_start = time.perf_counter()
-            hx = scorer._prepare_host(scorer._pad(rows))
-            t_padded = time.perf_counter()
-            out = scorer._score_padded(jnp.asarray(hx))
-            out.block_until_ready()
-            t_synced = time.perf_counter()
-            probs = np.asarray(out, dtype=np.float32)[:n]
-            t_fetched = time.perf_counter()
-        return probs, t_flush_start, t_padded, t_synced, t_fetched
+        n = len(batch)
+        staging = scorer.staging
+        slot = staging.acquire(_bucket(n, scorer.min_bucket))
+        try:
+            with annotate("microbatch-score"):
+                t_flush_start = time.perf_counter()
+                hx = scorer.stage_rows(slot, [r for r, _, _ in batch])
+                t_padded = time.perf_counter()
+                if target is not None:
+                    drift, score_fn, score_args = target
+                    out = drift.fused_flush(
+                        jnp.asarray(hx), jnp.asarray(slot.valid), n,
+                        score_args, score_fn,
+                    )
+                    device_calls = 1
+                    need_rows = getattr(
+                        self.watchtower, "wants_rows", lambda: True
+                    )()
+                else:
+                    out = scorer._score_padded(jnp.asarray(hx))
+                    # the ingest thread will issue the drift-window dispatch
+                    # for this batch — the split path's second device call
+                    device_calls = 2 if self.watchtower is not None else 1
+                    need_rows = self.watchtower is not None
+                if telemetry:
+                    out.block_until_ready()
+                t_synced = time.perf_counter()
+                probs = np.asarray(out, dtype=np.float32)[:n]
+                t_fetched = time.perf_counter()
+                monitor_rows = slot.f32[:n].copy() if need_rows else None
+        finally:
+            # after the score fetch the device has consumed the staged
+            # bytes, so the slot is safe to recycle
+            staging.release(slot)
+        return (
+            probs, t_flush_start, t_padded, t_synced, t_fetched,
+            device_calls, monitor_rows,
+        )
 
     async def _flush(self, batch: list[tuple]) -> None:
         telemetry = self.telemetry
+        fused = False
         try:
             # Everything that can fail stays inside this try — a raise
             # before the waiters are resolved (e.g. np.stack on a
             # mixed-shape batch) would otherwise leave clients awaiting
             # forever inside a detached task.
-            rows = np.stack([r for r, _, _ in batch])
             metrics.microbatch_size.observe(len(batch))
             # ONE slot read per flush: the scorer is pinned for this batch
             # even if a promotion swaps the slot mid-dispatch.
@@ -250,24 +394,34 @@ class MicroBatcher:
                 scorer = model.scorer
             else:
                 scorer, source, version = self.scorer, None, None
-            # The device call is synchronous-but-fast; run it in the default
-            # executor so the event loop keeps accepting requests while XLA
-            # executes. annotate() is free when no trace is active.
-            if telemetry and hasattr(scorer, "_score_padded"):
-                probs, t_flush, t_padded, t_synced, t_fetched = (
-                    await asyncio.get_running_loop().run_in_executor(
-                        None, self._score_decomposed, scorer, rows
-                    )
+            loop = asyncio.get_running_loop()
+            if hasattr(scorer, "stage_rows") and hasattr(scorer, "_score_padded"):
+                target = self._fused_target(scorer)
+                fused = target is not None
+                (
+                    probs, t_flush, t_padded, t_synced, t_fetched,
+                    device_calls, monitor_rows,
+                ) = await loop.run_in_executor(
+                    None, self._flush_device, scorer, target, batch, telemetry
                 )
             else:
+                # Legacy scorers (test doubles, exotic models) without the
+                # staging protocol: opaque predict_proba, no decomposition.
+                rows = np.stack([r for r, _, _ in batch])
+
                 def _score() -> np.ndarray:
                     with annotate("microbatch-score"):
                         return scorer.predict_proba(rows)
 
-                probs = await asyncio.get_running_loop().run_in_executor(
-                    None, _score
-                )
+                probs = await loop.run_in_executor(None, _score)
                 telemetry = False
+                device_calls = 2 if self.watchtower is not None else 1
+                monitor_rows = rows
+            metrics.scorer_device_calls_per_flush.set(device_calls)
+            metrics.scorer_flushes.labels(
+                "fused" if fused
+                else ("split" if self.watchtower is not None else "solo")
+            ).inc()
         except Exception as e:  # resolve all waiters with the failure
             for _, f, _ in batch:
                 if not f.done():
@@ -277,14 +431,14 @@ class MicroBatcher:
         if telemetry:
             n = len(batch)
             try:
-                drift = bool(metrics.watchtower_drift_detected._value.get())
+                drift_flag = bool(metrics.watchtower_drift_detected._value.get())
             except Exception:  # graftcheck: ignore[silent-except] — private gauge attr probe; absence just means "no drift info"
-                drift = False
+                drift_flag = False
             fi = FlushInfo(
                 t_flush_start=t_flush, t_padded=t_padded, t_synced=t_synced,
                 t_fetched=t_fetched, batch_size=n,
                 bucket=_bucket(n, scorer.min_bucket),
-                model_version=version, model_source=source, drift=drift,
+                model_version=version, model_source=source, drift=drift_flag,
             )
         if fi is not None and tracing._tracer is not None:
             # Link rows to the flush ONLY when a tracer will read the
@@ -304,11 +458,14 @@ class MicroBatcher:
             fi.t_resolved = time.perf_counter()
             self._export_flush(fi, batch)
         if self.watchtower is not None:
-            # Waiters are already resolved; observe() only enqueues onto the
-            # watchtower's own ingest thread (bounded, drop-under-pressure),
-            # so a slow monitor can never add request latency.
+            # Waiters are already resolved. Fused path: the drift window is
+            # already updated (it rode the scoring dispatch); observe() only
+            # counts the batch and runs the sampled shadow comparison on the
+            # watchtower's own thread. Split path: observe() enqueues the
+            # full drift update. Either way a slow monitor can never add
+            # request latency.
             try:
-                self.watchtower.observe(rows, probs)
+                self.watchtower.observe(monitor_rows, probs, drift_done=fused)
             except Exception:
                 log.debug("watchtower observe failed", exc_info=True)
 

@@ -221,25 +221,32 @@ class XaiWorker:
             return outcome
         # Pad to the scorer's power-of-two shape buckets: without this every
         # distinct claimed-batch size compiles its own explain executable
-        # (the scorer buckets internally already).
+        # (the scorer buckets internally already). The pad rows come from
+        # the scorer's preallocated staging pool — the worker's batch loop
+        # used to allocate an np.zeros tail per claimed batch; now the same
+        # per-bucket buffer is recycled across batches (fastlane satellite).
         from fraud_detection_tpu.ops.scorer import _bucket
 
+        # graftcheck: hot-path — the claimed-batch explain loop must not
+        # allocate fresh pad/stack arrays per batch
         k = len(prepared)
-        rows = np.stack([r for _, r in prepared])
-        b = _bucket(k, self.model.scorer.min_bucket)
-        if b != k:
-            rows = np.concatenate(
-                [rows, np.zeros((b - k, rows.shape[1]), rows.dtype)]
-            )
+        scorer = self.model.scorer
+        slot = scorer.staging.acquire(_bucket(k, scorer.min_bucket))
         try:
-            scores = self.model.scorer.predict_proba(rows)[:k]
-            phis, expected_value = self.model.explain_batch(rows)
+            np.stack([r for _, r in prepared], out=slot.f32[:k])
+            slot.f32[k:] = 0.0
+            scores = scorer.predict_proba(slot.f32)[:k]
+            phis, expected_value = self.model.explain_batch(slot.f32)
             phis = phis[:k]
         except Exception as e:  # graftcheck: ignore[silent-except] — captured into outcome, settled+logged by _settle
             # device failure fails the whole batch
             for t, _ in prepared:
                 outcome[t.id] = e
             return outcome
+        finally:
+            # both calls fetched their results (sync d2h), so the staged
+            # bytes are consumed and the slot can recycle
+            scorer.staging.release(slot)
         names = self.model.feature_names
         for (t, _), score, phi in zip(prepared, scores, phis):
             tx_id, _, corr_id, traceparent = (t.args + [None] * 4)[:4]

@@ -65,6 +65,7 @@ WRAP_TARGETS: dict[str, list[tuple[str, str]]] = {
         ("fraud_detection_tpu.ops.pallas_kernels", "fused_score"),
     ],
     "drift_window": [("fraud_detection_tpu.monitor.drift", "_window_update")],
+    "fastlane.flush": [("fraud_detection_tpu.monitor.drift", "_fused_flush")],
     "gate": [("fraud_detection_tpu.lifecycle.gate", "_gate_stats")],
     "linear_shap": [
         ("fraud_detection_tpu.ops.linear_shap", "linear_shap"),

@@ -1,0 +1,28 @@
+"""Known-bad fixture for the hot-path-alloc rule: fresh array allocations
+inside regions marked ``# graftcheck: hot-path``."""
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def flush(batch):
+    # graftcheck: hot-path — per-flush serving path
+    rows = np.stack(batch)  # finding: bare np.stack (no out=)
+    padded = np.concatenate([rows, np.zeros((8, 30), np.float32)])
+    # ^ two findings: np.concatenate without out= AND the np.zeros tail
+    pad = np.empty((8, 30), np.float32)  # finding: np.empty
+    mask = jnp.zeros((8,))  # finding: jnp.zeros
+    return rows, padded, pad, mask
+
+
+def nested_region(batch):
+    def inner(rows):
+        # graftcheck: hot-path
+        return np.ones_like(rows)  # finding: marker binds the INNER fn
+
+    return inner(np.asarray(batch))
+
+
+def cold_path(batch):
+    # no marker: allocation churn here is nobody's business
+    return np.zeros((len(batch), 30))

@@ -1,0 +1,28 @@
+"""Known-good fixture for the hot-path-alloc rule: marked regions reuse
+preallocated buffers (the StagingPool discipline); allocations live outside
+the marked regions or carry a reviewed ignore tag."""
+
+import numpy as np
+
+_BUF = np.zeros((1024, 30), np.float32)  # module init: allowed
+_VALID = np.zeros((1024,), np.float32)
+
+
+def flush(rows):
+    # graftcheck: hot-path — stacks into the preallocated staging buffer
+    n = len(rows)
+    np.stack(rows, out=_BUF[:n])
+    _BUF[n:] = 0.0
+    _VALID[:n] = 1.0
+    _VALID[n:] = 0.0
+    return _BUF, _VALID
+
+
+def flush_with_reviewed_alloc(rows):
+    # graftcheck: hot-path
+    tmp = np.zeros((4,), np.float32)  # graftcheck: ignore[hot-path-alloc] — tiny, reviewed
+    return rows, tmp
+
+
+def cold_builder():
+    return np.zeros((1024, 30), np.float32)

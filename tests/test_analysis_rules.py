@@ -55,6 +55,19 @@ def test_donate_rule_true_positive():
     assert "params" in f.message and "opt_state" in f.message
 
 
+def test_hot_path_alloc_true_positives():
+    counts, findings = rule_counts("bad_hot_path_alloc.py")
+    assert counts["hot-path-alloc"] == 6, findings
+    msgs = [f.message for f in findings if f.rule_id == "hot-path-alloc"]
+    # the exact pre-fastlane regression: a bare per-flush np.stack
+    assert any("np.stack" in m and "without out=" in m for m in msgs), msgs
+    assert any("np.concatenate" in m for m in msgs), msgs
+    # the marker binds the INNERMOST enclosing function
+    assert any("'inner'" in m for m in msgs), msgs
+    # unmarked functions are never flagged
+    assert not any("cold_path" in m for m in msgs), msgs
+
+
 def test_service_rules_true_positives():
     counts, findings = rule_counts("bad_service.py")
     assert counts["socket-no-timeout"] == 3, findings
@@ -73,6 +86,7 @@ def test_service_rules_true_positives():
         "good_donate.py",
         "good_service.py",
         "good_prometheus.py",
+        "good_hot_path_alloc.py",
     ],
 )
 def test_good_fixtures_are_clean(good):

@@ -138,6 +138,38 @@ def test_telemetry_alert_metrics_exist_in_registry():
     assert not missing, f"alert rules reference unexported metrics: {missing}"
 
 
+def test_fastlane_alert_and_panels_present():
+    """The fastlane contract (ISSUE 5): the FlushDispatchRegression alert
+    ships promlint-clean, its gauge is exported by service/metrics.py, and
+    both dashboards carry the queue-depth / effective-wait /
+    device-calls-per-flush panels."""
+    path = os.path.join(RULES_DIR, "telemetry-alerts.yml")
+    with open(path) as f:
+        text = f.read()
+    assert "FlushDispatchRegression" in text
+    assert "scorer_flushes_total" in text
+    assert promlint.lint_rules_file(path) == []
+    exported = _exported_metric_names()
+    for name in (
+        "scorer_device_calls_per_flush",
+        "scorer_flushes",  # counter: exposition names it scorer_flushes_total
+        "scorer_queue_depth",
+        "scorer_effective_wait_seconds",
+    ):
+        assert name in exported or f"{name}_total" in exported, (
+            f"{name} not exported by service/metrics.py"
+        )
+    for rel in (
+        "grafana_dashboard.json",
+        os.path.join("grafana_provisioning", "dashboards", "fraud-tpu.json"),
+    ):
+        with open(os.path.join(MONITORING, rel)) as f:
+            dash = f.read()
+        assert "scorer_queue_depth" in dash, rel
+        assert "scorer_effective_wait_seconds" in dash, rel
+        assert "scorer_device_calls_per_flush" in dash, rel
+
+
 def test_grafana_waterfall_row_present():
     """The latency-waterfall row must ship in the dashboard with the stage
     histogram + compile counter exprs (promlint checks expr balance)."""
