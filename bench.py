@@ -64,6 +64,7 @@ SECTION_BUDGETS = {
     "microbatch_flush": 240,
     "telemetry": 240,
     "lifecycle": 240,
+    "scenarios": 420,
     "dp_train": 360,
     "online_load": 300,
     "worker_tasks": 300,
@@ -743,6 +744,38 @@ def bench_lifecycle(x, coef, intercept, mean, scale) -> dict[str, float]:
     }
 
 
+def bench_scenarios() -> dict:
+    """The fraud range (range/): run the seeded scenario suite against the
+    live in-process stack and record every invariant verdict in the JSON
+    trajectory. This is the closed-loop acceptance evidence — drift caught
+    within budget, exactly-once promotion under a mid-step kill, p99 held
+    through bursts and hot swaps, no alert flaps, bitwise-reproducible
+    windows. CI's ``chaos`` job publishes this section as
+    ``bench-scenarios.json``; the same scenarios back the ``-m slow`` test
+    tier (tests/test_range.py)."""
+    import tempfile
+
+    from fraud_detection_tpu.range.faults import ReplicaKilled
+    from fraud_detection_tpu.range.scenarios import SCENARIOS, run_scenario
+
+    results = {}
+    for name in SCENARIOS:
+        t0 = time.perf_counter()
+        try:
+            with tempfile.TemporaryDirectory(prefix=f"range-{name}-") as td:
+                r = run_scenario(name, tmpdir=td)
+            d = r.to_dict()
+        except (Exception, ReplicaKilled) as e:
+            # one broken scenario must not hide the rest — and ReplicaKilled
+            # is a BaseException by design (so production except-Exception
+            # ladders can't absorb it), so it needs naming here or a leaked
+            # kill aborts the whole bench line
+            d = {"scenario": name, "ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
+        d["wall_s"] = round(time.perf_counter() - t0, 2)
+        results[name] = d
+    return results
+
+
 def bench_shap_device(x, coef, intercept, mean) -> float:
     """Exact interventional linear SHAP values/sec on device (the async XAI
     hot loop, reference api/worker.py:73-79). Must run BEFORE any synchronous
@@ -1370,6 +1403,18 @@ def main() -> None:
             telemetry_overhead_ok=bool(
                 tel_res["telemetry_overhead_frac"] <= 0.05
             ),
+        )
+    scen_res = h.section("scenarios", bench_scenarios)
+    if scen_res:
+        h.update(
+            scenarios=scen_res,
+            scenarios_all_ok=bool(
+                all(d.get("ok") for d in scen_res.values())
+            ),
+            **{
+                f"scenario_{name}_ok": bool(d.get("ok"))
+                for name, d in scen_res.items()
+            },
         )
     lc_res = h.section("lifecycle", bench_lifecycle, x, coef, intercept,
                        mean, scale)

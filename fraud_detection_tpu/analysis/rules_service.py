@@ -282,6 +282,102 @@ def check_prom_foreign_registry(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+def _module_constants(mod: ModuleInfo) -> set[str]:
+    """Module-level names bound (once) to a numeric literal — the
+    ``RETRY_DELAY = 5.0`` pattern a constant-backoff loop sleeps on."""
+    consts: dict[str, int] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if (
+                isinstance(t, ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, (int, float))
+            ):
+                consts[t.id] = consts.get(t.id, 0) + 1
+    return {name for name, n in consts.items() if n == 1}
+
+
+def _is_constant_delay(arg: ast.AST, module_consts: set[str]) -> bool:
+    """True when a sleep argument provably evaluates to the same number on
+    every iteration: a literal, a module-level numeric constant, or a
+    unary +/- of one. Anything referencing loop state (``2 ** attempt``),
+    calls (``random()``, ``min(...)``), or unknown names is treated as a
+    real backoff — the rule must not guess."""
+    if isinstance(arg, ast.Constant):
+        return isinstance(arg.value, (int, float))
+    if isinstance(arg, ast.UnaryOp) and isinstance(
+        arg.op, (ast.USub, ast.UAdd)
+    ):
+        return _is_constant_delay(arg.operand, module_consts)
+    if isinstance(arg, ast.Name):
+        return arg.id in module_consts
+    return False
+
+
+def _walk_skip_nested_funcs(node: ast.AST):
+    """Walk a loop body without descending into nested function defs —
+    a closure defined inside the loop runs on its own schedule."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if not isinstance(cur, _FuncDef):
+            stack.extend(ast.iter_child_nodes(cur))
+
+
+def _sleep_names(mod: ModuleInfo) -> set[str]:
+    """Dotted callee names that mean ``time.sleep`` in this module
+    (``time.sleep`` itself plus ``from time import sleep [as s]``)."""
+    names = {"time.sleep"}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "sleep":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+@register_rule(
+    "retry-no-backoff",
+    Severity.WARNING,
+    "retry loop sleeping a constant (or zero) delay — every client retries "
+    "in lockstep and hammers the failing dependency exactly when it is "
+    "least able to answer; use bounded exponential backoff with jitter "
+    "(the netclient.call pattern)",
+)
+def check_retry_no_backoff(mod: ModuleInfo) -> Iterator[Finding]:
+    """A *retry* loop is a for/while whose body handles exceptions (the
+    try/except-continue idiom); a constant ``time.sleep`` inside one never
+    backs off. Poll/serve loops without exception handling are exempt —
+    waking every N seconds to check a queue is a schedule, not a retry."""
+    rule = check_retry_no_backoff.rule
+    module_consts = _module_constants(mod)
+    sleep_names = _sleep_names(mod)
+    flagged: set[int] = set()  # id() — nested loops walk shared subtrees
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, (ast.While, ast.For)):
+            continue
+        body_nodes = list(_walk_skip_nested_funcs(loop))
+        if not any(isinstance(n, ast.ExceptHandler) for n in body_nodes):
+            continue  # not a retry loop
+        for node in body_nodes:
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            if id(node) in flagged:
+                continue
+            if dotted_name(node.func) not in sleep_names:
+                continue
+            if _is_constant_delay(node.args[0], module_consts):
+                flagged.add(id(node))
+                yield mod.finding(
+                    rule, node,
+                    "retry loop sleeps a constant delay — no exponential "
+                    "backoff, no jitter; a dependency outage gets hammered "
+                    "at a fixed frequency by every replica at once",
+                )
+
+
 def _join_targets(mod: ModuleInfo) -> set[str]:
     out: set[str] = set()
     for node in ast.walk(mod.tree):

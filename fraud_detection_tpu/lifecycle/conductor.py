@@ -55,6 +55,7 @@ from fraud_detection_tpu import config
 from fraud_detection_tpu.lifecycle import store as st
 from fraud_detection_tpu.lifecycle.retrain import RetrainResult, run_retrain
 from fraud_detection_tpu.lifecycle.store import LifecycleStore
+from fraud_detection_tpu.range.faults import fire
 from fraud_detection_tpu.service import metrics
 
 log = logging.getLogger("fraud_detection_tpu.lifecycle")
@@ -275,6 +276,10 @@ class Conductor:
             )
             return {"outcome": "lost_ownership", "version": version}
         self._export_state(st.GATED)
+        # fraud-range kill point: challenger registered + intent persisted,
+        # @shadow alias not yet written — resume() must re-alias, not
+        # re-register (the duplicate-registration drill)
+        fire("conductor.gated.pre_alias", version=version)
         self.registry.set_alias(self.name, config.shadow_stage(), version)
         if not self.store.transition(self.name, (st.GATED,), st.SHADOWING):
             return self._shadow_alias_lost_race(version)
@@ -358,8 +363,16 @@ class Conductor:
             )
             self._export_state(st.ROLLED_BACK)
             return {"outcome": "failed", "error": "no recorded target version"}
+        # fraud-range kill points around the promotion's registry writes:
+        # pre_alias = intent persisted, nothing applied; mid_alias = @prod
+        # moved but @shadow not yet dropped; pre_finalize = both applied,
+        # DONE not recorded. resume() must converge every one of them to
+        # exactly-once promotion.
+        fire("conductor.promoting.pre_alias", target=target)
         self.registry.set_alias(self.name, config.model_stage(), int(target))
+        fire("conductor.promoting.mid_alias", target=target)
         self.registry.delete_alias(self.name, config.shadow_stage())
+        fire("conductor.promoting.pre_finalize", target=target)
         if not self.store.transition(self.name, (st.PROMOTING,), st.DONE):
             # a concurrent rollback won PROMOTING → ROLLING_BACK while our
             # alias writes were in flight; the state machine picked IT, so
@@ -405,6 +418,9 @@ class Conductor:
             )
             self._export_state(st.ROLLED_BACK)
             return {"outcome": "failed", "error": "no prior champion recorded"}
+        # fraud-range kill point: rollback intent persisted, alias restore
+        # not yet applied — resume() completes it
+        fire("conductor.rolling_back.pre_alias", prior=prior)
         self.registry.set_alias(self.name, config.model_stage(), int(prior))
         self.registry.delete_alias(self.name, config.shadow_stage())
         if not self.store.transition(

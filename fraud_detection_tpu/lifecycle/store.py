@@ -44,6 +44,7 @@ from typing import Any, Iterable
 import numpy as np
 
 from fraud_detection_tpu import config
+from fraud_detection_tpu.range.faults import fire
 
 log = logging.getLogger("fraud_detection_tpu.lifecycle")
 
@@ -194,9 +195,31 @@ class LifecycleStore:
             feats = feats[None, :]
         scores = np.asarray(scores, np.float64).reshape(-1)
         labels = np.asarray(labels).reshape(-1)
+        # fraud-range injection point: the poisoned-feedback drill corrupts
+        # the batch in flight here; the guards below are the blast door
+        fire(
+            "lifecycle.store.add_feedback",
+            features=feats, scores=scores, labels=labels,
+        )
         n = feats.shape[0]
         if not (scores.shape[0] == n and labels.shape[0] == n):
             raise ValueError("features/scores/labels must have equal length")
+        # Poison guard: this store feeds the conductor's retrain replay and
+        # the challenger gate — a NaN/Inf row or out-of-range score would
+        # silently corrupt the training mix and NaN the gate statistics
+        # (which fail closed, bricking promotion). /monitor/feedback
+        # validates at the API edge; queue-delivered feedback
+        # (lifecycle.record_feedback) and embedded callers land here, so
+        # the store is the boundary that must hold.
+        if not np.all(np.isfinite(feats)):
+            raise ValueError("feedback features must be finite")
+        if not (
+            np.all(np.isfinite(scores))
+            and np.all((scores >= 0.0) & (scores <= 1.0))
+        ):
+            raise ValueError("feedback scores must be probabilities in [0, 1]")
+        if not np.all((labels == 0) | (labels == 1)):
+            raise ValueError("feedback labels must be 0 or 1")
         now = time.time()
         with self._lock, self._conn:
             seq = self._meta_get("seq")
@@ -301,6 +324,10 @@ class LifecycleStore:
 
     # -- conductor state machine -------------------------------------------
     def get_state(self, name: str) -> dict:
+        # fraud-range injection point: a chaos plan stalls/errors the
+        # lifecycle store read here — the /lifecycle/status degradation
+        # drill (503 + Retry-After instead of a hung 500)
+        fire("lifecycle.store.get_state", name=name)
         with self._lock:
             row = self._conn.execute(
                 "SELECT * FROM lifecycle_state WHERE name = ?", (name,)

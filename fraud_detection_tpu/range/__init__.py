@@ -1,0 +1,44 @@
+"""The fraud range: adversarial traffic simulation + closed-loop chaos.
+
+Submodules (imported lazily — production code imports ``range.faults``
+alone, which must stay stdlib-light because its ``fire()`` hook sits on
+the serving flush):
+
+- :mod:`fraud_detection_tpu.range.faults` — the :class:`FaultPlan`
+  injector behind the named injection points in lifecycle/conductor.py,
+  service/taskq.py, service/netclient.py, lifecycle/store.py, and
+  service/microbatch.py;
+- :mod:`fraud_detection_tpu.range.traffic` — seeded campaign generators
+  (diurnal bursts, drift onsets, fraud rings, label delay/noise);
+- :mod:`fraud_detection_tpu.range.invariants` — the end-to-end invariant
+  checks + the alert-flap detector;
+- :mod:`fraud_detection_tpu.range.scenarios` — the named scenario suite
+  (``run_scenario``), shared by ``bench.py``'s ``scenarios`` section and
+  the ``-m slow`` chaos test tier.
+
+See docs/runbooks/ChaosDrills.md for how to drive a drill by hand.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "FaultPlan": ("fraud_detection_tpu.range.faults", "FaultPlan"),
+    "ReplicaKilled": ("fraud_detection_tpu.range.faults", "ReplicaKilled"),
+    "ScenarioResult": (
+        "fraud_detection_tpu.range.invariants", "ScenarioResult"
+    ),
+    "SCENARIOS": ("fraud_detection_tpu.range.scenarios", "SCENARIOS"),
+    "run_scenario": ("fraud_detection_tpu.range.scenarios", "run_scenario"),
+}
+
+__all__ = list(_LAZY)
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr)

@@ -1,0 +1,773 @@
+"""The fraud range: named chaos scenarios against the live in-process stack.
+
+Each scenario builds the REAL serving pieces (jitted ``BatchScorer`` behind
+the micro-batcher, watchtower drift window, conductor CAS state machine,
+sqlite broker) — no stubs — replays a seeded synthetic campaign
+(range/traffic.py), optionally arms a :class:`~.faults.FaultPlan`
+(range/faults.py), and asserts the end-to-end invariants
+(range/invariants.py). Results serialize into the bench JSON trajectory
+(``bench.py`` ``scenarios`` section) and drive the ``-m slow`` chaos test
+tier (tests/test_range.py, CI ``chaos`` job).
+
+The suite (``run_scenario(name)``):
+
+========================  ==================================================
+``burst``                 heavy-tailed diurnal arrival bursts through the
+                          micro-batcher; p99 holds, every row scored, no
+                          alert flap
+``drift_onset``           covariate drift at a known onset row; detected
+                          within the row budget, drift window ends
+                          bitwise-consistent across two seeded runs
+``fraud_ring``            coordinated correlated-feature rings; the model
+                          separates ring rows AND the drift monitor flags
+                          the contamination within budget
+``label_delay``           delayed + noisy labels, one poisoned feedback
+                          batch; the store rejects poison, clean rows land
+                          durably and in the calibration window, ECE stays
+                          finite
+``control_plane_chaos``   replica killed mid-promotion + duplicate task
+                          delivery past the visibility timeout; promotion
+                          converges to exactly-once on resume
+``hot_swap``              champion hot-swapped mid-burst; p99 holds across
+                          the swap, zero new XLA compiles (no recompile
+                          storm), every row scored
+========================  ==================================================
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from fraud_detection_tpu.range import faults
+from fraud_detection_tpu.range.invariants import (
+    AlertFlapDetector,
+    InvariantOutcome,
+    ScenarioResult,
+    drift_detected_within,
+    exactly_once_promotion,
+    p99_within,
+    windows_bitwise_equal,
+)
+from fraud_detection_tpu.range.traffic import (
+    ArrivalProcess,
+    CampaignSpec,
+    CampaignTraffic,
+    DelayedLabelJoiner,
+    DriftCampaign,
+    FraudRing,
+    LabelFeedback,
+)
+
+KAGGLE = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+D = 30
+
+
+# -- environment builders ----------------------------------------------------
+
+@dataclass
+class RangeModel:
+    """A trained-for-real champion + its baseline profile + the ground
+    truth boundary the traffic generators share."""
+
+    model: object
+    profile: object
+    w_true: np.ndarray
+    x_base: np.ndarray
+    y_base: np.ndarray
+
+
+def _make_rows(n: int, rng: np.random.Generator, w_true: np.ndarray):
+    x = rng.standard_normal((n, D)).astype(np.float32)
+    logits = x @ w_true - 2.0
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logits))).astype(np.int32)
+    return x, y
+
+
+def build_model(seed: int = 7, n_base: int = 2400) -> RangeModel:
+    """Fit a small logistic champion on synthetic Kaggle-schema data and
+    profile it — the real scorer/profile pair every scenario serves."""
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+    from fraud_detection_tpu.monitor.baseline import build_baseline_profile
+    from fraud_detection_tpu.ops.logistic import logistic_fit_lbfgs
+    from fraud_detection_tpu.ops.scaler import scaler_fit, scaler_transform
+
+    rng = np.random.default_rng(seed)
+    w_true = rng.standard_normal(D).astype(np.float32)
+    x, y = _make_rows(n_base, rng, w_true)
+    scaler = scaler_fit(x)
+    params = logistic_fit_lbfgs(scaler_transform(scaler, x), y, max_iter=100)
+    model = FraudLogisticModel(params, scaler, KAGGLE)
+    scores = np.asarray(model.scorer.predict_proba(x[:1024]))
+    profile = build_baseline_profile(x, scores, feature_names=KAGGLE)
+    return RangeModel(model, profile, w_true, x, y)
+
+
+def _watchtower(profile, min_rows: int = 256, halflife: float = 1500.0):
+    from fraud_detection_tpu.monitor.watchtower import Thresholds, Watchtower
+
+    thr = Thresholds(
+        psi=0.2, ks=0.15, ece=0.2, disagree=0.05, min_rows=min_rows
+    )
+    return Watchtower(
+        profile, thresholds=thr, halflife_rows=halflife, max_backlog=256
+    )
+
+
+# -- shared drivers ----------------------------------------------------------
+
+async def _timed_score(batcher, row, lat: list[float]) -> float:
+    t0 = time.perf_counter()
+    s = await batcher.score(row)
+    lat.append(time.perf_counter() - t0)
+    return s
+
+
+async def _baseline_p99(batcher, rows: np.ndarray) -> float:
+    """Quiet-traffic per-request latency floor: sequential lone requests."""
+    lat: list[float] = []
+    for r in rows:
+        await _timed_score(batcher, r, lat)
+    return float(np.percentile(np.asarray(lat), 99))
+
+
+def _drive_bursts(
+    batcher_factory,
+    traffic: CampaignTraffic,
+    on_batch=None,
+    mid_stream=None,
+) -> dict:
+    """Replay a campaign through a micro-batcher on a private event loop.
+
+    ``on_batch(batch, scores)`` runs after each batch resolves;
+    ``mid_stream(batcher)`` fires once, halfway through the campaign (the
+    hot-swap hook). Returns latencies, scores and counters.
+    """
+
+    async def run() -> dict:
+        batcher = batcher_factory()
+        await batcher.start()
+        try:
+            warm = traffic.rng.standard_normal((64, D)).astype(np.float32)
+            base_p99 = await _baseline_p99(batcher, warm)
+            lat: list[float] = []
+            n_scored = 0
+            batches = list(traffic.batches())
+            fire_mid = len(batches) // 2
+            mid_fut = None
+            for bi, batch in enumerate(batches):
+                if mid_stream is not None and bi == fire_mid:
+                    # launch WITHOUT awaiting: requests must genuinely
+                    # overlap the swap, or the p99-across-swap invariant
+                    # passes vacuously (a swap that blocks serving would
+                    # add zero latency to any measured request otherwise)
+                    mid_fut = asyncio.get_running_loop().run_in_executor(
+                        None, mid_stream, batcher
+                    )
+                scores = await asyncio.gather(
+                    *(_timed_score(batcher, r, lat) for r in batch.rows)
+                )
+                n_scored += len(scores)
+                if on_batch is not None:
+                    on_batch(batch, np.asarray(scores, np.float32))
+                await asyncio.sleep(traffic.spec.arrivals.window_s)
+            if mid_fut is not None:
+                await mid_fut
+            return {
+                "baseline_p99_s": base_p99,
+                "latencies_s": lat,
+                "rows_scored": n_scored,
+            }
+        finally:
+            await batcher.stop()
+
+    return asyncio.run(run())
+
+
+def _fold_campaign(
+    wt,
+    model,
+    traffic: CampaignTraffic,
+    sample_every: int = 4,
+    status_hook=None,
+    on_batch=None,
+) -> dict:
+    """Synchronous replay: score each batch with the real scorer, hand it
+    to the watchtower, drain, and sample status — the deterministic driver
+    the detection-latency and bitwise invariants need."""
+    flap = AlertFlapDetector()
+    detected_row: int | None = None
+    rows = 0
+    for bi, batch in enumerate(traffic.batches()):
+        scores = np.asarray(model.scorer.predict_proba(batch.rows), np.float32)
+        if on_batch is not None:
+            on_batch(batch, scores)
+        wt.observe(batch.rows, scores)
+        # drain per batch: the bounded ingest backlog must never drop a
+        # batch here — determinism (the bitwise invariant) depends on every
+        # batch folding, in order
+        wt.drain(timeout=30.0)
+        rows = batch.start_row + batch.rows.shape[0]
+        if bi % sample_every == 0:
+            status = wt.status()
+            flap.sample(drift=status["status"] == "drift")
+            if status["status"] == "drift" and detected_row is None:
+                detected_row = rows
+            if status_hook is not None:
+                status_hook(batch, scores, status)
+    wt.drain(timeout=30.0)
+    status = wt.status()
+    flap.sample(drift=status["status"] == "drift")
+    if status["status"] == "drift" and detected_row is None:
+        detected_row = rows
+    return {
+        "detected_row": detected_row,
+        "rows": rows,
+        "flap": flap,
+        "final_status": status,
+    }
+
+
+# -- scenarios ---------------------------------------------------------------
+
+def scenario_burst(seed: int = 2026, total_rows: int = 6144) -> ScenarioResult:
+    """Heavy-tailed diurnal bursts; the serving path holds its latency SLO
+    and loses nothing."""
+    from fraud_detection_tpu.service.microbatch import MicroBatcher
+
+    rm = build_model(seed=seed)
+    wt = _watchtower(rm.profile)
+    spec = CampaignSpec(
+        total_rows=total_rows, seed=seed, w_true=rm.w_true,
+        arrivals=ArrivalProcess(rate_hz=4000.0, window_s=0.01),
+    )
+    result = ScenarioResult("burst")
+    try:
+        out = _drive_bursts(
+            lambda: MicroBatcher(
+                scorer=rm.model.scorer, watchtower=wt,
+                max_batch=512, max_wait_ms=2.0, telemetry=False,
+            ),
+            CampaignTraffic(spec),
+        )
+    finally:
+        wt.close()
+    result.metrics = {
+        "rows": total_rows,
+        "rows_scored": out["rows_scored"],
+        "baseline_p99_ms": round(out["baseline_p99_s"] * 1e3, 3),
+        "burst_p99_ms": round(
+            float(np.percentile(out["latencies_s"], 99)) * 1e3, 3
+        ),
+    }
+    result.add(
+        p99_within(
+            out["latencies_s"], out["baseline_p99_s"],
+            factor=10.0, absolute_floor_s=0.25,
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "all-rows-scored",
+            out["rows_scored"] == total_rows,
+            f"{out['rows_scored']}/{total_rows} rows returned a score",
+        )
+    )
+    return result
+
+
+def scenario_drift_onset(
+    seed: int = 2026, total_rows: int = 6144, onset_row: int = 2048,
+    budget_rows: int = 2048,
+) -> ScenarioResult:
+    """Covariate drift with a known onset; detection latency is bounded and
+    the window state is bitwise-reproducible per seed."""
+    rm = build_model(seed=seed)
+    drift = DriftCampaign(onset_row=onset_row, mean_shift=3.0)
+
+    def one_run():
+        wt = _watchtower(rm.profile)
+        spec = CampaignSpec(
+            total_rows=total_rows, seed=seed, w_true=rm.w_true, drift=drift
+        )
+        try:
+            out = _fold_campaign(wt, rm.model, CampaignTraffic(spec))
+            window = wt.drift.window
+        finally:
+            wt.close()
+        return out, window
+
+    out, window_a = one_run()
+    _, window_b = one_run()  # same seed → must end bitwise identical
+
+    result = ScenarioResult("drift_onset")
+    result.metrics = {
+        "rows": out["rows"],
+        "onset_row": onset_row,
+        "detected_row": out["detected_row"],
+        "feature_psi_max": round(
+            out["final_status"]["drift"]["feature_psi_max"], 4
+        ),
+    }
+    result.add(drift_detected_within(onset_row, out["detected_row"], budget_rows))
+    result.add(out["flap"].check())
+    result.add(windows_bitwise_equal(window_a, window_b))
+    return result
+
+
+def scenario_fraud_ring(
+    seed: int = 2026, total_rows: int = 6144, ring_start: int = 1536,
+    budget_rows: int = 3072,
+) -> ScenarioResult:
+    """Coordinated rings (correlated feature clusters): the scorer must
+    separate ring rows from background AND the drift monitor must flag the
+    contamination."""
+    rm = build_model(seed=seed)
+    # ~25% of post-onset traffic is ring rows (96 per 288 background): a
+    # mule-network burst heavy enough that NOT flagging it is a monitoring
+    # failure, not a threshold judgement call
+    ring = FraudRing(start_row=ring_start, n_rings=3, ring_size=96,
+                     every_rows=288, center_scale=5.0)
+    spec = CampaignSpec(
+        total_rows=total_rows, seed=seed, w_true=rm.w_true, ring=ring
+    )
+    wt = _watchtower(rm.profile)
+    ring_scores: list[float] = []
+    bg_scores: list[float] = []
+
+    def collect(batch, scores):
+        ring_scores.extend(scores[batch.ring_mask].tolist())
+        bg_scores.extend(scores[~batch.ring_mask].tolist())
+
+    try:
+        out = _fold_campaign(
+            wt, rm.model, CampaignTraffic(spec), on_batch=collect
+        )
+    finally:
+        wt.close()
+
+    result = ScenarioResult("fraud_ring")
+    ring_mean = float(np.mean(ring_scores)) if ring_scores else float("nan")
+    bg_mean = float(np.mean(bg_scores)) if bg_scores else float("nan")
+    result.metrics = {
+        "rows": out["rows"],
+        "ring_rows": len(ring_scores),
+        "ring_mean_score": round(ring_mean, 4),
+        "background_mean_score": round(bg_mean, 4),
+        "detected_row": out["detected_row"],
+    }
+    result.add(
+        InvariantOutcome(
+            "ring-rows-injected",
+            len(ring_scores) > 0,
+            f"{len(ring_scores)} ring rows generated",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "ring-separable",
+            bool(ring_scores) and abs(ring_mean - bg_mean) > 0.05,
+            f"ring mean score {ring_mean:.4f} vs background {bg_mean:.4f} — "
+            "a coordinated cluster must not score like background traffic",
+        )
+    )
+    result.add(drift_detected_within(ring_start, out["detected_row"], budget_rows))
+    result.add(out["flap"].check())
+    return result
+
+
+def scenario_label_delay(
+    tmpdir: str, seed: int = 2026, total_rows: int = 4096,
+    delay_rows: int = 1024, noise_rate: float = 0.05,
+) -> ScenarioResult:
+    """Delayed, noisy labels with one poisoned batch in flight: durable
+    feedback stays consistent, poison is rejected at the store boundary,
+    and calibration state stays finite."""
+    from fraud_detection_tpu.lifecycle.store import LifecycleStore
+
+    rm = build_model(seed=seed)
+    fb = LabelFeedback(delay_rows=delay_rows, noise_rate=noise_rate, batch=256)
+    spec = CampaignSpec(
+        total_rows=total_rows, seed=seed, w_true=rm.w_true, feedback=fb,
+        # huge half-life: decayed n_labeled ≈ true labeled count, so the
+        # bookkeeping invariant below is exact-ish
+    )
+    wt = _watchtower(rm.profile, halflife=10_000_000.0)
+    joiner = DelayedLabelJoiner(fb, seed)
+    store = LifecycleStore(
+        f"sqlite:///{os.path.join(tmpdir, 'range-lifecycle.db')}",
+        window_size=total_rows, reservoir_size=256,
+    )
+    delivered = 0
+    rejected_batches = 0
+
+    def poison(features=None, scores=None, labels=None, **_):
+        # corrupt the scores array in flight (review pipeline bug)
+        if scores is not None:
+            scores[:] = np.nan
+
+    plan = faults.FaultPlan().call(
+        "lifecycle.store.add_feedback", poison, times=1
+    )
+    try:
+        with plan.armed():
+            for batch in CampaignTraffic(spec).batches():
+                scores = np.asarray(
+                    rm.model.scorer.predict_proba(batch.rows), np.float32
+                )
+                wt.observe(batch.rows, scores)
+                joiner.observe(batch, scores)
+                current = batch.start_row + batch.rows.shape[0]
+                for fx, fs, fy in joiner.due(current):
+                    fs = fs.copy()  # the poison fault mutates in flight
+                    try:
+                        store.add_feedback(fx, fs, fy)
+                    except ValueError:
+                        rejected_batches += 1
+                        continue
+                    wt.observe(fx, fs, fy, calibration_only=True)
+                    delivered += fy.shape[0]
+        wt.drain(timeout=30.0)
+        status = wt.status()
+    finally:
+        counts = store.feedback_counts()
+        store.close()
+        wt.close()
+
+    result = ScenarioResult("label_delay")
+    n_labeled = float(status["drift"]["n_labeled"])
+    ece = float(status["drift"]["ece"])
+    result.metrics = {
+        "rows": total_rows,
+        "labels_released": joiner.released_rows,
+        "labels_flipped": joiner.flipped_rows,
+        "labels_delivered": delivered,
+        "poisoned_batches_rejected": rejected_batches,
+        "store_window_rows": counts["window"],
+        "ece": round(ece, 4),
+    }
+    result.add(
+        InvariantOutcome(
+            "poison-rejected",
+            rejected_batches == 1 and plan.fired() == 1,
+            f"{rejected_batches} poisoned batch(es) rejected at the store "
+            f"boundary ({plan.fired()} fault(s) fired)",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "feedback-durable",
+            counts["window"] == delivered and counts["seen"] == delivered,
+            f"store window {counts['window']} / seen {counts['seen']} vs "
+            f"{delivered} delivered rows",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "calibration-bookkeeping",
+            abs(n_labeled - delivered) <= max(2.0, 0.01 * delivered),
+            f"calibration window holds {n_labeled:.0f} labeled rows, "
+            f"{delivered} delivered",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "ece-finite",
+            np.isfinite(ece),
+            f"windowed ECE = {ece} over noisy delayed labels",
+        )
+    )
+    return result
+
+
+# -- lifecycle scenarios -----------------------------------------------------
+
+def build_lifecycle_env(tmpdir: str, seed: int = 7) -> dict:
+    """Registered champion + lifecycle store + conductor, self-contained in
+    ``tmpdir`` (no environment variables touched)."""
+    from fraud_detection_tpu.lifecycle import Conductor, GateThresholds, LifecycleStore
+    from fraud_detection_tpu.monitor.baseline import (
+        build_baseline_profile,
+        save_profile,
+    )
+    from fraud_detection_tpu.tracking.store import TrackingClient
+
+    rm = build_model(seed=seed)
+    csv = os.path.join(tmpdir, "base.csv")
+    with open(csv, "w") as f:
+        f.write(",".join(KAGGLE + ["Class"]) + "\n")
+        for row, label in zip(rm.x_base, rm.y_base):
+            f.write(",".join(f"{v:.6f}" for v in row) + f",{int(label)}\n")
+    art = os.path.join(tmpdir, "champion")
+    rm.model.save(art, joblib_too=False)
+    scores = np.asarray(rm.model.scorer.predict_proba(rm.x_base[:512]))
+    save_profile(
+        art, build_baseline_profile(rm.x_base, scores, feature_names=KAGGLE)
+    )
+    client = TrackingClient(uri=f"file:{os.path.join(tmpdir, 'mlruns')}")
+    v1 = client.registry.register("fraud", art)
+    client.registry.set_alias("fraud", "prod", v1)
+    store = LifecycleStore(
+        f"sqlite:///{os.path.join(tmpdir, 'lifecycle.db')}",
+        window_size=600, reservoir_size=200, seed=3,
+    )
+    loose = GateThresholds(
+        auc_margin=0.05, ece_bound=0.5, psi_bound=2.0, min_eval_rows=64
+    )
+    conductor = Conductor(
+        store=store,
+        tracking_client=client,
+        retrain_kwargs={
+            "data_csv": csv, "use_smote": False, "max_iter": 100,
+            "thresholds": loose,
+        },
+    )
+    return {
+        "rm": rm, "client": client, "registry": client.registry,
+        "store": store, "conductor": conductor, "v1": v1, "tmp": tmpdir,
+    }
+
+
+def _feed_store(env, n: int = 512, seed: int = 99) -> None:
+    rng = np.random.default_rng(seed)
+    x, y = _make_rows(n, rng, env["rm"].w_true)
+    s = 1.0 / (1.0 + np.exp(-(x @ env["rm"].w_true - 2.0)))
+    env["store"].add_feedback(x, s.astype(np.float32), y)
+
+
+def scenario_control_plane_chaos(
+    tmpdir: str, seed: int = 7, kill_point: str = "conductor.promoting.pre_alias",
+) -> ScenarioResult:
+    """The mid-promotion kill + duplicate-delivery drill: a replica dies at
+    ``kill_point`` with the promotion intent persisted; the promote task is
+    redelivered past a collapsed visibility window AND a second replica
+    resumes — the CAS machine must converge to exactly one promotion."""
+    from fraud_detection_tpu.lifecycle import Conductor
+    from fraud_detection_tpu.lifecycle import store as lst
+    from fraud_detection_tpu.service import metrics
+    from fraud_detection_tpu.service.taskq import SqliteBroker
+
+    env = build_lifecycle_env(tmpdir, seed=seed)
+    result = ScenarioResult("control_plane_chaos")
+    _feed_store(env, n=512, seed=seed + 1)
+
+    out = env["conductor"].handle_retrain("range: control-plane drill")
+    result.add(
+        InvariantOutcome(
+            "retrain-gated",
+            out.get("outcome") == "gated",
+            f"retrain outcome {out.get('outcome')!r}",
+        )
+    )
+    if out.get("outcome") != "gated":
+        return result
+    v2 = out["version"]
+    versions_before = env["registry"].latest_version("fraud")
+    promos_before = metrics.lifecycle_promotions._value.get()
+
+    # --- duplicate delivery: the promote task redelivered past a collapsed
+    # visibility window (simulating a worker that claimed, then stalled)
+    broker = SqliteBroker(f"sqlite:///{os.path.join(tmpdir, 'taskq.db')}")
+    redeliveries_before = broker.redeliveries
+    plan = (
+        faults.FaultPlan()
+        .kill(kill_point)
+        .patch("taskq.visibility_timeout", 0.0, times=1)
+    )
+    killed = False
+    with plan.armed():
+        broker.send_task("lifecycle.promote_challenger", ["range drill"])
+        first = broker.claim("worker-a")  # visibility collapsed to 0 → stays deliverable
+        second = broker.claim("worker-b")  # the at-least-once redelivery
+        try:
+            env["conductor"].handle_promote("range drill")
+        except faults.ReplicaKilled:
+            killed = True  # replica died mid-promotion, intent persisted
+    result.add(
+        InvariantOutcome(
+            "fault-fired",
+            killed and plan.fired(kill_point) == 1,
+            f"kill at {kill_point}: fired={plan.fired(kill_point)}",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "task-redelivered",
+            first is not None and second is not None
+            and second.id == first.id
+            and broker.redeliveries - redeliveries_before >= 1,
+            "collapsed visibility window produced an observable redelivery "
+            f"(redeliveries +{broker.redeliveries - redeliveries_before})",
+        )
+    )
+    state = env["store"].get_state("fraud")["state"]
+    result.add(
+        InvariantOutcome(
+            "intent-persisted",
+            state in (lst.PROMOTING, lst.SHADOWING),
+            f"state after kill = {state!r} (intent must be durable)",
+        )
+    )
+
+    # --- two replicas resume concurrently-ish: the first completes the
+    # promotion, the second finds nothing to do
+    replica_b = Conductor(store=env["store"], tracking_client=env["client"])
+    resumed = replica_b.resume()
+    replica_c = Conductor(store=env["store"], tracking_client=env["client"])
+    resumed_again = replica_c.resume()
+    dup = env["conductor"].handle_promote("duplicate delivery replay")
+    broker.ack(second.id)
+    broker.close()
+
+    result.metrics = {
+        "kill_point": kill_point,
+        "challenger_version": v2,
+        "resume_outcome": (resumed or {}).get("outcome"),
+        "second_resume": resumed_again,
+        "duplicate_promote_outcome": dup.get("outcome"),
+        "redeliveries": broker.redeliveries - redeliveries_before,
+    }
+    result.add(
+        InvariantOutcome(
+            "resume-completes",
+            (resumed or {}).get("outcome") == "promoted"
+            and resumed_again is None,
+            f"first resume {resumed!r}, second resume {resumed_again!r}",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "duplicate-promote-dropped",
+            dup.get("outcome") in ("skipped", "no_challenger"),
+            f"replayed promote task outcome {dup.get('outcome')!r}",
+        )
+    )
+    promos_delta = metrics.lifecycle_promotions._value.get() - promos_before
+    result.add(
+        exactly_once_promotion(
+            env["registry"], env["store"], "fraud",
+            challenger_version=v2, versions_before=versions_before,
+            promotions_delta=promos_delta,
+        )
+    )
+    env["store"].close()
+    return result
+
+
+def scenario_hot_swap(
+    seed: int = 2026, total_rows: int = 4096
+) -> ScenarioResult:
+    """Champion hot swap under burst traffic: the slot flip lands between
+    flushes with p99 intact and ZERO new XLA compiles (the ladder was
+    pre-warmed — a swap must never page RecompileStorm)."""
+    from fraud_detection_tpu.lifecycle.swap import ModelSlot, warm_scorer
+    from fraud_detection_tpu.monitor import drift as drift_mod
+    from fraud_detection_tpu.service.microbatch import MicroBatcher
+
+    rm = build_model(seed=seed)
+    challenger = build_model(seed=seed + 1)
+    wt = _watchtower(rm.profile)
+    slot = ModelSlot(rm.model, "range:champion", 1)
+    spec = CampaignSpec(
+        total_rows=total_rows, seed=seed, w_true=rm.w_true,
+        arrivals=ArrivalProcess(rate_hz=4000.0, window_s=0.01),
+    )
+    swap_state = {"compiles_delta": None, "swapped": False}
+
+    def swap(batcher) -> None:
+        # what ModelReloader does on an alias flip, minus the registry:
+        # warm the incoming ladder off-path, THEN flip the slot
+        warm_scorer(challenger.model.scorer, max_batch=512)
+        before = drift_mod._fused_flush._cache_size()
+        slot.swap(challenger.model, "range:challenger", 2)
+        swap_state["compiles_before"] = before
+        swap_state["swapped"] = True
+
+    result = ScenarioResult("hot_swap")
+    try:
+        out = _drive_bursts(
+            lambda: MicroBatcher(
+                slot=slot, watchtower=wt,
+                max_batch=512, max_wait_ms=2.0, telemetry=False,
+            ),
+            CampaignTraffic(spec),
+            mid_stream=swap,
+        )
+        compiles_after = drift_mod._fused_flush._cache_size()
+    finally:
+        wt.close()
+
+    compiles_delta = (
+        compiles_after - swap_state.get("compiles_before", compiles_after)
+        if swap_state["swapped"]
+        else None
+    )
+    result.metrics = {
+        "rows": total_rows,
+        "rows_scored": out["rows_scored"],
+        "baseline_p99_ms": round(out["baseline_p99_s"] * 1e3, 3),
+        "swap_p99_ms": round(
+            float(np.percentile(out["latencies_s"], 99)) * 1e3, 3
+        ),
+        "post_swap_compiles": compiles_delta,
+    }
+    result.add(
+        InvariantOutcome(
+            "swap-applied",
+            swap_state["swapped"] and slot.version == 2,
+            f"slot now serves v{slot.version}",
+        )
+    )
+    result.add(
+        p99_within(
+            out["latencies_s"], out["baseline_p99_s"],
+            factor=10.0, absolute_floor_s=0.25,
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "no-recompile-storm",
+            compiles_delta == 0,
+            f"{compiles_delta} fused-flush executables compiled after the "
+            "pre-warmed swap (must be 0)",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "all-rows-scored",
+            out["rows_scored"] == total_rows,
+            f"{out['rows_scored']}/{total_rows} rows returned a score",
+        )
+    )
+    return result
+
+
+# -- registry ----------------------------------------------------------------
+
+SCENARIOS = {
+    "burst": scenario_burst,
+    "drift_onset": scenario_drift_onset,
+    "fraud_ring": scenario_fraud_ring,
+    "label_delay": scenario_label_delay,
+    "control_plane_chaos": scenario_control_plane_chaos,
+    "hot_swap": scenario_hot_swap,
+}
+
+#: scenarios that need a scratch directory as their first argument
+NEEDS_TMPDIR = ("label_delay", "control_plane_chaos")
+
+
+def run_scenario(name: str, tmpdir: str | None = None, **kw) -> ScenarioResult:
+    fn = SCENARIOS[name]
+    if name in NEEDS_TMPDIR:
+        if tmpdir is None:
+            import tempfile
+
+            with tempfile.TemporaryDirectory(prefix=f"range-{name}-") as td:
+                return fn(td, **kw)
+        return fn(tmpdir, **kw)
+    return fn(**kw)

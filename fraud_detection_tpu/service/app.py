@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import sqlite3
 import time
 import uuid
 
@@ -73,6 +74,29 @@ from fraud_detection_tpu.telemetry import devicemem
 log = logging.getLogger("fraud_detection_tpu.api")
 
 TASK_NAME = "xai_tasks.compute_shap"  # reference task name (api/worker.py:65)
+
+# Store-outage surface: the exception classes a lifecycle-store call raises
+# once the client's retry budget is exhausted (netclient backoff ≈ 6.5 s,
+# sqlite busy timeout, raw socket death on the PG wire). Endpoints that ride
+# the store answer 503 + Retry-After instead of a 500-after-a-hang so
+# clients back off for one failover window rather than hammering a dead
+# primary (docs/runbooks/ChaosDrills.md, store-stall drill).
+from fraud_detection_tpu.service.errors import StoreError
+
+_STORE_OUTAGE_ERRORS = (sqlite3.Error, StoreError, OSError)
+STORE_RETRY_AFTER_S = 10  # ≥ the net client's exhausted retry budget
+
+
+def _store_unavailable(what: str, e: Exception) -> Response:
+    log.warning("%s unavailable (store outage): %s", what, e)
+    return Response(
+        {
+            "error": f"{what} temporarily unavailable — store outage",
+            "detail": str(e),
+        },
+        status_code=503,
+        headers={"retry-after": str(STORE_RETRY_AFTER_S)},
+    )
 
 
 _frontend_cache: dict[str | None, bytes | None] = {}
@@ -474,6 +498,12 @@ def create_app(
                     "equal length"
                 )
             rows = np.stack([model.prepare_row(f) for f in feats])
+            if not np.all(np.isfinite(rows)):
+                # mirror the store's poison guard at the edge: without this
+                # the guard's ValueError lands in the best-effort persist
+                # path below and the client reads 202 for a batch the
+                # durable pool permanently rejected
+                raise ValueError("'features' must be finite numbers")
             scores_arr = np.asarray(scores, np.float32)
             labels_arr = np.asarray(labels, np.float32)
             if scores_arr.ndim != 1 or labels_arr.ndim != 1:
@@ -509,6 +539,14 @@ def create_app(
                     rows, scores_arr, labels_arr,
                 )
                 persisted = True
+            except _STORE_OUTAGE_ERRORS as e:
+                # Store down/stalled past the client's retry budget: tell
+                # the joiner to retry later instead of 500-after-a-hang.
+                # The in-memory calibration window already queued the rows
+                # (advisory state — a retried batch double-counts there at
+                # worst); the DURABLE training pool never got them, so the
+                # retry cannot duplicate training data.
+                return _store_unavailable("feedback persistence", e)
             except Exception:
                 log.warning("feedback persistence failed", exc_info=True)
         return Response(
@@ -537,7 +575,10 @@ def create_app(
             s["enabled"] = True
             return s
 
-        return Response(await asyncio.to_thread(_read))
+        try:
+            return Response(await asyncio.to_thread(_read))
+        except _STORE_OUTAGE_ERRORS as e:
+            return _store_unavailable("lifecycle status", e)
 
     @app.get("/debug/flightrecorder")
     async def flightrecorder(req: Request) -> Response:

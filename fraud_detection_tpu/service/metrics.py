@@ -66,6 +66,23 @@ xai_task_failures = Counter(
 queue_depth = Gauge(
     "xai_queue_depth", "Queued XAI tasks (KEDA scaling signal)", registry=registry
 )
+# At-least-once delivery observability (the fraud range's chaos drills and
+# the WorkerBacklog runbook read these instead of inferring redelivery from
+# log archaeology). Incremented in the broker engines (taskq.py), so every
+# backend — sqlite, PG, and the network store server hosting a SqliteBroker
+# (netserver.py) — reports through the process that performed the claim.
+taskq_redeliveries = Counter(
+    "taskq_redeliveries",
+    "Task deliveries beyond the first: a visibility-timeout expiry handed "
+    "the task to another worker, or a nacked task was retried",
+    registry=registry,
+)
+taskq_expired_claims = Counter(
+    "taskq_expired_claims",
+    "Claims whose visibility window lapsed before ack/nack (worker death "
+    "or stall mid-task) — the acks-late redelivery trigger",
+    registry=registry,
+)
 model_loaded = Gauge(
     "model_loaded",
     "1 when a servable model is loaded (ModelUnavailable alert signal)",

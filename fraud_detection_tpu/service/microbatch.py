@@ -60,6 +60,7 @@ import numpy as np
 
 from fraud_detection_tpu import config
 from fraud_detection_tpu.ops.scorer import BatchScorer, _bucket
+from fraud_detection_tpu.range.faults import fire
 from fraud_detection_tpu.service import metrics, tracing
 from fraud_detection_tpu.telemetry.timeline import STAGES, FlushInfo
 from fraud_detection_tpu.utils.profiling import annotate
@@ -339,6 +340,10 @@ class MicroBatcher:
         # fresh batch arrays (bench.py microbatch_flush asserts this)
         import jax.numpy as jnp
 
+        # fraud-range injection point: a chaos plan adds device-latency or
+        # fails a flush here. Disarmed (the default) this is one global
+        # load — no allocation, priced inside the ≤5% telemetry bench gate.
+        fire("microbatch.flush")
         n = len(batch)
         staging = scorer.staging
         slot = staging.acquire(_bucket(n, scorer.min_bucket))

@@ -24,6 +24,7 @@ trips/sec on loopback).
 
 from __future__ import annotations
 
+import random
 import socket
 import threading
 import time
@@ -31,6 +32,7 @@ import uuid
 from typing import Any
 
 from fraud_detection_tpu import config
+from fraud_detection_tpu.range.faults import fire
 from fraud_detection_tpu.service.errors import (
     BrokerError,
     DatabaseError,
@@ -134,11 +136,21 @@ class _StoreClient:
 
     # -- calls -------------------------------------------------------------
     def call(self, op: str, **kwargs: Any) -> Any:
+        # fraud-range injection point: a chaos plan stalls or errors the
+        # store/registry client here — the "registry stalled mid-promotion"
+        # and retry-budget-exhaustion drills (zero-cost disarmed)
+        fire("netclient.call", op=op)
         last_err: Exception | None = None
         with self._lock:
             for attempt in range(RETRIES):
                 if attempt:
-                    time.sleep(min(BACKOFF_BASE * 2 ** (attempt - 1), BACKOFF_CAP))
+                    # Bounded exponential backoff with jitter: the jitter
+                    # multiplier only stretches the delay (×1.0–1.25), so
+                    # the budget still provably exceeds the sentinel's
+                    # down_after + promotion window while desynchronizing a
+                    # client herd that all saw the primary die at once.
+                    delay = min(BACKOFF_BASE * 2 ** (attempt - 1), BACKOFF_CAP)
+                    time.sleep(delay * (1.0 + 0.25 * random.random()))
                 try:
                     if self._sock is None:
                         self._sock = self._connect()

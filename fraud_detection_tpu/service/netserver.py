@@ -647,6 +647,24 @@ def main() -> None:
             registry=registry,
         )
         epoch.set_function(lambda: float(srv.epoch))
+        # At-least-once delivery observability for claims served by THIS
+        # store (monotonic totals mirrored from the hosted broker engine —
+        # the same events taskq.py counts on the shared registry in API/
+        # worker processes, visible here for fraud://-routed claims).
+        redeliveries = Gauge(
+            "fraud_store_taskq_redeliveries_total",
+            "Task deliveries beyond the first served by this store "
+            "(visibility-timeout expiry or nack retry)",
+            registry=registry,
+        )
+        redeliveries.set_function(lambda: float(srv.broker.redeliveries))
+        expired = Gauge(
+            "fraud_store_taskq_expired_claims_total",
+            "Claims whose visibility window lapsed before ack/nack on this "
+            "store (worker death or stall mid-task)",
+            registry=registry,
+        )
+        expired.set_function(lambda: float(srv.broker.expired_claims))
         start_http_server(args.metrics_port, registry=registry)
         log.info("store metrics on :%d", args.metrics_port)
     srv.serve_forever()

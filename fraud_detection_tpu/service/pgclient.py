@@ -114,6 +114,8 @@ class PgBroker(_taskq.SqliteBroker):
     def __init__(self, url: str):
         self.url = url
         self._lock = threading.Lock()
+        self.redeliveries = 0
+        self.expired_claims = 0
         self._conn = _PgAdapter(url)
         with self._lock, self._conn:
             self._conn.executescript(

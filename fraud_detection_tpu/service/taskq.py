@@ -33,6 +33,8 @@ from dataclasses import dataclass
 from typing import Any
 
 from fraud_detection_tpu import config
+from fraud_detection_tpu.range.faults import fire, patched
+from fraud_detection_tpu.service import metrics
 
 log = logging.getLogger("fraud_detection_tpu.taskq")
 
@@ -66,6 +68,12 @@ class SqliteBroker:
         if path != ":memory:" and os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
         self._lock = threading.Lock()
+        # Per-instance delivery-anomaly counters, mirrored into the shared
+        # Prometheus registry: the netserver's module-local exporter reads
+        # these via set_function (counters can't), and chaos scenarios
+        # assert on them without scraping.
+        self.redeliveries = 0
+        self.expired_claims = 0
         self._conn = sqlite3.connect(path, check_same_thread=False, timeout=30.0)
         self._conn.row_factory = sqlite3.Row
         with self._lock, self._conn:
@@ -117,6 +125,9 @@ class SqliteBroker:
         producers stay compatible across all broker backends.
         """
         task_id = task_id or uuid.uuid4().hex
+        # fraud-range injection point: a chaos plan can delay deliveries by
+        # stretching the countdown (off by default, zero-cost disarmed)
+        countdown = patched("taskq.countdown", countdown)
         now = time.time()
         with self._lock, self._conn:
             self._conn.execute(
@@ -155,6 +166,11 @@ class SqliteBroker:
         row under one transaction. Lets a worker amortize a single device
         dispatch over many tasks (the batched-SHAP hot path).
         """
+        # fraud-range injection point: a chaos plan can collapse the window
+        # so a claimed task stays deliverable — the duplicate-delivery drill
+        visibility_timeout = patched(
+            "taskq.visibility_timeout", visibility_timeout
+        )
         now = time.time()
         claimed: list[Task] = []
         with self._lock, self._conn:
@@ -173,6 +189,21 @@ class SqliteBroker:
                     ),
                 )
                 if cur.rowcount == 1:  # else lost the race to another worker
+                    # Delivery-anomaly accounting: a CLAIMED row here means
+                    # the previous claim's visibility window lapsed without
+                    # ack/nack (worker death/stall — the acks-late
+                    # redelivery); a QUEUED row with attempts > 0 is a
+                    # nack-retry redelivery. Both are deliveries beyond the
+                    # first — the at-least-once signal operators (and chaos
+                    # drills) watch instead of inferring it.
+                    if row["status"] == CLAIMED:
+                        self.expired_claims += 1
+                        self.redeliveries += 1
+                        metrics.taskq_expired_claims.inc()
+                        metrics.taskq_redeliveries.inc()
+                    elif row["attempts"] > 0:
+                        self.redeliveries += 1
+                        metrics.taskq_redeliveries.inc()
                     claimed.append(
                         Task(
                             id=row["id"],
@@ -183,10 +214,18 @@ class SqliteBroker:
                             max_retries=row["max_retries"],
                         )
                     )
+        # outside the transaction: a kill here simulates a worker dying
+        # AFTER the claim committed but before execution — the visibility
+        # window must redeliver the task, never lose it
+        for t in claimed:
+            fire("taskq.claim", task_id=t.id, name=t.name)
         return claimed
 
     def ack(self, task_id: str) -> None:
         """Acknowledge success — only called AFTER execution (acks_late)."""
+        # a kill here = worker died post-execution pre-ack: the task will be
+        # redelivered and re-executed — the duplicate-side-effect drill
+        fire("taskq.ack", task_id=task_id)
         with self._lock, self._conn:
             self._conn.execute(
                 "UPDATE tasks SET status = ?, updated_at = ? WHERE id = ?",

@@ -1,0 +1,32 @@
+"""Fixture: retry loops with constant (or zero) delays — retry-no-backoff."""
+
+import time
+from time import sleep
+
+RETRY_DELAY = 5.0
+
+
+def fetch_with_fixed_delay(client):
+    for _attempt in range(8):
+        try:
+            return client.call("op")
+        except OSError:
+            time.sleep(2.0)  # BAD: constant delay in a retry loop
+    return None
+
+
+def fetch_with_named_constant(client):
+    while True:
+        try:
+            return client.call("op")
+        except OSError:
+            time.sleep(RETRY_DELAY)  # BAD: module-level constant delay
+
+
+def fetch_hot_spin(client):
+    for _attempt in range(8):
+        try:
+            return client.call("op")
+        except OSError:
+            sleep(0)  # BAD: zero-delay hot retry (imported sleep)
+    return None

@@ -1,0 +1,54 @@
+"""Fixture: retry/poll loops the retry-no-backoff rule must NOT flag."""
+
+import random
+import time
+
+BASE = 0.05
+CAP = 2.0
+POLL_INTERVAL = 0.2
+
+
+def fetch_with_backoff_jitter(client):
+    # exponential backoff, capped, with jitter — the sanctioned pattern
+    for attempt in range(8):
+        try:
+            return client.call("op")
+        except OSError:
+            delay = min(BASE * 2 ** attempt, CAP)
+            time.sleep(delay * (1.0 + 0.25 * random.random()))
+    return None
+
+
+def poll_queue(queue):
+    # a schedule, not a retry: no exception handling in the loop
+    while True:
+        item = queue.get_nowait()
+        if item is None:
+            time.sleep(POLL_INTERVAL)
+
+
+def retry_with_variable_delay(client, delays):
+    # data-driven delays: not provably constant — trusted
+    for d in delays:
+        try:
+            return client.call("op")
+        except OSError:
+            time.sleep(d)
+    return None
+
+
+def retry_with_closure(client):
+    # the sleep lives in a nested function on its own schedule
+    def waiter():
+        time.sleep(1.0)
+
+    for _attempt in range(3):
+        try:
+            return client.call("op")
+        except OSError:
+            register_waiter(waiter)
+    return None
+
+
+def register_waiter(fn):
+    return fn

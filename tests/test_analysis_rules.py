@@ -75,6 +75,17 @@ def test_service_rules_true_positives():
     assert counts["thread-nondaemon-nojoin"] == 1, findings
 
 
+def test_retry_no_backoff_true_positives():
+    counts, findings = rule_counts("bad_retry_backoff.py")
+    assert counts["retry-no-backoff"] == 3, findings
+    lines = {
+        f.line for f in findings if f.rule_id == "retry-no-backoff"
+    }
+    # literal constant, module-level named constant, and zero-delay hot
+    # spin through an imported sleep are all caught
+    assert len(lines) == 3
+
+
 # -- false positives --------------------------------------------------------
 
 
@@ -87,6 +98,7 @@ def test_service_rules_true_positives():
         "good_service.py",
         "good_prometheus.py",
         "good_hot_path_alloc.py",
+        "good_retry_backoff.py",
     ],
 )
 def test_good_fixtures_are_clean(good):

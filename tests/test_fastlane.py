@@ -424,3 +424,107 @@ def test_hot_swap_lands_between_pipelined_flushes(data, profile):
     # still counted; traffic after it must add zero)
     assert new_compiles == 0
     assert wt.drift.rows_seen == 64
+
+
+def test_concurrent_reload_drivers_race_one_swap_no_recompile(
+    data, profile, tmp_path
+):
+    """The poll thread and POST /admin/reload both drive the SAME
+    ModelReloader.check_once at a promotion alias flip, with fused traffic
+    live: the reloader lock admits exactly one swap, the bucket ladder is
+    warmed off-path before the flip, and zero new fastlane.flush
+    executables compile under the race (no recompile-storm page)."""
+    import threading
+
+    from fraud_detection_tpu.lifecycle.swap import ModelReloader, ModelSlot
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+    from fraud_detection_tpu.ops.scaler import scaler_fit
+    from fraud_detection_tpu.telemetry import compile_sentinel
+
+    names = [f"f{i}" for i in range(D)]
+    scaler = scaler_fit(data[:256])
+    rng = np.random.default_rng(0)
+
+    def make_model(seed):
+        r = np.random.default_rng(seed)
+        params = LogisticParams(
+            coef=r.standard_normal(D).astype(np.float32),
+            intercept=np.float32(-1.0),
+        )
+        m = FraudLogisticModel(params, scaler, names)
+        art = str(tmp_path / f"v{seed}")
+        m.save(art, joblib_too=False)
+        return m, art
+
+    model_a, art_a = make_model(1)
+    model_b, art_b = make_model(2)
+
+    class _Reg:
+        """Minimal alias/artifact surface of the file registry."""
+
+        def __init__(self):
+            self.aliases = {"prod": 1}
+            self.dirs = {1: art_a, 2: art_b}
+
+        def get_version_by_alias(self, name, alias):
+            return self.aliases.get(alias)
+
+        def artifact_dir(self, name, version):
+            return self.dirs[version]
+
+    reg = _Reg()
+    slot = ModelSlot(model_a, "test:a", 1)
+    wt = Watchtower(profile, thresholds=THR)
+    reloader = ModelReloader(slot, max_batch=32)
+    reloader._registry = lambda: reg  # point at the stub registry
+
+    compile_sentinel.install()
+    try:
+        async def run():
+            mb = MicroBatcher(
+                slot=slot, max_batch=32, max_wait_ms=1.0,
+                watchtower=wt, telemetry=False, fused=True,
+            )
+            await mb.start()
+            await asyncio.gather(*(mb.score(data[i]) for i in range(32)))
+            base = _compiles("fastlane.flush")
+            reg.aliases["prod"] = 2  # the promotion's alias flip lands
+            results: list[dict] = []
+
+            def drive():  # poll thread and /admin/reload both end up here
+                results.append(reloader.check_once())
+
+            threads = [threading.Thread(target=drive) for _ in range(4)]
+            loop = asyncio.get_running_loop()
+            starts = [loop.run_in_executor(None, t.start) for t in threads]
+            await asyncio.gather(*starts)
+            # traffic keeps flowing while the reload race runs
+            mid = await asyncio.gather(
+                *(mb.score(data[i]) for i in range(32, 64))
+            )
+            for t in threads:
+                await loop.run_in_executor(None, t.join)
+            post = await asyncio.gather(
+                *(mb.score(data[i]) for i in range(64, 96))
+            )
+            await mb.stop()
+            return results, mid, post, _compiles("fastlane.flush") - base
+
+        results, mid, post, new_compiles = asyncio.run(run())
+    finally:
+        compile_sentinel.uninstall()
+        wt.drain()
+        wt.close()
+
+    swapped = [r for r in results if r["champion"].startswith("swapped")]
+    unchanged = [r for r in results if r["champion"] == "unchanged"]
+    assert len(swapped) == 1, results  # exactly one swap landed
+    assert len(unchanged) == len(results) - 1
+    assert slot.version == 2
+    # the race added zero fused executables: the ladder was pre-warmed
+    # off-path (warm_scorer under expected_compiles) before the flip
+    assert new_compiles == 0
+    # traffic never broke; post-race scores come from the promoted model
+    want_b = model_b.scorer.predict_proba(data[64:96])
+    np.testing.assert_allclose(post, want_b, atol=1e-6)
+    assert len(mid) == 32

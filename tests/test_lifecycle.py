@@ -522,6 +522,78 @@ def test_watchtower_action_sender_latches_per_episode(env, monkeypatch):
     wt.close()
 
 
+def test_concurrent_admin_reload_races_promotion(env, monkeypatch):
+    """POST /admin/reload hammered while a conductor promotion is IN FLIGHT
+    (stalled between its two registry writes by a fraud-range fault):
+    exactly one swap lands, the bucket ladder stays pre-warmed (post-swap
+    scoring compiles nothing — no recompile-storm page), and serving never
+    breaks."""
+    import threading
+    import time as _time
+
+    from fraud_detection_tpu.ops import scorer as ops_scorer
+    from fraud_detection_tpu.range import faults
+    from fraud_detection_tpu.service import metrics as m
+    from fraud_detection_tpu.service.app import create_app
+    from fraud_detection_tpu.service.http import TestClient
+
+    tmp = env["tmp"]
+    monkeypatch.setenv("LIFECYCLE_RELOAD_INTERVAL_S", "0")
+    monkeypatch.setenv("LIFECYCLE_DB_URL", f"sqlite:///{tmp}/lifecycle.db")
+    v2 = _run_to_shadowing(env)
+    app = create_app(
+        database_url=f"sqlite:///{tmp}/fraud.db",
+        broker_url=f"sqlite:///{tmp}/taskq.db",
+    )
+    client = TestClient(app)
+    try:
+        assert client.get("/health").status_code == 200
+        assert app.state["slot"].version == env["v1"]
+        swaps_before = m.lifecycle_model_swaps._value.get()
+        # widen the in-flight window: the promotion stalls with @prod
+        # already flipped but @shadow not yet dropped
+        plan = faults.FaultPlan().stall(
+            "conductor.promoting.mid_alias", seconds=0.4
+        )
+        outcome: dict = {}
+
+        def promote():
+            outcome.update(env["conductor"].handle_promote("race drill"))
+
+        swapped: list[str] = []
+        with plan.armed():
+            t = threading.Thread(target=promote)
+            t.start()
+            deadline = _time.time() + 15
+            while _time.time() < deadline:
+                r = client.post("/admin/reload")
+                assert r.status_code == 200
+                champ = r.json()["champion"]
+                if champ.startswith("swapped"):
+                    swapped.append(champ)
+                if not t.is_alive() and app.state["slot"].version == v2:
+                    break
+            t.join(timeout=15)
+        assert not t.is_alive()
+        assert outcome.get("outcome") == "promoted"
+        # exactly one swap landed across all the racing reloads
+        assert swapped == [f"swapped to v{v2}"]
+        assert m.lifecycle_model_swaps._value.get() == swaps_before + 1
+        assert app.state["slot"].version == v2
+        # the ladder stays pre-warmed: scoring right after the swap must
+        # not compile anything (no RecompileStorm page on promotion)
+        compiles_before = ops_scorer._score._cache_size()
+        assert client.post(
+            "/predict", json={"features": [0.1] * 30}
+        ).status_code == 200
+        assert ops_scorer._score._cache_size() == compiles_before
+        # a settle-state reload sweep is a no-op (idempotent)
+        assert client.post("/admin/reload").json()["champion"] == "unchanged"
+        assert m.lifecycle_model_swaps._value.get() == swaps_before + 1
+    finally:
+        client.close()
+
+
 # -- the whole loop through the deployed surfaces ----------------------------
 
 def test_end_to_end_service_loop(env, monkeypatch):

@@ -1,0 +1,493 @@
+"""The fraud range (ISSUE 6): traffic generators, fault injection, invariant
+machinery — plus the ``-m slow`` chaos tier that runs every named scenario
+end to end against the live in-process stack and asserts the closed-loop
+invariants (drift caught within budget, exactly-once promotion under a
+mid-step kill, p99 held through bursts and hot swaps, no alert flaps,
+bitwise-reproducible windows).
+"""
+
+import os
+import sqlite3
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.range import faults
+from fraud_detection_tpu.range.invariants import (
+    AlertFlapDetector,
+    drift_detected_within,
+    p99_within,
+    windows_bitwise_equal,
+)
+from fraud_detection_tpu.range.traffic import (
+    ArrivalProcess,
+    CampaignSpec,
+    CampaignTraffic,
+    DelayedLabelJoiner,
+    DriftCampaign,
+    FraudRing,
+    LabelFeedback,
+)
+
+D = 30
+
+
+# -- traffic generators ------------------------------------------------------
+
+def test_traffic_is_deterministic_per_seed():
+    spec = CampaignSpec(
+        total_rows=2048, seed=11,
+        drift=DriftCampaign(onset_row=512),
+        ring=FraudRing(start_row=256, ring_size=32, every_rows=128),
+    )
+    a = list(CampaignTraffic(spec).batches())
+    b = list(CampaignTraffic(spec).batches())
+    assert len(a) == len(b)
+    for ba, bb in zip(a, b):
+        np.testing.assert_array_equal(ba.rows, bb.rows)
+        np.testing.assert_array_equal(ba.labels, bb.labels)
+        np.testing.assert_array_equal(ba.ring_mask, bb.ring_mask)
+    assert sum(x.rows.shape[0] for x in a) == 2048
+
+
+def test_arrivals_are_bursty_and_exact():
+    rng = np.random.default_rng(0)
+    sizes = ArrivalProcess(rate_hz=2000.0, window_s=0.01).batch_sizes(8192, rng)
+    assert sum(sizes) == 8192
+    assert min(sizes) >= 1
+    # heavy tail: the largest window dwarfs the median
+    assert max(sizes) > 3 * float(np.median(sizes))
+
+
+def test_drift_campaign_respects_onset():
+    spec = CampaignSpec(
+        total_rows=2048, seed=5,
+        drift=DriftCampaign(onset_row=1024, features=(0,), mean_shift=10.0),
+    )
+    pre, post = [], []
+    for b in CampaignTraffic(spec).batches():
+        for i in range(b.rows.shape[0]):
+            (post if b.start_row + i >= 1024 else pre).append(b.rows[i, 0])
+    assert abs(np.mean(pre)) < 1.0
+    assert np.mean(post) > 8.0
+
+
+def test_ring_rows_are_fraud_and_correlated():
+    spec = CampaignSpec(
+        total_rows=3072, seed=9,
+        ring=FraudRing(start_row=0, ring_size=64, every_rows=192),
+    )
+    ring_rows, ring_labels = [], []
+    for b in CampaignTraffic(spec).batches():
+        ring_rows.append(b.rows[b.ring_mask])
+        ring_labels.append(b.labels[b.ring_mask])
+    rows = np.concatenate(ring_rows)
+    labels = np.concatenate(ring_labels)
+    assert rows.shape[0] > 0
+    assert np.all(labels == 1), "ring rows must carry the fraud label"
+    # correlated cluster: within one ring run, per-feature variance is far
+    # below the unit background variance (rows[:32] all come from the first
+    # 64-row run, so they share one center)
+    feats = list(spec.ring.ring_features)
+    per_feature_var = np.var(rows[:32][:, feats].astype(np.float64), axis=0)
+    assert float(per_feature_var.max()) < 0.1
+
+
+def test_delayed_label_joiner_releases_after_delay_with_noise():
+    fb = LabelFeedback(delay_rows=512, noise_rate=0.5, batch=64)
+    spec = CampaignSpec(total_rows=1536, seed=3, feedback=fb)
+    joiner = DelayedLabelJoiner(fb, seed=3)
+    released_at: list[tuple[int, int]] = []
+    for b in CampaignTraffic(spec).batches():
+        scores = np.zeros(b.rows.shape[0], np.float32)
+        joiner.observe(b, scores)
+        current = b.start_row + b.rows.shape[0]
+        for _, _, fy in joiner.due(current):
+            released_at.append((current, fy.shape[0]))
+    assert joiner.released_rows > 0
+    # nothing releases before one full delay of traffic has passed
+    assert all(cur >= 512 for cur, _ in released_at)
+    # ~half the labels flipped (review noise)
+    frac = joiner.flipped_rows / joiner.released_rows
+    assert 0.3 < frac < 0.7
+
+
+# -- fault injection ---------------------------------------------------------
+
+def test_fire_is_noop_when_disarmed():
+    faults.fire("nonexistent.point", anything=1)  # must not raise
+    assert faults.patched("nonexistent.point", 42) == 42
+    assert faults.active_plan() is None
+
+
+def test_fault_plan_kill_budget_and_log():
+    plan = faults.FaultPlan().kill("p.kill", times=2)
+    with plan.armed():
+        with pytest.raises(faults.ReplicaKilled):
+            faults.fire("p.kill")
+        with pytest.raises(faults.ReplicaKilled):
+            faults.fire("p.kill")
+        faults.fire("p.kill")  # budget exhausted: no-op
+    assert plan.fired("p.kill") == 2
+    assert faults.active_plan() is None
+
+
+def test_fault_plan_patch_error_call():
+    seen = {}
+    plan = (
+        faults.FaultPlan()
+        .patch("p.v", 0.0, times=1)
+        .error("p.err", lambda: RuntimeError("boom"), times=1)
+        .call("p.cb", lambda **kw: seen.update(kw))
+    )
+    with plan.armed():
+        assert faults.patched("p.v", 60.0) == 0.0
+        assert faults.patched("p.v", 60.0) == 60.0  # budget spent
+        with pytest.raises(RuntimeError):
+            faults.fire("p.err")
+        faults.fire("p.err")  # spent
+        faults.fire("p.cb", x=7)
+    assert seen == {"x": 7}
+
+
+def test_arming_is_exclusive():
+    plan = faults.FaultPlan()
+    with plan.armed():
+        with pytest.raises(RuntimeError):
+            with faults.FaultPlan().armed():
+                pass
+    # and the failed arm didn't clobber the disarm
+    assert faults.active_plan() is None
+
+
+def test_replica_killed_escapes_except_exception():
+    """A simulated process death must not be absorbed by production
+    ``except Exception`` retry ladders — a real SIGKILL wouldn't be."""
+    try:
+        try:
+            raise faults.ReplicaKilled("x")
+        except Exception:  # the worker's ladder
+            pytest.fail("ReplicaKilled was caught by except Exception")
+    except faults.ReplicaKilled:
+        pass
+
+
+# -- invariant machinery -----------------------------------------------------
+
+def test_drift_detected_within():
+    assert drift_detected_within(100, 150, 100).ok
+    assert not drift_detected_within(100, 250, 100).ok
+    assert not drift_detected_within(100, None, 100).ok
+
+
+def test_p99_within_floor_and_factor():
+    base = 0.001
+    assert p99_within([0.002] * 100, base, factor=5.0, absolute_floor_s=0.0).ok
+    assert not p99_within([0.2] * 100, base, factor=5.0, absolute_floor_s=0.05).ok
+    assert p99_within([0.04] * 100, base, factor=5.0, absolute_floor_s=0.05).ok
+
+
+def test_windows_bitwise_equal_catches_one_bit():
+    from fraud_detection_tpu.monitor.drift import init_window
+
+    a = init_window(4, 8, 8)
+    b = init_window(4, 8, 8)
+    assert windows_bitwise_equal(a, b).ok
+    c = b._replace(n_rows=b.n_rows + 1e-7)
+    assert not windows_bitwise_equal(a, c).ok
+
+
+def test_alert_flap_detector():
+    det = AlertFlapDetector(min_hold_samples=3)
+    # fires for 1 sample then clears = a flap
+    for v in (False, True, False, False):
+        det.sample(drift=v)
+    assert not det.check().ok
+    det2 = AlertFlapDetector(min_hold_samples=3)
+    # fires and HOLDS through scenario end = not a flap
+    for v in (False, True, True, True):
+        det2.sample(drift=v)
+    assert det2.check().ok
+
+
+# -- taskq delivery observability (satellite) --------------------------------
+
+def test_taskq_redelivery_and_expired_claim_metrics(tmp_path):
+    from fraud_detection_tpu.service import metrics
+    from fraud_detection_tpu.service.taskq import SqliteBroker
+
+    broker = SqliteBroker(f"sqlite:///{tmp_path}/q.db")
+    red0 = metrics.taskq_redeliveries._value.get()
+    exp0 = metrics.taskq_expired_claims._value.get()
+
+    # visibility-timeout expiry → expired claim AND redelivery
+    broker.send_task("t.work", [1])
+    t1 = broker.claim("w1", visibility_timeout=0.0)
+    assert t1 is not None
+    t2 = broker.claim("w2", visibility_timeout=60.0)
+    assert t2 is not None and t2.id == t1.id
+    assert broker.expired_claims == 1
+    assert broker.redeliveries == 1
+
+    # nack retry → redelivery only (the claim found a QUEUED row)
+    assert broker.nack(t2.id, countdown=0.0, claimed_by="w2")
+    t3 = broker.claim("w3", visibility_timeout=60.0)
+    assert t3 is not None and t3.attempts == 1
+    assert broker.expired_claims == 1
+    assert broker.redeliveries == 2
+
+    # mirrored into the shared Prometheus registry
+    assert metrics.taskq_expired_claims._value.get() - exp0 == 1
+    assert metrics.taskq_redeliveries._value.get() - red0 == 2
+
+    # first deliveries never count
+    broker.send_task("t.other", [2])
+    broker.claim("w1", visibility_timeout=60.0)
+    assert broker.redeliveries == 2
+    broker.close()
+
+
+def test_taskq_metrics_exported_by_registry():
+    """Registry contract: the exposition carries the new counters."""
+    from fraud_detection_tpu.service import metrics as m
+
+    text = m.render().decode()
+    assert "taskq_redeliveries_total" in text
+    assert "taskq_expired_claims_total" in text
+
+
+def test_taskq_fault_points(tmp_path):
+    from fraud_detection_tpu.service.taskq import SqliteBroker
+
+    broker = SqliteBroker(f"sqlite:///{tmp_path}/q2.db")
+    plan = (
+        faults.FaultPlan()
+        .patch("taskq.visibility_timeout", 0.0, times=1)
+        .kill("taskq.ack")
+    )
+    with plan.armed():
+        broker.send_task("t.x", [])
+        first = broker.claim("w1")  # collapsed window
+        dup = broker.claim("w2")  # redelivered immediately
+        assert dup is not None and dup.id == first.id
+        with pytest.raises(faults.ReplicaKilled):
+            broker.ack(dup.id)  # died pre-ack → will be redelivered
+    assert broker.get_status(first.id) == "CLAIMED"  # never acked
+    broker.close()
+
+
+# -- store poison guard (surfaced by the label_delay drill) ------------------
+
+def test_store_rejects_poisoned_feedback(tmp_path):
+    from fraud_detection_tpu.lifecycle.store import LifecycleStore
+
+    store = LifecycleStore(f"sqlite:///{tmp_path}/lc.db")
+    x = np.zeros((4, D), np.float32)
+    s = np.full(4, 0.5, np.float32)
+    y = np.array([0, 1, 0, 1])
+    with pytest.raises(ValueError, match="finite"):
+        store.add_feedback(
+            np.full((4, D), np.nan, np.float32), s, y
+        )
+    with pytest.raises(ValueError, match="probabilities"):
+        store.add_feedback(x, np.full(4, np.nan, np.float32), y)
+    with pytest.raises(ValueError, match="probabilities"):
+        store.add_feedback(x, np.full(4, 1.5, np.float32), y)
+    with pytest.raises(ValueError, match="labels"):
+        store.add_feedback(x, s, np.array([0, 1, 2, 1]))
+    assert store.feedback_counts()["seen"] == 0  # nothing leaked through
+    store.add_feedback(x, s, y)
+    assert store.feedback_counts()["seen"] == 4
+    store.close()
+
+
+# -- graceful degradation: 503 + Retry-After on store stall (satellite) ------
+
+@pytest.fixture()
+def served_app(tmp_path, rng, monkeypatch):
+    """App with a real model + monitor profile + sqlite lifecycle store."""
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+    from fraud_detection_tpu.monitor.baseline import (
+        build_baseline_profile,
+        save_profile,
+    )
+    from fraud_detection_tpu.ops.logistic import LogisticParams
+    from fraud_detection_tpu.ops.scaler import scaler_fit
+    from fraud_detection_tpu.service.app import create_app
+    from fraud_detection_tpu.service.http import TestClient
+
+    params = LogisticParams(
+        coef=rng.standard_normal(D).astype(np.float32),
+        intercept=np.float32(-1.0),
+    )
+    x = rng.standard_normal((600, D)).astype(np.float32)
+    scaler = scaler_fit(x)
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    model_dir = str(tmp_path / "models")
+    model = FraudLogisticModel(params, scaler, names)
+    model.save(model_dir, joblib_too=False)
+    save_profile(
+        model_dir,
+        build_baseline_profile(
+            x, np.asarray(model.scorer.predict_proba(x)), feature_names=names
+        ),
+    )
+    monkeypatch.setenv(
+        "MODEL_PATH", os.path.join(model_dir, "logistic_model.joblib")
+    )
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    monkeypatch.setenv("LIFECYCLE_RELOAD_INTERVAL_S", "0")
+    app = create_app(
+        database_url=f"sqlite:///{tmp_path}/fraud.db",
+        broker_url=f"sqlite:///{tmp_path}/taskq.db",
+    )
+    client = TestClient(app)
+    yield client, app
+    client.close()
+
+
+def test_lifecycle_status_503_with_retry_after_on_store_stall(served_app):
+    from fraud_detection_tpu.service.errors import StoreError
+
+    client, app = served_app
+    assert client.get("/lifecycle/status").status_code == 200
+    plan = faults.FaultPlan().error(
+        "lifecycle.store.get_state",
+        lambda: StoreError("get_state failed after 8 attempts: stalled"),
+    )
+    with plan.armed():
+        r = client.get("/lifecycle/status")
+    assert r.status_code == 503
+    assert r.headers.get("retry-after") == "10"
+    assert "store outage" in r.json()["error"]
+    # recovery: the next request is served normally
+    assert client.get("/lifecycle/status").status_code == 200
+
+
+def test_monitor_feedback_rejects_nonfinite_features_at_edge(served_app):
+    """The edge mirrors the store's poison guard: a NaN feature row is a
+    422, not a 202 whose durable persist silently failed."""
+    client, app = served_app
+    r = client.post(
+        "/monitor/feedback",
+        json={
+            "features": [[float("nan")] * D],
+            "scores": [0.5],
+            "labels": [1],
+        },
+    )
+    assert r.status_code == 422
+    assert "finite" in r.json()["detail"]
+
+
+def test_monitor_feedback_503_with_retry_after_on_store_outage(served_app):
+    from fraud_detection_tpu.service.errors import DatabaseError
+
+    client, app = served_app
+    payload = {
+        "features": [[0.1] * D] * 4,
+        "scores": [0.5] * 4,
+        "labels": [0, 1, 0, 1],
+    }
+    assert client.post("/monitor/feedback", json=payload).status_code == 202
+    plan = faults.FaultPlan().error(
+        "lifecycle.store.add_feedback",
+        lambda: DatabaseError("add_feedback failed after 8 attempts"),
+    )
+    with plan.armed():
+        r = client.post("/monitor/feedback", json=payload)
+    assert r.status_code == 503
+    assert r.headers.get("retry-after") == "10"
+    # recovery
+    r = client.post("/monitor/feedback", json=payload)
+    assert r.status_code == 202 and r.json()["persisted"] is True
+
+
+# -- the chaos scenario tier (-m slow) ---------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name", ["burst", "drift_onset", "fraud_ring", "label_delay"]
+)
+def test_scenario_traffic_tier(name, tmp_path):
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario(name, tmpdir=str(tmp_path)).raise_if_failed()
+
+
+@pytest.mark.slow
+def test_scenario_hot_swap():
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario("hot_swap").raise_if_failed()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "kill_point",
+    [
+        "conductor.promoting.pre_alias",
+        "conductor.promoting.mid_alias",
+        "conductor.promoting.pre_finalize",
+    ],
+)
+def test_scenario_control_plane_chaos_converges(kill_point, tmp_path):
+    """The acceptance drill: a replica killed at ANY point inside the
+    promotion's registry writes converges to exactly-once promotion on
+    resume — with the promote task also duplicated past the visibility
+    window."""
+    from fraud_detection_tpu.range.scenarios import scenario_control_plane_chaos
+
+    r = scenario_control_plane_chaos(str(tmp_path), kill_point=kill_point)
+    r.raise_if_failed()
+
+
+@pytest.mark.slow
+def test_scenario_chaos_kill_mid_gated(tmp_path):
+    """Kill between challenger registration and the @shadow alias write:
+    resume must re-alias the RECORDED version, never re-register."""
+    from fraud_detection_tpu.lifecycle import Conductor
+    from fraud_detection_tpu.range.scenarios import _feed_store, build_lifecycle_env
+
+    env = build_lifecycle_env(str(tmp_path))
+    _feed_store(env, n=512)
+    plan = faults.FaultPlan().kill("conductor.gated.pre_alias")
+    with plan.armed():
+        with pytest.raises(faults.ReplicaKilled):
+            env["conductor"].handle_retrain("range: gated kill")
+    assert plan.fired() == 1
+    versions = env["registry"].latest_version("fraud")
+    resumed = Conductor(
+        store=env["store"], tracking_client=env["client"]
+    ).resume()
+    assert resumed["outcome"] == "resumed_shadowing"
+    assert env["registry"].latest_version("fraud") == versions  # no re-register
+    assert env["registry"].get_version_by_alias("fraud", "shadow") == versions
+    env["store"].close()
+
+
+@pytest.mark.slow
+def test_scenario_store_stall_keeps_service_answering(served_app):
+    """Store stalled (not dead): the microbatch flush keeps scoring while
+    /lifecycle/status degrades to 503 — a stalled control plane must never
+    take the data plane down."""
+    from fraud_detection_tpu.service.errors import StoreError
+
+    client, app = served_app
+    plan = (
+        faults.FaultPlan()
+        .error(
+            "lifecycle.store.get_state",
+            lambda: StoreError("stalled past retry budget"),
+        )
+        .stall("microbatch.flush", seconds=0.05, times=2)
+    )
+    with plan.armed():
+        # scoring rides through the injected flush latency
+        r = client.post("/predict", json={"features": [0.1] * D})
+        assert r.status_code == 200
+        assert client.get("/lifecycle/status").status_code == 503
+    assert plan.fired("microbatch.flush") >= 1
+    # disarmed: both planes healthy again
+    assert client.post("/predict", json={"features": [0.1] * D}).status_code == 200
+    assert client.get("/lifecycle/status").status_code == 200
