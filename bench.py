@@ -62,6 +62,7 @@ SECTION_BUDGETS = {
     "sync_scoring": 300,
     "monitored_scoring": 240,
     "microbatch_flush": 240,
+    "mesh_serving": 300,
     "telemetry": 240,
     "lifecycle": 240,
     "scenarios": 420,
@@ -496,6 +497,40 @@ def bench_microbatch_flush(x, coef, intercept, mean, scale) -> dict[str, float]:
         "device_calls_per_flush_split": 2.0,
         "staging_steady_allocations": float(steady_allocs),
     }
+
+
+def bench_mesh_serving() -> dict:
+    """Switchyard scaling curve: the sharded fused flush over 1/2/4/8
+    virtual CPU shards, with single-device parity asserted (scores from
+    the N-shard program must bitwise-match the fastlane flush).
+
+    Runs in a SUBPROCESS with ``--xla_force_host_platform_device_count=8``:
+    the backend's device count is fixed at first init, so this process
+    (which may be attached to a real TPU or a 1-device CPU) cannot measure
+    the virtual-shard curve itself. The probe module
+    (fraud_detection_tpu/mesh/bench.py) prints one JSON line; a dead or
+    hung probe surfaces as a section error, never a hang (subprocess
+    timeout under the section watchdog)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    r = subprocess.run(
+        [sys.executable, "-m", "fraud_detection_tpu.mesh.bench"],
+        capture_output=True, text=True, timeout=270, env=env,
+    )
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+        raise RuntimeError(f"mesh probe rc={r.returncode}: {tail[0][:160]}")
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError("mesh probe printed no JSON")
 
 
 def bench_telemetry(x, coef, intercept, mean, scale) -> dict[str, float]:
@@ -1386,6 +1421,18 @@ def main() -> None:
             staging_zero_alloc_ok=bool(
                 mbf_res["staging_steady_allocations"] == 0
             ),
+        )
+    mesh_res = h.section("mesh_serving", bench_mesh_serving)
+    if mesh_res:
+        h.update(
+            mesh_flushes_per_sec=mesh_res["mesh_flushes_per_sec"],
+            mesh_rows_per_sec_top=mesh_res["mesh_rows_per_sec_top"],
+            mesh_speedup_top_vs_1=mesh_res["mesh_speedup_top_vs_1"],
+            # the switchyard acceptance bars: N-shard scores bitwise-match
+            # the single-device fastlane, and throughput does not collapse
+            # as shards are added (monotone within the probe's noise slack)
+            mesh_parity_ok=bool(mesh_res["mesh_parity_ok"]),
+            mesh_scaling_monotone=bool(mesh_res["mesh_scaling_monotone"]),
         )
     tel_res = h.section("telemetry", bench_telemetry, x, coef, intercept,
                         mean, scale)

@@ -387,6 +387,78 @@ def _build_fastlane_flush(mesh: Mesh):
     return fn, (window, x, valid, decay, feature_edges, score_edges, score_args)
 
 
+@register_entrypoint("mesh.sharded_flush")
+def _build_mesh_sharded_flush(mesh: Mesh):
+    """The switchyard serving flush: the fused score+drift program as ONE
+    shard_map-mapped dispatch over the data axis — rows row-sharded,
+    params replicated, per-shard windows (leading shard axis) donated
+    through. The live serving topology at every virtual mesh size."""
+    from fraud_detection_tpu.mesh.shardflush import _sharded_flush
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import N_CALIB_BINS, DriftWindow
+    from fraud_detection_tpu.ops.scorer import _raw_score_linear
+
+    n_shards = mesh.shape[DATA_AXIS]
+    shard = P(DATA_AXIS)
+    window = DriftWindow(
+        feature_counts=sds(
+            (n_shards, _FEATURES, N_FEATURE_BINS), jnp.float32, mesh, shard
+        ),
+        score_counts=sds((n_shards, N_SCORE_BINS), jnp.float32, mesh, shard),
+        calib_count=sds((n_shards, N_CALIB_BINS), jnp.float32, mesh, shard),
+        calib_conf=sds((n_shards, N_CALIB_BINS), jnp.float32, mesh, shard),
+        calib_label=sds((n_shards, N_CALIB_BINS), jnp.float32, mesh, shard),
+        n_rows=sds((n_shards,), jnp.float32, mesh, shard),
+    )
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, shard)
+    valid = sds((_ROWS,), jnp.float32, mesh, shard)
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((_FEATURES, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = (
+        sds((_FEATURES,), jnp.float32, mesh, P()),
+        sds((), jnp.float32, mesh, P()),
+    )
+    fn = lambda w, xx, vv, dd, fe, se, sa: _sharded_flush(  # noqa: E731
+        w, xx, vv, dd, fe, se, sa, score_fn=_raw_score_linear, mesh=mesh
+    )
+    return fn, (window, x, valid, decay, feature_edges, score_edges, score_args)
+
+
+@register_entrypoint("mesh.sharded_update")
+def _build_mesh_sharded_update(mesh: Mesh):
+    """The cross-replica-sharded weight update (2004.13336): params and
+    optimizer state sharded over the data axis, gradient psum_scatter'd
+    onto the owning shards, full vector all_gather'd per forward."""
+    from fraud_detection_tpu.mesh.retrain import (
+        _pad_features,
+        _sharded_update_epoch,
+    )
+
+    size = mesh.shape[DATA_AXIS]
+    d_pad = _pad_features(_FEATURES, size)
+    batch = 64  # divides the per-device shard at every registered mesh size
+    shard = P(DATA_AXIS)
+    coef_sh = sds((d_pad,), jnp.float32, mesh, shard)
+    vel_sh = sds((d_pad,), jnp.float32, mesh, shard)
+    intercept = sds((), jnp.float32, mesh, P())
+    vel_b = sds((), jnp.float32, mesh, P())
+    x = sds((_ROWS, d_pad), jnp.float32, mesh, shard)
+    per_row = lambda: sds((_ROWS,), jnp.float32, mesh, shard)  # noqa: E731
+    perm = sds((_ROWS // size,), jnp.int32, mesh, P())
+    lr = sds((), jnp.float32, mesh, P())
+    fn = lambda c_sh, v_sh, b, vb, xx, yy, ss, vv, pp, ll: (  # noqa: E731
+        _sharded_update_epoch(
+            c_sh, v_sh, b, vb, xx, yy, ss, vv, pp, ll,
+            mesh=mesh, c=1.0, n_total=_ROWS, momentum=0.9, batch=batch,
+        )
+    )
+    return fn, (
+        coef_sh, vel_sh, intercept, vel_b, x, per_row(), per_row(),
+        per_row(), perm, lr,
+    )
+
+
 @register_entrypoint("lifecycle.gate_eval")
 def _build_gate_eval(mesh: Mesh):
     from fraud_detection_tpu.lifecycle.gate import (

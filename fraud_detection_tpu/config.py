@@ -344,6 +344,51 @@ def recompile_storm_threshold() -> int:
 
 
 # --------------------------------------------------------------------------
+# Switchyard: sharded serving mesh (mesh/)
+# --------------------------------------------------------------------------
+
+def mesh_shards() -> int:
+    """``MESH_SHARDS`` — replica shards the switchyard serving front runs
+    (each shard is one micro-batcher behind the router, sharing the model
+    slot so hot swaps land on every shard between flushes). 0/1 (default)
+    = single-batcher serving, no front."""
+    return _get_int("MESH_SHARDS", 0)
+
+
+def mesh_flush_devices() -> int:
+    """``MESH_FLUSH_DEVICES`` — data-axis size of the serving mesh the
+    fused flush shards over (the SPMD ``mesh.sharded_flush`` program:
+    rows row-sharded, params replicated, per-shard drift windows donated
+    through). 0 (default) = single-device fastlane flush; must be a
+    power of two ≤ the local device count."""
+    return _get_int("MESH_FLUSH_DEVICES", 0)
+
+
+def mesh_shard_max_errors() -> int:
+    """``MESH_SHARD_MAX_ERRORS`` — consecutive scoring failures after which
+    the front marks a shard dead and sheds its load to healthy shards."""
+    return _get_int("MESH_SHARD_MAX_ERRORS", 3)
+
+
+def mesh_shard_reopen_s() -> float:
+    """``MESH_SHARD_REOPEN_S`` — seconds a dead shard rests before the
+    front half-open-probes it when no healthy shard is available (one
+    request; a failure re-kills it immediately, a success revives it).
+    Self-healing after a transient shared failure kills every shard —
+    without it, a correlated blip would need a manual revive per shard."""
+    return _get_float("MESH_SHARD_REOPEN_S", 5.0)
+
+
+def mesh_retrain() -> bool:
+    """``MESH_RETRAIN=1`` — the conductor's warm-started retrain refines
+    the fit with the cross-replica-sharded weight update
+    (mesh/retrain.mesh_sgd_fit, arxiv 2004.13336) instead of the
+    replicated-update L-BFGS path. Default off: the L-BFGS path is the
+    AUC-parity artifact every champion was gated on."""
+    return env_flag("MESH_RETRAIN") is True
+
+
+# --------------------------------------------------------------------------
 # Conductor: closed-loop retrain → challenger gate → promotion (lifecycle/)
 # --------------------------------------------------------------------------
 

@@ -113,10 +113,10 @@ def run_retrain(
     # on rows it trained on would inflate its recent AUC vs a champion that
     # never saw them (train-set evaluation) and let a worse model pass.
     # Interleaved (not chronological) so both halves span the same period.
-    fx_w, _, fy_w = store.window_rows()
+    fx_w, fs_w, fy_w = store.window_rows()
     fx_train, fy_train = fx_w[0::2], fy_w[0::2]
     fx_eval, fy_eval = fx_w[1::2], fy_w[1::2]
-    fx_r, _, fy_r = store.reservoir_rows()
+    fx_r, fs_r, fy_r = store.reservoir_rows()
     replay_x = [a for a in (fx_train, fx_r) if a.size]
     replay_y = [a for a in (fy_train, fy_r) if a.size]
     n_replay = int(sum(a.shape[0] for a in replay_x))
@@ -132,6 +132,29 @@ def run_retrain(
     else:
         x_fit, y_fit = x_train, y_train
 
+    # MapReduce aggregation of the sharded feedback pools (2403.07128,
+    # DrJAX idiom): each mesh shard summarizes its slice of the replay
+    # rows, one psum reduces the summaries — the pool composition the run
+    # records and operators audit, computed without a host-side row loop.
+    pool_stats: dict | None = None
+    if replay_x:
+        from fraud_detection_tpu.mesh.retrain import mapreduce_pool_stats
+
+        # scores captured from the SAME fetch as the replay rows above —
+        # a second store read could interleave with arriving feedback and
+        # silently misalign scores with rows
+        pool_scores = np.concatenate(
+            [fs_w[0::2], fs_r]
+        ) if fs_r.size else fs_w[0::2]
+        try:
+            pool_stats = mapreduce_pool_stats(
+                np.concatenate(replay_x),
+                np.concatenate(replay_y),
+                pool_scores,
+            )
+        except Exception as e:
+            log.warning("feedback pool aggregation failed: %s", e)
+
     with client.start_run() as run:
         run.log_params(
             {
@@ -145,8 +168,12 @@ def run_retrain(
                 "max_iter": max_iter,
                 "device": jax.devices()[0].platform,
                 "n_devices": jax.device_count(),
+                "mesh_retrain": config.mesh_retrain(),
             }
         )
+        if pool_stats is not None:
+            run.log_metric("feedback_label_rate", pool_stats["label_rate"])
+            run.log_metric("feedback_score_mean", pool_stats["score_mean"])
 
         # ---- scaler on the train side only, then the sharded DP fit
         scaler = scaler_fit(x_fit)
@@ -163,9 +190,21 @@ def run_retrain(
                 # the raw mix rather than failing the whole loop closure
                 log.warning("retrain SMOTE skipped: %s", e)
                 run.set_tag("smote_skipped", str(e))
-        params = logistic_fit_lbfgs(
-            x_final, y_final, max_iter=max_iter, sharded=True, warm_start=ws
-        )
+        if config.mesh_retrain():
+            # MESH_RETRAIN=1: the warm-started update itself shards across
+            # the mesh — each replica owns 1/N of the params and optimizer
+            # state (2004.13336) instead of replicating the full update
+            from fraud_detection_tpu.mesh.retrain import mesh_sgd_fit
+
+            params = mesh_sgd_fit(
+                x_final, y_final, epochs=max(max_iter // 20, 3),
+                warm_start=ws,
+            )
+        else:
+            params = logistic_fit_lbfgs(
+                x_final, y_final, max_iter=max_iter, sharded=True,
+                warm_start=ws,
+            )
         challenger = FraudLogisticModel(params, scaler, list(feature_names))
 
         # ---- the challenger gate: frozen holdout + recent labeled window

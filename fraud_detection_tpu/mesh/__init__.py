@@ -1,0 +1,41 @@
+"""Switchyard: the sharded serving mesh — one logical scorer across N shards.
+
+The serving tier scaled only at the process level until this package: the
+numerics are mesh-proven (meshcheck's virtual meshes, the multichip DP+TP
+dry-run), but a serving process flushed to ONE device and routed to ONE
+micro-batcher. Switchyard is the scale-out tier:
+
+- :mod:`~fraud_detection_tpu.mesh.topology` — the serving mesh: a data-axis
+  device mesh over real devices when present, virtual CPU shards otherwise
+  (the same ``--xla_force_host_platform_device_count`` trick meshcheck
+  uses, promoted from a static gate to the live topology);
+- :mod:`~fraud_detection_tpu.mesh.shardflush` — the fastlane fused flush as
+  one ``shard_map``-mapped program: rows row-sharded over the data axis,
+  params replicated, per-shard drift windows donated through and merged at
+  scrape time — each shard still pays exactly ONE device dispatch per
+  flush;
+- :mod:`~fraud_detection_tpu.mesh.front` — the shard front: a router that
+  balances micro-batches across replica shards with health tracking and
+  draining, so a dead shard sheds load instead of stalling the flush;
+- :mod:`~fraud_detection_tpu.mesh.retrain` — the cross-replica-sharded
+  weight update (arxiv 2004.13336: shard the update, don't replicate it)
+  and MapReduce-style sharded feedback-pool aggregation (arxiv 2403.07128).
+"""
+
+from fraud_detection_tpu.mesh.front import NoHealthyShards, ShardFront
+from fraud_detection_tpu.mesh.shardflush import (
+    MeshDriftMonitor,
+    init_sharded_window,
+    merge_window,
+)
+from fraud_detection_tpu.mesh.topology import serving_mesh, serving_mesh_size
+
+__all__ = [
+    "MeshDriftMonitor",
+    "NoHealthyShards",
+    "ShardFront",
+    "init_sharded_window",
+    "merge_window",
+    "serving_mesh",
+    "serving_mesh_size",
+]

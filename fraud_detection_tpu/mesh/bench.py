@@ -1,0 +1,131 @@
+"""Switchyard bench probe: sharded-flush scaling over virtual CPU shards.
+
+Run as a SUBPROCESS by ``bench.py``'s ``mesh_serving`` section with
+``XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu``
+(the backend device count is fixed at init, so the scaling curve needs its
+own process). Measures fused-flush throughput at mesh sizes 1/2/4/8 on one
+bucket shape and asserts single-device parity: the N-shard program's
+scores must bitwise-match the single-device fastlane flush on the same
+batch. Prints exactly one JSON line.
+
+Virtual shards share the host's cores, so the curve reports what the
+mechanism delivers on THIS machine (XLA runs per-device computations on
+separate threads — small GEMVs overlap); ``monotone`` applies a noise
+margin rather than demanding strict growth, and the hard CI gate is
+parity + the curve existing, mirroring the CPU-fallback honesty rules of
+the other bench sections.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+#: throughput may dip within this factor step-to-step before the curve
+#: stops counting as monotone — virtual shards share cores, so ulp-level
+#: scheduling noise must not fail a mechanism gate.
+MONOTONE_SLACK = 0.85
+
+
+def _build(seed: int = 7, n_rows: int = 4096):
+    from fraud_detection_tpu.monitor.baseline import build_baseline_profile
+    from fraud_detection_tpu.ops.logistic import LogisticParams
+    from fraud_detection_tpu.ops.scaler import ScalerParams
+    from fraud_detection_tpu.ops.scorer import BatchScorer
+
+    d = 30
+    rng = np.random.default_rng(seed)
+    data = rng.standard_normal((n_rows, d)).astype(np.float32)
+    scorer = BatchScorer(
+        LogisticParams(
+            coef=rng.standard_normal(d).astype(np.float32),
+            intercept=np.float32(-1.0),
+        ),
+        ScalerParams(
+            mean=np.zeros(d, np.float32), scale=np.ones(d, np.float32),
+            var=np.ones(d, np.float32), n_samples=np.float32(1),
+        ),
+    )
+    profile = build_baseline_profile(
+        data, scorer.predict_proba(data),
+        feature_names=[f"f{i}" for i in range(d)],
+    )
+    return data, scorer, profile
+
+
+def _flush_once(scorer, monitor, rows) -> np.ndarray:
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.ops.scorer import _bucket
+
+    n = len(rows)
+    score_fn, score_args = scorer.fused_spec()
+    slot = scorer.staging.acquire(_bucket(n, scorer.min_bucket))
+    try:
+        hx = scorer.stage_rows(slot, list(rows))
+        out = monitor.fused_flush(
+            jnp.asarray(hx), jnp.asarray(slot.valid), n, score_args, score_fn
+        )
+        return np.asarray(out, np.float32)[:n]
+    finally:
+        scorer.staging.release(slot)
+
+
+def run(bucket: int = 65536, reps: int = 8, sizes=(1, 2, 4, 8)) -> dict:
+    import jax
+
+    from fraud_detection_tpu.mesh.shardflush import MeshDriftMonitor
+    from fraud_detection_tpu.mesh.topology import serving_mesh
+    from fraud_detection_tpu.monitor.drift import DriftMonitor
+
+    avail = jax.device_count()
+    sizes = tuple(s for s in sizes if s <= avail)
+    data, scorer, profile = _build(n_rows=bucket)
+    rows = [data[i] for i in range(bucket)]
+
+    # single-device fastlane reference: the parity target
+    ref = _flush_once(scorer, DriftMonitor(profile), rows)
+
+    rates: dict[str, float] = {}
+    parity = True
+    for n_sh in sizes:
+        monitor = MeshDriftMonitor(profile, serving_mesh(n_sh))
+        scores = _flush_once(scorer, monitor, rows)  # warm/compile + parity
+        parity = parity and bool(
+            np.array_equal(scores.view(np.uint32), ref.view(np.uint32))
+        )
+        best = 0.0
+        for _ in range(3):  # max-of-rounds damps shared-core noise
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                _flush_once(scorer, monitor, rows)
+            np.asarray(monitor.shard_window.n_rows)  # drain the chain
+            best = max(best, reps / (time.perf_counter() - t0))
+        rates[str(n_sh)] = best
+
+    order = [rates[str(s)] for s in sizes]
+    monotone = all(
+        b >= a * MONOTONE_SLACK for a, b in zip(order, order[1:])
+    )
+    top = str(sizes[-1])
+    return {
+        "device_count": avail,
+        "bucket": bucket,
+        "mesh_flushes_per_sec": {k: round(v, 2) for k, v in rates.items()},
+        "mesh_rows_per_sec_top": round(rates[top] * bucket),
+        "mesh_speedup_top_vs_1": round(rates[top] / max(rates["1"], 1e-9), 3),
+        "mesh_parity_ok": parity,
+        "mesh_scaling_monotone": monotone,
+        "mesh_sizes_measured": list(sizes),
+    }
+
+
+def main() -> int:
+    print(json.dumps(run()), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,323 @@
+"""The shard front: route, balance, drain — a dead shard sheds load.
+
+N replica shards (each one micro-batcher with its own flush pipeline) sit
+behind one router. Requests go to the healthy shard with the least
+in-flight rows (least-loaded routing — with micro-batching this also keeps
+buckets full on fewer shards under light traffic instead of scattering
+lone rows across all of them). A shard whose flushes fail repeatedly is
+marked DEAD and sheds its load: the failed request retries on another
+healthy shard in the same call, so a dying shard costs a retry, not an
+error, and never stalls the collector of a healthy one.
+
+All shards share the lifecycle :class:`ModelSlot`: a promotion's slot swap
+lands on EVERY shard between its in-flight flushes (each flush re-reads
+the slot — the existing zero-downtime contract), and because the shards
+share the scorer object they also share its pre-warmed bucket ladder, so
+a swap is recompile-free on all shards at once.
+
+Draining is first-class (``drain()`` → no new picks, in-flight completes;
+``revive()`` re-admits): the ShardOutage runbook's safe-restart primitive,
+and what the ``replica_burst`` chaos scenario exercises under load.
+
+Metrics note: the pre-existing process-wide scorer gauges
+(``scorer_queue_depth``, ``scorer_effective_wait_seconds``,
+``scorer_device_calls_per_flush``) are written by every shard's flush
+loop, so with N shards they read as whichever shard flushed last — a
+per-flush sample, not an aggregate. Per-shard visibility lives in the
+``mesh_shard_*`` series (in-flight, rows, errors, health); alert on
+those for shard-level conditions.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.range.faults import fire
+from fraud_detection_tpu.service import metrics
+
+log = logging.getLogger("fraud_detection_tpu.mesh")
+
+HEALTHY = "healthy"
+DRAINING = "draining"
+DEAD = "dead"
+#: a dead shard under its single half-open probe: excluded from routing
+#: (not HEALTHY), so exactly ONE request — the one that opened the probe —
+#: rides it; concurrent traffic keeps seeing the outage instead of
+#: flooding a possibly-still-broken shard.
+HALF_OPEN = "half_open"
+
+
+class NoHealthyShards(RuntimeError):
+    """Every shard is dead or draining — the front cannot place the row."""
+
+
+class ShardHandle:
+    """One shard's batcher plus its health bookkeeping."""
+
+    def __init__(self, shard_id: int, batcher, max_consecutive_errors: int):
+        self.shard_id = shard_id
+        self.batcher = batcher
+        self.state = HEALTHY
+        self.inflight = 0
+        self.rows_total = 0
+        self.errors_total = 0
+        self.consecutive_errors = 0
+        self.dead_since: float | None = None
+        # half-open probe: a shard revived because nothing else was
+        # healthy re-dies on its FIRST failure instead of getting a fresh
+        # error budget
+        self.probation = False
+        self._max_errors = max_consecutive_errors
+        label = str(shard_id)
+        self._g_healthy = metrics.mesh_shard_healthy.labels(label)
+        self._g_inflight = metrics.mesh_shard_inflight.labels(label)
+        self._c_rows = metrics.mesh_shard_rows.labels(label)
+        self._c_errors = metrics.mesh_shard_errors.labels(label)
+        self._g_healthy.set(1)
+        self._g_inflight.set(0)
+
+    def note_ok(self) -> bool:
+        """Record one scoring success; returns True when this success was
+        a half-open probe resolving — the shard revives (the caller
+        refreshes the health gauge)."""
+        self.consecutive_errors = 0
+        self.probation = False
+        self.rows_total += 1
+        self._c_rows.inc()
+        if self.state == HALF_OPEN:
+            self.set_state(HEALTHY)
+            return True
+        return False
+
+    def note_error(self, exc: BaseException) -> bool:
+        """Record one scoring failure; returns True when this crossed the
+        death threshold (the caller logs the shed). A probation shard
+        (half-open probe) dies on its first failure."""
+        self.errors_total += 1
+        self.consecutive_errors += 1
+        self._c_errors.inc()
+        if self.state in (HEALTHY, HALF_OPEN) and (
+            self.probation or self.consecutive_errors >= self._max_errors
+        ):
+            self.set_state(DEAD)
+            return True
+        return False
+
+    def set_state(self, state: str) -> None:
+        self.state = state
+        self.dead_since = time.monotonic() if state == DEAD else None
+        if state != HEALTHY:
+            self.probation = False
+        self._g_healthy.set(1 if state == HEALTHY else 0)
+
+    def to_dict(self) -> dict:
+        return {
+            "shard": self.shard_id,
+            "state": self.state,
+            "inflight": self.inflight,
+            "rows_total": self.rows_total,
+            "errors_total": self.errors_total,
+        }
+
+
+class ShardFront:
+    """Router over N shard batchers; same surface as one MicroBatcher
+    (``start``/``stop``/``score``), so the serving app swaps it in behind
+    ``/predict`` untouched."""
+
+    def __init__(
+        self,
+        batchers,
+        max_consecutive_errors: int | None = None,
+        reopen_after: float | None = None,
+    ):
+        if not batchers:
+            raise ValueError("ShardFront needs at least one shard batcher")
+        max_err = (
+            max_consecutive_errors
+            if max_consecutive_errors is not None
+            else config.mesh_shard_max_errors()
+        )
+        # half-open window: how long a dead shard rests before it may be
+        # probed when nothing else is healthy (self-healing — a transient
+        # failure correlated across shards must not need N manual revives)
+        self.reopen_after = (
+            reopen_after
+            if reopen_after is not None
+            else config.mesh_shard_reopen_s()
+        )
+        self.shards = [
+            ShardHandle(i, b, max_err) for i, b in enumerate(batchers)
+        ]
+        metrics.mesh_shards.set(len(self.shards))
+        metrics.mesh_shards_healthy.set(len(self.shards))
+
+    # -- MicroBatcher-compatible surface ------------------------------------
+    @property
+    def telemetry(self) -> bool:
+        return self.shards[0].batcher.telemetry
+
+    async def start(self) -> None:
+        # Shards share the slot's scorer and the watchtower's drift
+        # monitor, so ONE bucket-ladder warmup covers every shard —
+        # shard 0 warms, the rest skip straight to collecting.
+        for i, h in enumerate(self.shards):
+            await h.batcher.start(warm=(i == 0))
+
+    async def stop(self) -> None:
+        for h in self.shards:
+            await h.batcher.stop()
+
+    # -- routing ------------------------------------------------------------
+    def _healthy(self) -> list[ShardHandle]:
+        return [h for h in self.shards if h.state == HEALTHY]
+
+    def pick(self, exclude: set[int] | None = None) -> ShardHandle:
+        """Least-in-flight healthy shard (optionally excluding shards this
+        request already failed on — a fast-failing shard has the LOWEST
+        in-flight count, so without the exclusion a retry would re-pick
+        exactly the shard that just failed it)."""
+        healthy = [
+            h for h in self._healthy()
+            if not exclude or h.shard_id not in exclude
+        ]
+        if not healthy:
+            probe = self._half_open_candidate(exclude)
+            if probe is not None:
+                return probe
+            raise NoHealthyShards(
+                f"all {len(self.shards)} shards dead, draining, or already "
+                "tried by this request"
+            )
+        return min(healthy, key=lambda h: h.inflight)
+
+    def _half_open_candidate(self, exclude: set[int] | None) -> (
+        ShardHandle | None
+    ):
+        """Self-healing when every shard is dead: probe the longest-dead
+        shard whose rest window (``reopen_after``) has elapsed. The shard
+        moves to HALF_OPEN — still excluded from routing, so ONLY the
+        request that opened the probe rides it; concurrent traffic keeps
+        seeing NoHealthyShards (→ 503) instead of flooding a possibly
+        still-broken shard. One failure re-kills it instantly, a success
+        fully revives it. Without this, a transient failure correlated
+        across shards (shared device blip, one poisoned burst) would turn
+        into a permanent outage needing a manual revive per shard."""
+        now = time.monotonic()
+        rested = [
+            h for h in self.shards
+            if h.state == DEAD
+            and (not exclude or h.shard_id not in exclude)
+            and h.dead_since is not None
+            and now - h.dead_since >= self.reopen_after
+        ]
+        if not rested:
+            return None
+        probe = min(rested, key=lambda h: h.dead_since)
+        dead_for = now - probe.dead_since
+        probe.set_state(HALF_OPEN)
+        probe.probation = True
+        log.warning(
+            "shard %d half-open probe after %.1fs dead",
+            probe.shard_id, dead_for,
+        )
+        return probe
+
+    def _refresh_health_gauge(self) -> None:
+        metrics.mesh_shards_healthy.set(len(self._healthy()))
+
+    async def score(self, row, timeline=None) -> float:
+        """Route one row; a failing shard is retried elsewhere in the same
+        call (at most once per shard), so callers see a score or one final
+        error — never a dead shard's exception."""
+        last_exc: BaseException | None = None
+        tried: set[int] = set()
+        for _ in range(len(self.shards)):
+            try:
+                h = self.pick(exclude=tried)
+            except NoHealthyShards:
+                if last_exc is not None:
+                    raise last_exc
+                raise
+            tried.add(h.shard_id)
+            h.inflight += 1
+            h._g_inflight.set(h.inflight)
+            try:
+                # fraud-range injection point: a chaos plan fails a named
+                # shard's scoring here (the kill-a-shard drill). Disarmed
+                # this is one global load.
+                fire("mesh.shard_flush", shard=h.shard_id)
+                out = await h.batcher.score(row, timeline)
+            except Exception as e:
+                last_exc = e
+                if h.note_error(e):
+                    self._refresh_health_gauge()
+                    log.error(
+                        "shard %d marked dead after %d consecutive "
+                        "errors — shedding load (%s)",
+                        h.shard_id, h.consecutive_errors, e,
+                    )
+                continue
+            else:
+                if h.note_ok():  # a half-open probe resolved: shard revived
+                    self._refresh_health_gauge()
+                    log.warning(
+                        "shard %d revived by half-open probe", h.shard_id
+                    )
+                return out
+            finally:
+                h.inflight -= 1
+                h._g_inflight.set(h.inflight)
+        raise last_exc if last_exc is not None else NoHealthyShards(
+            "no healthy shards"
+        )
+
+    # -- operations ---------------------------------------------------------
+    def drain(self, shard_id: int) -> None:
+        """Stop routing new rows to ``shard_id``; in-flight rows finish.
+
+        Refuses to drain the LAST healthy shard: draining is the
+        safe-restart primitive, and a drain that silently turned every
+        request into NoHealthyShards would be a self-inflicted outage —
+        the operator gets the error at drain time instead."""
+        h = self.shards[shard_id]
+        if h.state != HEALTHY:
+            return
+        if len(self._healthy()) <= 1:
+            raise ValueError(
+                f"refusing to drain shard {shard_id}: it is the last "
+                "healthy shard — revive another shard first"
+            )
+        h.set_state(DRAINING)
+        self._refresh_health_gauge()
+        log.warning("shard %d draining", shard_id)
+
+    def wait_drained(self, shard_id: int, timeout: float = 10.0) -> bool:
+        """Block until a draining shard's in-flight count reaches zero.
+        Poll-based so operators can call it from a sync admin path."""
+        deadline = time.monotonic() + timeout
+        h = self.shards[shard_id]
+        while h.inflight > 0:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.002)
+        return True
+
+    def revive(self, shard_id: int) -> None:
+        """Re-admit a drained/dead shard (post-restart, post-fix)."""
+        h = self.shards[shard_id]
+        h.consecutive_errors = 0
+        h.probation = False  # an operator revive grants a full error budget
+        h.set_state(HEALTHY)
+        self._refresh_health_gauge()
+        log.warning("shard %d revived", shard_id)
+
+    def status(self) -> dict:
+        healthy = self._healthy()
+        return {
+            "shards": len(self.shards),
+            "healthy": len(healthy),
+            "per_shard": [h.to_dict() for h in self.shards],
+        }

@@ -1,0 +1,285 @@
+"""Cross-replica-sharded weight update + MapReduce feedback aggregation.
+
+Two idioms from the papers behind ISSUE 7:
+
+- **Sharded weight update** (arxiv 2004.13336): plain data-parallel SGD
+  allreduces the gradient and has every replica redundantly apply the
+  same update to a full replicated copy of the weights and optimizer
+  state. Here the update itself is sharded: each replica owns 1/N of the
+  parameter vector and its optimizer state, the per-batch gradient is
+  ``psum_scatter``-reduced straight into that shard (one collective doing
+  reduce+shard in one hop), the shard applies the momentum update to its
+  slice only, and the full vector is ``all_gather``-ed just-in-time for
+  the next forward pass. For a 30-feature logistic this is a mechanism
+  proof, not a memory win — but it is the exact program shape that makes
+  optimizer state O(P/N) for the wide-model families ``score_args``
+  generalizes to.
+- **MapReduce pool aggregation** (arxiv 2403.07128, DrJAX): the conductor's
+  feedback pools are aggregated as mapped-then-reduced per-shard
+  computation — each shard summarizes ITS rows (map), a ``psum`` reduces
+  the summaries (reduce) — instead of hauling every row to one host loop.
+
+``_sharded_update_epoch`` is a module-level jit (mesh static) so the
+compile sentinel wraps it (entrypoint ``mesh.sharded_update``) and
+meshcheck abstractly evaluates it at every virtual mesh size.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fraud_detection_tpu.ops.logistic import (
+    LogisticParams,
+    _cap_batch_size,
+    _resolve_sample_weight,
+)
+from fraud_detection_tpu.parallel.compat import shard_map
+from fraud_detection_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from fraud_detection_tpu.parallel.sharding import (
+    pad_to_multiple,
+    shard_batch,
+    sync_fetch,
+)
+
+
+def _pad_features(d: int, ndev: int) -> int:
+    """Parameter length padded so the shard axis divides it evenly (the
+    padded coefficients start at zero, see zero gradient, and stay zero)."""
+    return ((d + ndev - 1) // ndev) * ndev
+
+
+def _update_body(c: float, n_total: int, n_devices: int, momentum: float,
+                 batch: int):
+    """Per-shard epoch under shard_map: sharded params/velocity in, sharded
+    out. Each step all_gathers the full weight vector for the forward,
+    psum_scatters the gradient back onto the owning shards, and updates the
+    local slice + local momentum state only (2004.13336)."""
+
+    def epoch(coef_l, vel_l, intercept, vel_b, x_local, y_pm_local, sw_local,
+              valid_local, perm, lr):
+        n_local = x_local.shape[0]
+        n_batches = n_local // batch
+
+        def body(carry, i):
+            coef_l, vel_l, b, vel_b = carry
+            w = jax.lax.all_gather(coef_l, DATA_AXIS, axis=0, tiled=True)
+            idx = jax.lax.dynamic_slice_in_dim(perm, i * batch, batch)
+            xb = x_local[idx]
+            yb = y_pm_local[idx]
+            swb = sw_local[idx]
+            b_valid = jnp.maximum(
+                jax.lax.psum(jnp.sum(valid_local[idx]), DATA_AXIS), 1.0
+            )
+
+            def loss(w, b):
+                z = xb @ w + b
+                data = jnp.sum(swb * jax.nn.softplus(-yb * z)) * (c / b_valid)
+                # reg split across devices so the psum reconstitutes it once
+                reg = 0.5 * jnp.dot(w, w) / (n_total * n_devices)
+                return data + reg
+
+            gw, gb = jax.grad(loss, argnums=(0, 1))(w, b)
+            # reduce + shard in ONE collective: each shard receives the
+            # summed gradient of ITS parameter slice only
+            gw_l = jax.lax.psum_scatter(
+                gw, DATA_AXIS, scatter_dimension=0, tiled=True
+            )
+            gb = jax.lax.psum(gb, DATA_AXIS)
+            vel_l = momentum * vel_l - lr * gw_l
+            coef_l = coef_l + vel_l
+            vel_b = momentum * vel_b - lr * gb
+            b = b + vel_b
+            return (coef_l, vel_l, b, vel_b), None
+
+        (coef_l, vel_l, intercept, vel_b), _ = jax.lax.scan(
+            body, (coef_l, vel_l, intercept, vel_b), jnp.arange(n_batches)
+        )
+        return coef_l, vel_l, intercept, vel_b
+
+    return epoch
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "c", "n_total", "momentum", "batch"),
+    donate_argnums=(0, 1),
+)
+def _sharded_update_epoch(
+    coef_sh,  # (d_pad,) sharded over data — each shard owns its slice
+    vel_sh,   # (d_pad,) sharded — optimizer state is sharded too
+    intercept,  # () replicated
+    vel_b,      # () replicated
+    x,        # (n, d_pad) row-sharded
+    y_pm,     # (n,) ±1 labels, row-sharded
+    sw,       # (n,) sample weights (0 on padding), row-sharded
+    valid,    # (n,) row validity, row-sharded
+    perm,     # (n_local,) per-shard minibatch permutation, replicated
+    lr,       # () replicated
+    *,
+    mesh,
+    c: float,
+    n_total: int,
+    momentum: float,
+    batch: int,
+):
+    """One epoch of the cross-replica-sharded weight update. Registered in
+    meshcheck (``mesh.sharded_update``) and the compile sentinel."""
+    mapped = shard_map(
+        _update_body(c, n_total, mesh.shape[DATA_AXIS], momentum, batch),
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS), P(DATA_AXIS), P(), P(),
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            P(), P(),
+        ),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+        check_vma=False,
+    )
+    return mapped(
+        coef_sh, vel_sh, intercept, vel_b, x, y_pm, sw, valid, perm, lr
+    )
+
+
+def mesh_sgd_fit(
+    x,
+    y,
+    c: float = 1.0,
+    epochs: int = 5,
+    batch_size: int = 4096,
+    lr: float = 0.3,
+    momentum: float = 0.9,
+    class_weight: dict | str | None = None,
+    sample_weight=None,
+    seed: int = 0,
+    mesh=None,
+    warm_start: LogisticParams | None = None,
+) -> LogisticParams:
+    """Data-parallel minibatch SGD whose weight update is sharded across
+    the mesh instead of replicated. Same objective scaling as
+    :func:`~fraud_detection_tpu.ops.logistic.logistic_fit_sgd` (1/n-scaled
+    sklearn primal, cosine-decayed lr); ``warm_start`` seeds the sharded
+    params from the incumbent champion — the conductor's retrain path."""
+    mesh = mesh or default_mesh()
+    ndev = int(mesh.shape[DATA_AXIS])
+    x_np = np.asarray(x, np.float32)
+    y_np = np.asarray(y)
+    n, d = x_np.shape
+    d_pad = _pad_features(d, ndev)
+    if d_pad != d:
+        x_np = np.pad(x_np, ((0, 0), (0, d_pad - d)))
+    sw = _resolve_sample_weight(y_np, sample_weight, class_weight)
+    batch_size = _cap_batch_size(n, ndev, batch_size)
+
+    mult = ndev * batch_size
+    x_pad, _ = pad_to_multiple(x_np, mult)
+    y_pad, _ = pad_to_multiple(y_np, mult)
+    sw_pad, _ = pad_to_multiple(sw, mult)
+    valid = np.zeros((x_pad.shape[0],), np.float32)
+    valid[:n] = 1.0
+    y_pm = np.where(y_pad > 0, 1.0, -1.0).astype(np.float32)
+
+    x_dev, _ = shard_batch(x_pad, mesh)
+    y_dev, _ = shard_batch(y_pm, mesh)
+    sw_dev, _ = shard_batch(sw_pad, mesh)
+    valid_dev, _ = shard_batch(valid, mesh)
+
+    param_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    coef0 = np.zeros((d_pad,), np.float32)
+    b0 = np.float32(0.0)
+    if warm_start is not None:
+        coef0[:d] = np.asarray(warm_start.coef, np.float32)
+        b0 = np.float32(warm_start.intercept)
+    coef_sh = jax.device_put(coef0, param_sharding)
+    vel_sh = jax.device_put(np.zeros((d_pad,), np.float32), param_sharding)
+    intercept = jnp.float32(b0)
+    vel_b = jnp.float32(0.0)
+
+    n_local = x_pad.shape[0] // ndev
+    rng = np.random.default_rng(seed)
+    for e in range(epochs):
+        lr_e = jnp.float32(lr * 0.5 * (1.0 + np.cos(np.pi * e / max(epochs, 1))))
+        coef_sh, vel_sh, intercept, vel_b = _sharded_update_epoch(
+            coef_sh, vel_sh, intercept, vel_b,
+            x_dev, y_dev, sw_dev, valid_dev,
+            jnp.asarray(rng.permutation(n_local)), lr_e,
+            mesh=mesh, c=float(c), n_total=int(n),
+            momentum=float(momentum), batch=int(batch_size),
+        )
+    params = sync_fetch(
+        LogisticParams(coef=coef_sh, intercept=intercept)
+    )
+    return LogisticParams(
+        coef=jnp.asarray(np.asarray(params.coef)[:d]),
+        intercept=params.intercept,
+    )
+
+
+# --------------------------------------------------------------------------
+# MapReduce feedback-pool aggregation (2403.07128)
+# --------------------------------------------------------------------------
+
+
+def _pool_body(x, y, s, v):
+    """Map: this shard's pool summary. Reduce: psum over the data axis."""
+    red = lambda t: jax.lax.psum(t, DATA_AXIS)  # noqa: E731
+    n = red(jnp.sum(v))
+    n_pos = red(jnp.sum(v * y))
+    s_sum = red(jnp.sum(v * s))
+    fx = red(v @ x)
+    fx2 = red(v @ (x * x))
+    return n, n_pos, s_sum, fx, fx2
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _pool_stats(x, y, scores, valid, *, mesh):
+    mapped = shard_map(
+        _pool_body,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS),) * 4,
+        out_specs=(P(),) * 5,
+        check_vma=False,
+    )
+    return mapped(x, y, scores, valid)
+
+
+def mapreduce_pool_stats(x, y, scores, mesh=None) -> dict:
+    """Aggregate a (possibly sharded-origin) labeled feedback pool into the
+    summary the retrain executor logs and gates on: row/positive counts,
+    score mean, per-feature mean/std — computed map-side per shard, reduced
+    with one psum, never concatenated on host."""
+    x_np = np.asarray(x, np.float32)
+    if x_np.ndim == 1:
+        x_np = x_np[None, :]
+    n, d = x_np.shape
+    if n == 0:
+        zeros = np.zeros((d,), np.float64)
+        return {
+            "rows": 0, "positives": 0, "label_rate": 0.0,
+            "score_mean": 0.0, "feature_mean": zeros, "feature_std": zeros,
+        }
+    mesh = mesh or default_mesh()
+    x_dev, _ = shard_batch(x_np, mesh)
+    y_dev, _ = shard_batch(np.asarray(y, np.float32), mesh)
+    s_dev, _ = shard_batch(np.asarray(scores, np.float32), mesh)
+    valid = np.zeros((x_dev.shape[0],), np.float32)
+    valid[:n] = 1.0
+    v_dev, _ = shard_batch(valid, mesh)
+    cnt, n_pos, s_sum, fx, fx2 = _pool_stats(
+        x_dev, y_dev, s_dev, v_dev, mesh=mesh
+    )
+    cnt_f = max(float(cnt), 1.0)
+    mean = np.asarray(fx, np.float64) / cnt_f
+    var = np.maximum(np.asarray(fx2, np.float64) / cnt_f - mean**2, 0.0)
+    return {
+        "rows": int(round(float(cnt))),
+        "positives": int(round(float(n_pos))),
+        "label_rate": float(n_pos) / cnt_f,
+        "score_mean": float(s_sum) / cnt_f,
+        "feature_mean": mean,
+        "feature_std": np.sqrt(var),
+    }

@@ -1,0 +1,246 @@
+"""The fastlane fused flush as ONE ``shard_map``-mapped program.
+
+``monitor/drift._fused_flush`` collapsed the serving flush to a single
+device dispatch (scores + drift-window fold, window donated through); this
+module spreads that exact program across the serving mesh's data axis:
+
+- the staged batch rows shard over ``data`` (each device scores 1/N of the
+  bucket), the scorer params ride replicated (``score_args`` is a pytree —
+  a tensor-parallel family would carry sharded leaves there instead);
+- every shard folds ITS rows into ITS OWN drift window: the window pytree
+  gains a leading shard axis sharded over ``data``, donated through every
+  flush exactly like the single-device window, and **merged only at scrape
+  time** (:func:`merge_window`) — no cross-shard collective ever rides the
+  hot path, so a flush still costs each shard exactly one dispatch and
+  zero communication;
+- scrape-time merging is exact for the histogram fields: bin masses are
+  sums of {0,1} validity weights, so per-shard partial sums are
+  integer-valued f32 — addition order cannot change the merged counts
+  until exponential decay (< 1) makes them fractional, at which point the
+  divergence vs a single window is one ulp-scale reassociation.
+
+One module-level jitted function (``_sharded_flush``) with the mesh and
+score body static: the compile sentinel wraps it (entrypoint
+``mesh.sharded_flush``), meshcheck abstractly evaluates it at every
+virtual mesh size, and jit caches one executable per (bucket, mesh,
+scorer-family) — the bucket ladder discipline is unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fraud_detection_tpu.monitor.baseline import (
+    BaselineProfile,
+    feature_histogram,
+    score_histogram,
+)
+from fraud_detection_tpu.monitor.drift import (
+    N_CALIB_BINS,
+    DriftMonitor,
+    DriftWindow,
+)
+from fraud_detection_tpu.parallel.compat import shard_map
+from fraud_detection_tpu.parallel.mesh import DATA_AXIS
+
+
+def init_sharded_window(
+    n_shards: int,
+    n_features: int,
+    n_feature_bins: int,
+    n_score_bins: int,
+    mesh=None,
+    n_calib_bins: int = N_CALIB_BINS,
+) -> DriftWindow:
+    """Per-shard drift windows: every :class:`DriftWindow` leaf gains a
+    leading ``(n_shards,)`` axis, laid out over the mesh's data axis when a
+    mesh is given (so donation keeps each shard's slice on its device)."""
+    sharding = (
+        NamedSharding(mesh, P(DATA_AXIS)) if mesh is not None else None
+    )
+
+    def z(*shape):
+        buf = np.zeros((n_shards, *shape), np.float32)
+        if sharding is None:
+            return jnp.asarray(buf)
+        return jax.device_put(buf, sharding)
+
+    return DriftWindow(
+        feature_counts=z(n_features, n_feature_bins),
+        score_counts=z(n_score_bins),
+        calib_count=z(n_calib_bins),
+        calib_conf=z(n_calib_bins),
+        calib_label=z(n_calib_bins),
+        n_rows=z(),
+    )
+
+
+@jax.jit
+def _merge_window(shard_window: DriftWindow) -> DriftWindow:
+    """Scrape-time reduce: sum the per-shard windows over the shard axis."""
+    return jax.tree.map(lambda t: jnp.sum(t, axis=0), shard_window)
+
+
+def merge_window(shard_window: DriftWindow) -> DriftWindow:
+    return _merge_window(shard_window)
+
+
+@jax.jit
+def _merge_total(
+    shard_window: DriftWindow, base_window: DriftWindow
+) -> DriftWindow:
+    """Merged shard evidence + the host-side window (calibration state from
+    labeled feedback replays lives there) — the window stats() reads."""
+    merged = jax.tree.map(lambda t: jnp.sum(t, axis=0), shard_window)
+    return jax.tree.map(lambda a, b: a + b, merged, base_window)
+
+
+def _shard_body(
+    window: DriftWindow,
+    x: jax.Array,
+    valid: jax.Array,
+    decay: jax.Array,
+    feature_edges: jax.Array,
+    score_edges: jax.Array,
+    score_args,
+    *,
+    score_fn,
+):
+    """Per-shard flush body under shard_map: identical math to
+    ``drift._fused_flush`` over this shard's rows and THIS shard's window
+    (the leading shard axis arrives as size 1 inside the block view). The
+    global ``decay`` applies to every shard, so the merged window evolves
+    exactly as the single-device window would for the same batch stream."""
+    w = jax.tree.map(lambda t: t[0], window)
+    xf = x.astype(jnp.float32)
+    scores = score_fn(score_args, x).astype(jnp.float32)
+    fc = feature_histogram(xf, feature_edges, weights=valid)
+    sc = score_histogram(scores, score_edges, weights=valid)
+    new = DriftWindow(
+        feature_counts=w.feature_counts * decay + fc,
+        score_counts=w.score_counts * decay + sc,
+        calib_count=w.calib_count,
+        calib_conf=w.calib_conf,
+        calib_label=w.calib_label,
+        n_rows=w.n_rows * decay + jnp.sum(valid),
+    )
+    return scores, jax.tree.map(lambda t: t[None], new)
+
+
+@partial(jax.jit, static_argnames=("score_fn", "mesh"), donate_argnums=(0,))
+def _sharded_flush(
+    window: DriftWindow,  # per-shard windows, leading axis = shard
+    x: jax.Array,  # (b, d) staged bucket, b % n_shards == 0
+    valid: jax.Array,  # (b,)
+    decay: jax.Array,  # () global drift forgetting factor
+    feature_edges: jax.Array,
+    score_edges: jax.Array,
+    score_args,  # pytree, replicated (linear family) — TP-sharded leaves OK
+    *,
+    score_fn,
+    mesh,
+):
+    """The switchyard flush program: ONE dispatch executes the fused
+    score+drift-fold on every shard of the serving mesh. Registered in
+    meshcheck (``mesh.sharded_flush``) and the compile sentinel."""
+    mapped = shard_map(
+        partial(_shard_body, score_fn=score_fn),
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),  # window: shard axis
+            P(DATA_AXIS),  # x: rows
+            P(DATA_AXIS),  # valid: rows
+            P(),           # decay
+            P(),           # feature_edges
+            P(),           # score_edges
+            P(),           # score_args (replicated pytree prefix)
+        ),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
+    )
+    return mapped(
+        window, x, valid, decay, feature_edges, score_edges, score_args
+    )
+
+
+class MeshDriftMonitor(DriftMonitor):
+    """Drift monitoring for the sharded serving mesh.
+
+    Drop-in for :class:`~fraud_detection_tpu.monitor.drift.DriftMonitor`
+    behind the micro-batcher's fused target: ``fused_flush`` dispatches the
+    shard_map program instead of the single-device one, keeping the
+    one-dispatch-per-flush contract while the batch spans the mesh. Live
+    drift evidence accumulates in the per-shard windows; labeled feedback
+    replays keep using the inherited host-side ``update()`` path (they fold
+    into the base window's calibration state), and ``stats()`` reads the
+    merged total — per-shard windows are reduced only at scrape time."""
+
+    def __init__(
+        self,
+        profile: BaselineProfile,
+        mesh,
+        halflife_rows: float | None = None,
+        min_bucket: int = 8,
+    ):
+        n_shards = int(mesh.shape[DATA_AXIS])
+        if n_shards & (n_shards - 1):
+            raise ValueError(
+                f"mesh data axis must be a power of two, got {n_shards}"
+            )
+        if n_shards > min_bucket:
+            # The micro-batcher buckets and warms by the SCORER's
+            # min_bucket, not this monitor's — a shard count above the
+            # smallest bucket would fail every lone-request flush (8 rows
+            # cannot shard over 16 devices). Refuse loudly at construction
+            # instead of crashing the warmup ladder.
+            raise ValueError(
+                f"{n_shards} flush shards exceed the smallest flush "
+                f"bucket ({min_bucket}) — every bucket must hand each "
+                "shard at least one row (see topology.MAX_FLUSH_SHARDS)"
+            )
+        super().__init__(
+            profile,
+            halflife_rows=halflife_rows,
+            min_bucket=min_bucket,
+        )
+        self.mesh = mesh
+        self.n_shards = n_shards
+        self.shard_window = init_sharded_window(
+            n_shards,
+            profile.n_features,
+            profile.feature_counts.shape[1],
+            profile.score_counts.shape[0],
+            mesh=mesh,
+        )
+
+    def fused_flush(
+        self, x: jax.Array, valid: jax.Array, n_live: int, score_args, score_fn
+    ) -> jax.Array:
+        """Score one staged bucket across every shard AND fold each shard's
+        rows into its own window — one dispatch, no collectives. Same
+        locking contract as the base class: the critical section is the
+        async dispatch plus the donated-state store."""
+        # graftcheck: hot-path
+        decay = self._decay_for(n_live)
+        with self._lock:
+            scores, self.shard_window = _sharded_flush(
+                self.shard_window,
+                x,
+                valid,
+                decay,
+                self._feature_edges,
+                self._score_edges,
+                score_args,
+                score_fn=score_fn,
+                mesh=self.mesh,
+            )
+            self.rows_seen += n_live
+        return scores
+
+    def _window_for_stats(self) -> DriftWindow:
+        return _merge_total(self.shard_window, self.window)

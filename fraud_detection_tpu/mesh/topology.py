@@ -1,0 +1,93 @@
+"""Serving-mesh topology: the data axis the switchyard shards over.
+
+The training tier already has a ``(data, model)`` mesh
+(:mod:`fraud_detection_tpu.parallel.mesh`); serving reuses the same axis
+names so the sharded flush and the sharded retrain update compose with the
+existing collectives. The serving mesh is 1-D over ``data``: the scaling
+axis of a fraud scorer is rows, and the 30-feature linear flagship has
+nothing worth tensor-sharding (the mechanism generalizes through
+``score_args`` being an arbitrary pytree — a TP-sharded family would carry
+sharded params there).
+
+Real accelerators when present; otherwise the *virtual CPU shards*
+meshcheck proves shapes on (``--xla_force_host_platform_device_count``)
+become the live topology — the promotion of that static gate to the real
+serving path that ISSUE 7 names.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+from jax.sharding import Mesh
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.parallel.mesh import MeshSpec, create_mesh
+
+log = logging.getLogger("fraud_detection_tpu.mesh")
+
+#: Hard ceiling on the flush shard count: every flush bucket must divide
+#: across the shards, and the smallest bucket the serving scorers emit is
+#: their min_bucket (ops/scorer default 8) — a lone-request flush pads to
+#: it. More shards than that cannot receive a row each.
+MAX_FLUSH_SHARDS = 8
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+def serving_mesh_size(requested: int | None = None) -> int:
+    """Resolve the serving mesh's data-axis size.
+
+    ``requested`` (default: the ``MESH_FLUSH_DEVICES`` knob) is clamped to
+    the local device count AND :data:`MAX_FLUSH_SHARDS` (the smallest
+    flush bucket — a lone-request flush pads to the scorer's min_bucket
+    and must still hand every shard a row), then floored to a power of
+    two — flush buckets are powers of two, and every bucket must divide
+    evenly across shards (the row-sharded ``shard_map`` needs equal
+    per-shard rows). 0 resolves to 1 (single-device fastlane)."""
+    n = config.mesh_flush_devices() if requested is None else requested
+    if n <= 1:
+        return 1
+    avail = jax.device_count()
+    if n > avail:
+        log.warning(
+            "MESH_FLUSH_DEVICES=%d but only %d device(s) present — "
+            "clamping (set XLA_FLAGS=--xla_force_host_platform_device_"
+            "count=N for virtual CPU shards)", n, avail,
+        )
+        n = avail
+    if n > MAX_FLUSH_SHARDS:
+        log.warning(
+            "MESH_FLUSH_DEVICES=%d exceeds the smallest flush bucket "
+            "(%d) — clamping; a lone-request flush could not hand every "
+            "shard a row", n, MAX_FLUSH_SHARDS,
+        )
+        n = MAX_FLUSH_SHARDS
+    while not _is_pow2(n):
+        n -= 1
+    return max(n, 1)
+
+
+def serving_mesh(n_shards: int | None = None, devices=None) -> Mesh:
+    """Build the 1-D ``data`` serving mesh over the first ``n_shards``
+    devices (resolved via :func:`serving_mesh_size` when None)."""
+    if devices is None:
+        devices = jax.devices()
+    # an explicit size is validated strictly below; only the knob-resolved
+    # default gets the clamp-and-floor treatment
+    n = serving_mesh_size() if n_shards is None else n_shards
+    if n > len(devices):
+        raise ValueError(
+            f"serving mesh needs {n} devices, have {len(devices)} — run "
+            "under XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{n} for virtual CPU shards"
+        )
+    if not _is_pow2(n):
+        raise ValueError(
+            f"serving mesh size must be a power of two (flush buckets "
+            f"must divide evenly across shards), got {n}"
+        )
+    return create_mesh(MeshSpec(data=n), devices=devices[:n])

@@ -382,11 +382,19 @@ class DriftMonitor:
             if not calibration_only:
                 self.rows_seen += n
 
+    def _window_for_stats(self) -> DriftWindow:
+        """The window ``stats()`` derives from — the mesh subclass returns
+        the per-shard windows merged with the host-side window here (the
+        scrape-time reduce), the base class its one live window. Called
+        under the lock."""
+        return self.window
+
     def stats(self) -> dict:
         """Host-synced snapshot (small arrays; called at status/scrape time,
         never on the per-batch path)."""
         with self._lock:
-            s = _drift_stats(self.window, self._base_fc, self._base_sc)
+            window = self._window_for_stats()
+            s = _drift_stats(window, self._base_fc, self._base_sc)
             # materialize inside the lock: once released, the next update
             # donates the window buffers these device values derive from
             feature_psi = np.asarray(s.feature_psi, np.float64)
@@ -395,7 +403,7 @@ class DriftMonitor:
             score_ks = float(s.score_ks)
             ece = float(s.ece)
             n_labeled = float(s.n_labeled)
-            window_rows = float(self.window.n_rows)
+            window_rows = float(window.n_rows)
             rows_seen = self.rows_seen
         order = np.argsort(feature_psi)[::-1][:5]
         top = [

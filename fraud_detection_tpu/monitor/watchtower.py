@@ -103,11 +103,17 @@ class Watchtower:
         retrain_sender=None,
         action_sender=None,
         max_backlog: int = 32,
+        mesh=None,
     ):
         self.thresholds = thresholds or Thresholds.from_config()
         self._sample_rate = sample_rate
         self._halflife_rows = halflife_rows
-        self.drift = DriftMonitor(profile, halflife_rows=halflife_rows)
+        # Switchyard: with a serving mesh, the drift window shards over the
+        # data axis (per-shard windows donated through the SPMD fused
+        # flush, merged at scrape time) — the micro-batcher's fused target
+        # resolves the same fused_flush surface either way.
+        self._mesh = mesh
+        self.drift = self._make_drift(profile)
         self.shadow = (
             ShadowScorer(
                 challenger.scorer,
@@ -141,6 +147,15 @@ class Watchtower:
             target=self._ingest_loop, name="watchtower-ingest", daemon=True
         )
         self._thread.start()
+
+    def _make_drift(self, profile) -> DriftMonitor:
+        if self._mesh is not None:
+            from fraud_detection_tpu.mesh.shardflush import MeshDriftMonitor
+
+            return MeshDriftMonitor(
+                profile, self._mesh, halflife_rows=self._halflife_rows
+            )
+        return DriftMonitor(profile, halflife_rows=self._halflife_rows)
 
     # -- ingest (request path adjacent; must never block) -------------------
     def wants_rows(self) -> bool:
@@ -364,7 +379,7 @@ class Watchtower:
                 "keeps the previous baseline"
             )
             return
-        self.drift = DriftMonitor(profile, halflife_rows=self._halflife_rows)
+        self.drift = self._make_drift(profile)
         if self.shadow is not None:
             # the old challenger IS usually the new champion — comparing a
             # model to itself reads as perfect agreement and would mask a
@@ -426,11 +441,14 @@ def resolve_profile_dir(model_source: str) -> str | None:
 
 
 def build_watchtower(
-    model, model_source: str, retrain_sender=None, action_sender=None
+    model, model_source: str, retrain_sender=None, action_sender=None,
+    mesh=None,
 ):
     """Serving-side factory: None when disabled (``WATCHTOWER_ENABLED=0``)
     or when the resolved model artifacts carry no baseline profile (models
-    trained before the watchtower existed keep serving, unmonitored)."""
+    trained before the watchtower existed keep serving, unmonitored).
+    ``mesh`` (the switchyard serving mesh) shards the drift window over the
+    data axis — see mesh/shardflush."""
     enabled = config.watchtower_enabled()
     if enabled is False:
         return None
@@ -479,6 +497,7 @@ def build_watchtower(
         challenger_source=challenger_source,
         retrain_sender=retrain_sender,
         action_sender=action_sender,
+        mesh=mesh,
     )
     log.info(
         "watchtower active: baseline over %d rows, challenger=%s",

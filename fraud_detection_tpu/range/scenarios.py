@@ -31,6 +31,12 @@ The suite (``run_scenario(name)``):
 ``hot_swap``              champion hot-swapped mid-burst; p99 holds across
                           the swap, zero new XLA compiles (no recompile
                           storm), every row scored
+``shard_kill_mid_swap``   a switchyard shard killed WHILE a promotion
+                          lands; load sheds to healthy shards, exactly one
+                          swap applies, the ladder stays warm, p99 holds
+``replica_burst``         burst across replica shards while one drains;
+                          p99 holds, in-flight empties cleanly, survivors
+                          share the load
 ========================  ==================================================
 """
 
@@ -746,6 +752,243 @@ def scenario_hot_swap(
     return result
 
 
+# -- switchyard scenarios ----------------------------------------------------
+
+def _shard_front(rm, wt, n_shards: int, slot=None, max_batch: int = 512):
+    from fraud_detection_tpu.mesh.front import ShardFront
+    from fraud_detection_tpu.service.microbatch import MicroBatcher
+
+    kw = dict(max_batch=max_batch, max_wait_ms=2.0, telemetry=False)
+    if slot is not None:
+        batchers = [
+            MicroBatcher(slot=slot, watchtower=wt, **kw)
+            for _ in range(n_shards)
+        ]
+    else:
+        batchers = [
+            MicroBatcher(scorer=rm.model.scorer, watchtower=wt, **kw)
+            for _ in range(n_shards)
+        ]
+    return ShardFront(batchers, max_consecutive_errors=3)
+
+
+def scenario_shard_kill_mid_swap(
+    seed: int = 2026, total_rows: int = 4096, n_shards: int = 3,
+    victim: int = 1,
+) -> ScenarioResult:
+    """Kill a switchyard shard WHILE a promotion hot-swap lands: the dead
+    shard must shed its load to the healthy shards (every row still
+    scored), exactly one swap must apply across all shards (they share the
+    slot), the pre-warmed ladder must hold (zero new fused-flush compiles
+    after the swap), and p99 must survive both disturbances at once."""
+    from fraud_detection_tpu.lifecycle.swap import ModelSlot, warm_scorer
+    from fraud_detection_tpu.mesh.front import DEAD
+    from fraud_detection_tpu.monitor import drift as drift_mod
+
+    rm = build_model(seed=seed)
+    challenger = build_model(seed=seed + 1)
+    wt = _watchtower(rm.profile)
+    slot = ModelSlot(rm.model, "range:champion", 1)
+    spec = CampaignSpec(
+        total_rows=total_rows, seed=seed, w_true=rm.w_true,
+        arrivals=ArrivalProcess(rate_hz=4000.0, window_s=0.01),
+    )
+    swap_state = {"swapped": False, "compiles_before": None}
+    kill_armed = {"on": False}
+    injected = {"n": 0}  # ACTUAL injected failures — the call rule fires
+    # (and counts in plan.fired()) on every routed row, raising only for
+    # the armed victim, so plan.fired() alone would be routing volume
+    fronts: list = []
+
+    def shard_fault(shard=None, **_):
+        if kill_armed["on"] and shard == victim:
+            injected["n"] += 1
+            raise RuntimeError("range: injected shard flush failure")
+
+    def swap_and_kill(front) -> None:
+        # the ModelReloader sequence minus the registry (warm off-path,
+        # then flip) — with the victim shard dying in the same window
+        kill_armed["on"] = True
+        warm_scorer(challenger.model.scorer, max_batch=512)
+        swap_state["compiles_before"] = drift_mod._fused_flush._cache_size()
+        slot.swap(challenger.model, "range:challenger", 2)
+        swap_state["swapped"] = True
+
+    def factory():
+        front = _shard_front(rm, wt, n_shards, slot=slot)
+        fronts.append(front)
+        return front
+
+    plan = faults.FaultPlan().call("mesh.shard_flush", shard_fault, times=-1)
+    result = ScenarioResult("shard_kill_mid_swap")
+    try:
+        with plan.armed():
+            out = _drive_bursts(
+                factory, CampaignTraffic(spec), mid_stream=swap_and_kill
+            )
+        compiles_after = drift_mod._fused_flush._cache_size()
+    finally:
+        wt.close()
+    front = fronts[0]
+    status = front.status()
+    compiles_delta = (
+        compiles_after - swap_state["compiles_before"]
+        if swap_state["swapped"]
+        else None
+    )
+    result.metrics = {
+        "rows": total_rows,
+        "rows_scored": out["rows_scored"],
+        "shards": n_shards,
+        "victim": victim,
+        "victim_state": status["per_shard"][victim]["state"],
+        "victim_errors": status["per_shard"][victim]["errors_total"],
+        "healthy_after": status["healthy"],
+        "baseline_p99_ms": round(out["baseline_p99_s"] * 1e3, 3),
+        "chaos_p99_ms": round(
+            float(np.percentile(out["latencies_s"], 99)) * 1e3, 3
+        ),
+        "post_swap_compiles": compiles_delta,
+        "failures_injected": injected["n"],
+    }
+    result.add(
+        InvariantOutcome(
+            "shard-killed",
+            status["per_shard"][victim]["state"] == DEAD
+            and injected["n"] > 0,
+            f"victim shard {victim} ended {status['per_shard'][victim]['state']!r} "
+            f"after {injected['n']} injected failure(s)",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "load-shed",
+            out["rows_scored"] == total_rows,
+            f"{out['rows_scored']}/{total_rows} rows scored with a shard "
+            "dead — the front must shed, not drop",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "exactly-once-swap",
+            swap_state["swapped"] and slot.version == 2,
+            f"slot serves v{slot.version} (one swap, shared by all shards)",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "ladder-stays-warm",
+            compiles_delta == 0,
+            f"{compiles_delta} fused-flush executables compiled after the "
+            "pre-warmed swap (must be 0 — the shards share the ladder)",
+        )
+    )
+    result.add(
+        p99_within(
+            out["latencies_s"], out["baseline_p99_s"],
+            factor=10.0, absolute_floor_s=0.25,
+        )
+    )
+    return result
+
+
+def scenario_replica_burst(
+    seed: int = 2026, total_rows: int = 4096, n_shards: int = 4,
+    drain_shard: int = 0,
+) -> ScenarioResult:
+    """Burst traffic across replica shards while one shard drains: p99
+    holds through the drain, every row is scored, the drained shard's
+    in-flight count empties, and the survivors share the load without a
+    pathological skew (least-in-flight routing)."""
+    from fraud_detection_tpu.mesh.front import DRAINING
+
+    rm = build_model(seed=seed)
+    wt = _watchtower(rm.profile)
+    spec = CampaignSpec(
+        total_rows=total_rows, seed=seed, w_true=rm.w_true,
+        arrivals=ArrivalProcess(rate_hz=4000.0, window_s=0.01),
+    )
+    fronts: list = []
+    drained = {"ok": None, "rows_at_drain": None}
+
+    def factory():
+        front = _shard_front(rm, wt, n_shards, max_batch=256)
+        fronts.append(front)
+        return front
+
+    def drain_mid(front) -> None:
+        # snapshot BEFORE draining: the load-sharing invariant must hold
+        # on post-drain deltas — cumulative totals would pass vacuously
+        # on pre-drain traffic alone
+        drained["rows_at_drain"] = [h.rows_total for h in front.shards]
+        front.drain(drain_shard)
+        drained["ok"] = front.wait_drained(drain_shard, timeout=15.0)
+
+    result = ScenarioResult("replica_burst")
+    try:
+        out = _drive_bursts(
+            factory, CampaignTraffic(spec), mid_stream=drain_mid
+        )
+    finally:
+        wt.close()
+    front = fronts[0]
+    status = front.status()
+    at_drain = drained["rows_at_drain"] or [0] * n_shards
+    survivor_rows = [
+        s["rows_total"] - at_drain[s["shard"]]
+        for s in status["per_shard"]
+        if s["shard"] != drain_shard
+    ]
+    result.metrics = {
+        "rows": total_rows,
+        "rows_scored": out["rows_scored"],
+        "shards": n_shards,
+        "drained_shard": drain_shard,
+        "drained_state": status["per_shard"][drain_shard]["state"],
+        "rows_per_shard": [s["rows_total"] for s in status["per_shard"]],
+        "post_drain_rows_per_survivor": survivor_rows,
+        "baseline_p99_ms": round(out["baseline_p99_s"] * 1e3, 3),
+        "burst_p99_ms": round(
+            float(np.percentile(out["latencies_s"], 99)) * 1e3, 3
+        ),
+    }
+    result.add(
+        InvariantOutcome(
+            "drain-clean",
+            drained["ok"] is True
+            and status["per_shard"][drain_shard]["state"] == DRAINING
+            and status["per_shard"][drain_shard]["inflight"] == 0,
+            f"shard {drain_shard} drained to 0 in-flight "
+            f"(state {status['per_shard'][drain_shard]['state']!r})",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "all-rows-scored",
+            out["rows_scored"] == total_rows,
+            f"{out['rows_scored']}/{total_rows} rows returned a score "
+            "across the drain",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "survivors-share-load",
+            drained["rows_at_drain"] is not None
+            and all(r > 0 for r in survivor_rows),
+            f"post-drain routed rows per survivor {survivor_rows} — every "
+            "healthy shard must carry traffic AFTER the drain (deltas "
+            "from the drain-time snapshot, not cumulative totals)",
+        )
+    )
+    result.add(
+        p99_within(
+            out["latencies_s"], out["baseline_p99_s"],
+            factor=10.0, absolute_floor_s=0.25,
+        )
+    )
+    return result
+
+
 # -- registry ----------------------------------------------------------------
 
 SCENARIOS = {
@@ -755,6 +998,8 @@ SCENARIOS = {
     "label_delay": scenario_label_delay,
     "control_plane_chaos": scenario_control_plane_chaos,
     "hot_swap": scenario_hot_swap,
+    "shard_kill_mid_swap": scenario_shard_kill_mid_swap,
+    "replica_burst": scenario_replica_burst,
 }
 
 #: scenarios that need a scratch directory as their first argument

@@ -19,6 +19,8 @@ Endpoint-for-endpoint rebuild of the reference's FastAPI app (api/app.py):
   watchtower's windowed-calibration (ECE) monitoring
 - ``GET /debug/flightrecorder`` — the spyglass ring of the last N scored
   requests (stage timelines, batch/bucket, model version, drift flag)
+- ``GET /mesh/status`` / ``POST /admin/shard/drain`` — switchyard front
+  state and the drain/revive operations (MESH_SHARDS>1; mesh/front)
 - ``POST /admin/profile`` — duration-bounded, single-flight on-demand
   device trace of the live service (auth-gated like ``/admin/reload``)
 
@@ -49,6 +51,7 @@ import uuid
 import numpy as np
 
 from fraud_detection_tpu import config
+from fraud_detection_tpu.mesh.front import NoHealthyShards
 from fraud_detection_tpu.service import metrics
 from fraud_detection_tpu.service.db import ResultsDB
 from fraud_detection_tpu.service.http import App, HTTPError, Request, Response
@@ -87,15 +90,23 @@ _STORE_OUTAGE_ERRORS = (sqlite3.Error, StoreError, OSError)
 STORE_RETRY_AFTER_S = 10  # ≥ the net client's exhausted retry budget
 
 
+def _unavailable(error: str, detail: str, retry_after_s: int) -> Response:
+    """The 503 degradation contract shared by every known-retryable outage
+    (store down, all scoring shards dead): one body/header shape so
+    clients and load balancers back off uniformly."""
+    return Response(
+        {"error": error, "detail": detail},
+        status_code=503,
+        headers={"retry-after": str(retry_after_s)},
+    )
+
+
 def _store_unavailable(what: str, e: Exception) -> Response:
     log.warning("%s unavailable (store outage): %s", what, e)
-    return Response(
-        {
-            "error": f"{what} temporarily unavailable — store outage",
-            "detail": str(e),
-        },
-        status_code=503,
-        headers={"retry-after": str(STORE_RETRY_AFTER_S)},
+    return _unavailable(
+        f"{what} temporarily unavailable — store outage",
+        str(e),
+        STORE_RETRY_AFTER_S,
     )
 
 
@@ -250,10 +261,19 @@ def create_app(
                 def _action_sender(task: str, reason: str) -> None:
                     state["broker"].send_task(task, [reason])
 
+                # Switchyard: MESH_FLUSH_DEVICES>1 shards the fused flush
+                # (and its drift window) over the serving mesh — one SPMD
+                # dispatch per flush spanning the data axis.
+                mesh = None
+                if config.mesh_flush_devices() > 1:
+                    from fraud_detection_tpu.mesh import serving_mesh
+
+                    mesh = serving_mesh()
                 state["watchtower"] = build_watchtower(
                     model, source,
                     retrain_sender=_retrain_sender,
                     action_sender=_action_sender,
+                    mesh=mesh,
                 )
             except Exception as e:
                 state["watchtower"] = None
@@ -269,11 +289,32 @@ def create_app(
             metrics.lifecycle_active_model_version.set(
                 state["slot"].version or 0
             )
-            batcher = MicroBatcher(
-                slot=state["slot"],
-                watchtower=state["watchtower"],
-                recorder=state["flightrecorder"],
-            )
+            # Switchyard front: MESH_SHARDS>1 runs that many replica
+            # batchers behind the router (health tracking + draining; a
+            # dead shard sheds load). All shards share the ModelSlot, so
+            # promotions land on every shard between in-flight flushes,
+            # and the shared scorer means one pre-warmed bucket ladder
+            # covers them all.
+            n_shards = config.mesh_shards()
+            if n_shards > 1:
+                from fraud_detection_tpu.mesh import ShardFront
+
+                batcher = ShardFront(
+                    [
+                        MicroBatcher(
+                            slot=state["slot"],
+                            watchtower=state["watchtower"],
+                            recorder=state["flightrecorder"],
+                        )
+                        for _ in range(n_shards)
+                    ]
+                )
+            else:
+                batcher = MicroBatcher(
+                    slot=state["slot"],
+                    watchtower=state["watchtower"],
+                    recorder=state["flightrecorder"],
+                )
             await batcher.start()  # warms the bucket ladder; can raise
             state["batcher"] = batcher
             # Alias watcher: promotion flips reach this process without a
@@ -375,7 +416,22 @@ def create_app(
         )
         with span("predict", correlation_id=corr_id):
             with metrics.timed(metrics.inference_duration):
-                score = await state["batcher"].score(row, timeline=timeline)
+                try:
+                    score = await state["batcher"].score(
+                        row, timeline=timeline
+                    )
+                except NoHealthyShards as e:
+                    # every switchyard shard dead/draining: a known,
+                    # retryable capacity outage — same 503 + Retry-After
+                    # degradation contract as the store-outage endpoints,
+                    # never a generic 500. The half-open probe re-admits
+                    # a rested shard within ~MESH_SHARD_REOPEN_S.
+                    log.error("[%s] no healthy shards: %s", corr_id, e)
+                    return _unavailable(
+                        "no healthy scoring shards",
+                        str(e),
+                        max(int(config.mesh_shard_reopen_s()), 1),
+                    )
             if timeline is not None:
                 # re-emit the stage decomposition as child spans of this
                 # predict span (explicit timestamps from the timeline)
@@ -462,6 +518,63 @@ def create_app(
         # dependency probes.
         body = await asyncio.to_thread(wt.status)
         return Response(body)
+
+    @app.get("/mesh/status")
+    async def mesh_status(req: Request) -> Response:
+        """Switchyard front state: shard health, in-flight counts, routed
+        row/error totals. ``enabled: false`` when serving runs the
+        single-batcher path (MESH_SHARDS unset)."""
+        batcher = state["batcher"]
+        if batcher is None or not hasattr(batcher, "shards"):
+            return Response({"enabled": False, "shards": 0})
+        body = {"enabled": True}
+        body.update(batcher.status())
+        return Response(body)
+
+    @app.post("/admin/shard/drain")
+    async def admin_shard_drain(req: Request) -> Response:
+        """Drain (or revive) one shard: ``{"shard": 0, "action": "drain"}``.
+        Draining stops new routing; in-flight rows finish — the safe-restart
+        primitive docs/runbooks/ShardOutage.md drills."""
+        _require_admin(req)
+        batcher = state["batcher"]
+        if batcher is None or not hasattr(batcher, "shards"):
+            raise HTTPError(409, "mesh front not enabled (MESH_SHARDS)")
+        try:
+            payload = req.json()
+            shard = int(payload["shard"])
+            action = payload.get("action", "drain")
+            if not 0 <= shard < len(batcher.shards):
+                raise ValueError(f"shard must be in [0, {len(batcher.shards)})")
+            if action not in ("drain", "revive"):
+                raise ValueError("action must be 'drain' or 'revive'")
+        except (KeyError, TypeError, ValueError) as e:
+            raise HTTPError(422, str(e))
+        if action == "drain":
+            state_now = batcher.shards[shard].state
+            if state_now not in ("healthy", "draining"):
+                # drain() would silently no-op on a dead/half-open shard;
+                # answering {"drained": true} there would misreport a
+                # state transition that never happened — revive instead
+                raise HTTPError(
+                    409,
+                    f"shard {shard} is {state_now!r} — nothing to drain "
+                    "(revive it instead)",
+                )
+            try:
+                batcher.drain(shard)
+            except ValueError as e:
+                # draining the last healthy shard would be a self-inflicted
+                # outage — refused at the front, surfaced as a conflict
+                raise HTTPError(409, str(e))
+            drained = await asyncio.to_thread(
+                batcher.wait_drained, shard, 10.0
+            )
+            return Response(
+                {"shard": shard, "action": "drain", "drained": drained}
+            )
+        batcher.revive(shard)
+        return Response({"shard": shard, "action": "revive"})
 
     @app.post("/monitor/feedback")
     async def monitor_feedback(req: Request) -> Response:

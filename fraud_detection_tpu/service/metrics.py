@@ -274,6 +274,49 @@ device_profile_active = Gauge(
     registry=registry,
 )
 
+# Switchyard: sharded serving mesh (mesh/). The mesh_shard_* names are the
+# alerting contract for monitoring/prometheus/rules/mesh-alerts.yml
+# (ShardDown, ShardLoadSkew) and the switchyard dashboard row.
+# NOTE: with MESH_SHARDS>1 the process-wide scorer gauges above
+# (scorer_queue_depth, scorer_effective_wait_seconds,
+# scorer_device_calls_per_flush) are written by every shard's flush loop —
+# they read as the last shard's per-flush sample, not an aggregate; use
+# the per-shard series below for shard-level conditions.
+mesh_shards = Gauge(
+    "mesh_shards",
+    "Replica shards configured in the switchyard serving front",
+    registry=registry,
+)
+mesh_shards_healthy = Gauge(
+    "mesh_shards_healthy",
+    "Shards currently accepting traffic (healthy, not draining/dead)",
+    registry=registry,
+)
+mesh_shard_healthy = Gauge(
+    "mesh_shard_healthy",
+    "1 while this shard accepts traffic (ShardDown alert input)",
+    ["shard"],
+    registry=registry,
+)
+mesh_shard_inflight = Gauge(
+    "mesh_shard_inflight",
+    "Rows currently in flight on this shard's micro-batcher",
+    ["shard"],
+    registry=registry,
+)
+mesh_shard_rows = Counter(
+    "mesh_shard_rows",
+    "Rows scored by this shard (ShardLoadSkew reads the per-shard rates)",
+    ["shard"],
+    registry=registry,
+)
+mesh_shard_errors = Counter(
+    "mesh_shard_errors",
+    "Scoring failures on this shard (consecutive failures mark it dead)",
+    ["shard"],
+    registry=registry,
+)
+
 # Conductor: closed-loop retrain → gate → promotion (lifecycle/). The
 # lifecycle_* names are the alerting contract for
 # monitoring/prometheus/rules/lifecycle-alerts.yml.

@@ -133,7 +133,11 @@ class MicroBatcher:
         )
         self._flushes: set[asyncio.Task] = set()
 
-    async def start(self) -> None:
+    async def start(self, warm: bool = True) -> None:
+        """``warm=False`` skips the bucket-ladder warmup: the switchyard
+        front passes it for shards 1..N-1, whose batchers share the first
+        shard's scorer and drift monitor — re-warming the same executables
+        N times would multiply startup latency for pure cache hits."""
         if self._starting or not (
             self._collector is None or self._collector.done()
         ):
@@ -171,7 +175,8 @@ class MicroBatcher:
                             drift.warm_fused(scorer, b)
                             b *= 2
 
-            await asyncio.get_running_loop().run_in_executor(None, _warm)
+            if warm:
+                await asyncio.get_running_loop().run_in_executor(None, _warm)
             self._collector = asyncio.create_task(self._run())
         finally:
             self._starting = False

@@ -66,6 +66,12 @@ WRAP_TARGETS: dict[str, list[tuple[str, str]]] = {
     ],
     "drift_window": [("fraud_detection_tpu.monitor.drift", "_window_update")],
     "fastlane.flush": [("fraud_detection_tpu.monitor.drift", "_fused_flush")],
+    "mesh.sharded_flush": [
+        ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush")
+    ],
+    "mesh.sharded_update": [
+        ("fraud_detection_tpu.mesh.retrain", "_sharded_update_epoch")
+    ],
     "gate": [("fraud_detection_tpu.lifecycle.gate", "_gate_stats")],
     "linear_shap": [
         ("fraud_detection_tpu.ops.linear_shap", "linear_shap"),

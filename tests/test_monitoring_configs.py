@@ -170,6 +170,51 @@ def test_fastlane_alert_and_panels_present():
         assert "scorer_device_calls_per_flush" in dash, rel
 
 
+def test_mesh_rules_file_ships():
+    """The switchyard contract (ISSUE 7): mesh-alerts.yml ships
+    promlint-clean with the two promised alerts."""
+    path = os.path.join(RULES_DIR, "mesh-alerts.yml")
+    assert os.path.exists(path)
+    assert promlint.lint_rules_file(path) == []
+    with open(path) as f:
+        text = f.read()
+    assert "ShardDown" in text
+    assert "ShardLoadSkew" in text
+
+
+def test_mesh_alert_metrics_exist_in_registry():
+    """Every mesh_* metric the switchyard rules reference must be exported
+    by service/metrics.py — same drift-proofing contract as the other
+    rule files."""
+    exported = _exported_metric_names()
+    with open(os.path.join(RULES_DIR, "mesh-alerts.yml")) as f:
+        text = f.read()
+    referenced = set(re.findall(r"\b(mesh_[a-z_]+)\b", text))
+    referenced -= {"mesh_alerts", "mesh_switchyard"}  # file/group names
+    assert referenced, "mesh rules reference no mesh metrics?"
+    missing = {
+        name for name in referenced
+        if name not in exported
+        and name.removesuffix("_total") not in exported
+        and f"{name}_total" not in exported
+    }
+    assert not missing, f"alert rules reference unexported metrics: {missing}"
+
+
+def test_grafana_switchyard_row_present():
+    """Both dashboards carry the switchyard panels (shard health, per-shard
+    rates, in-flight)."""
+    for rel in (
+        "grafana_dashboard.json",
+        os.path.join("grafana_provisioning", "dashboards", "fraud-tpu.json"),
+    ):
+        with open(os.path.join(MONITORING, rel)) as f:
+            text = f.read()
+        assert "mesh_shards_healthy" in text, rel
+        assert "mesh_shard_rows_total" in text, rel
+        assert "mesh_shard_inflight" in text, rel
+
+
 def test_grafana_waterfall_row_present():
     """The latency-waterfall row must ship in the dashboard with the stage
     histogram + compile counter exprs (promlint checks expr balance)."""

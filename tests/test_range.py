@@ -423,6 +423,25 @@ def test_scenario_hot_swap():
 
 
 @pytest.mark.slow
+def test_scenario_shard_kill_mid_swap():
+    """Switchyard chaos (ISSUE 7): a shard dies in the same window a
+    promotion hot-swap lands — load sheds, exactly one swap applies across
+    the shards, the shared ladder stays warm, p99 holds."""
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario("shard_kill_mid_swap").raise_if_failed()
+
+
+@pytest.mark.slow
+def test_scenario_replica_burst():
+    """Switchyard chaos (ISSUE 7): burst across replica shards while one
+    drains — p99 holds, the drain empties cleanly, survivors share load."""
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario("replica_burst").raise_if_failed()
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "kill_point",
     [

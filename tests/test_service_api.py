@@ -284,3 +284,104 @@ def test_predict_survives_broker_failure(served, monkeypatch):
     body = r.json()
     assert body["explanation_status"] == "Queue failed"
     assert 0.0 <= body["score"] <= 1.0
+
+
+# -- switchyard (mesh/) -------------------------------------------------------
+
+
+def _mesh_app(tmp_path, rng, monkeypatch, shards: int = 2) -> TestClient:
+    """A served app with the shard front enabled (MESH_SHARDS=N): the
+    model-on-disk + env wiring of the ``served`` fixture, mesh flavored."""
+    d = 30
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32),
+        intercept=np.float32(-1.0),
+    )
+    x = rng.standard_normal((200, d)).astype(np.float32)
+    scaler = scaler_fit(x)
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    model_dir = str(tmp_path / "models")
+    FraudLogisticModel(params, scaler, names).save(model_dir, joblib_too=False)
+    monkeypatch.setenv(
+        "MODEL_PATH", os.path.join(model_dir, "logistic_model.joblib")
+    )
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    monkeypatch.setenv("MESH_SHARDS", str(shards))
+    app = create_app(
+        database_url=f"sqlite:///{tmp_path}/fraud.db",
+        broker_url=f"sqlite:///{tmp_path}/taskq.db",
+    )
+    return TestClient(app)
+
+
+def test_mesh_status_disabled_on_single_batcher(served):
+    client, *_ = served
+    r = client.get("/mesh/status")
+    assert r.status_code == 200
+    assert r.json() == {"enabled": False, "shards": 0}
+    # the drain surface answers 409, not 500, when the front is off
+    r = client.post("/admin/shard/drain", json={"shard": 0})
+    assert r.status_code == 409
+
+
+def test_mesh_front_serves_and_drains(tmp_path, rng, monkeypatch):
+    """MESH_SHARDS=2 stands up the shard front behind /predict: scoring
+    works, /mesh/status reports both shards, and the drain/revive admin
+    surface round-trips."""
+    client = _mesh_app(tmp_path, rng, monkeypatch)
+    try:
+        for _ in range(4):
+            r = client.post("/predict", json={"features": [0.1] * 30})
+            assert r.status_code == 200
+            assert 0.0 <= r.json()["score"] <= 1.0
+        r = client.get("/mesh/status")
+        assert r.status_code == 200
+        body = r.json()
+        assert body["enabled"] is True and body["shards"] == 2
+        assert body["healthy"] == 2
+        assert sum(s["rows_total"] for s in body["per_shard"]) >= 4
+        # drain shard 0, confirm routing continues, then revive
+        r = client.post(
+            "/admin/shard/drain", json={"shard": 0, "action": "drain"}
+        )
+        assert r.status_code == 200 and r.json()["drained"] is True
+        r = client.post("/predict", json={"features": [0.2] * 30})
+        assert r.status_code == 200
+        assert client.get("/mesh/status").json()["healthy"] == 1
+        r = client.post(
+            "/admin/shard/drain", json={"shard": 0, "action": "revive"}
+        )
+        assert r.status_code == 200
+        assert client.get("/mesh/status").json()["healthy"] == 2
+        # validation: bad shard index and bad action are 422, not 500
+        assert client.post(
+            "/admin/shard/drain", json={"shard": 9}
+        ).status_code == 422
+        assert client.post(
+            "/admin/shard/drain", json={"shard": 0, "action": "explode"}
+        ).status_code == 422
+    finally:
+        client.close()
+
+
+def test_predict_503_when_all_shards_dead(tmp_path, rng, monkeypatch):
+    """Total switchyard outage is a known, retryable condition: /predict
+    answers 503 + Retry-After (the store-outage degradation contract),
+    not a generic 500."""
+    client = _mesh_app(tmp_path, rng, monkeypatch)
+    try:
+        import time as _t
+
+        from fraud_detection_tpu.mesh.front import DEAD
+
+        client.get("/status")  # trigger startup
+        front = client.app.state["batcher"]
+        for h in front.shards:
+            h.set_state(DEAD)
+            h.dead_since = _t.monotonic()  # freshly dead: probe not due
+        r = client.post("/predict", json={"features": [0.1] * 30})
+        assert r.status_code == 503, r.body
+        assert "retry-after" in {k.lower() for k in r.headers}
+        assert "shards" in r.json()["error"]
+    finally:
+        client.close()
