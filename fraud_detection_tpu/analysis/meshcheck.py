@@ -387,6 +387,47 @@ def _build_fastlane_flush(mesh: Mesh):
     return fn, (window, x, valid, decay, feature_edges, score_edges, score_args)
 
 
+@register_entrypoint("quickwire.flush")
+def _build_quickwire_flush(mesh: Mesh):
+    """The fused dequant·score·drift program (quickwire): int8 wire codes
+    in, per-feature dequant scale traced through to the drift histograms,
+    uint8 score codes out (the compressed d2h return wire) — the quantized
+    serving hot path, proven at every mesh size like ``fastlane.flush``."""
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import (
+        N_CALIB_BINS,
+        DriftWindow,
+        _fused_flush_quant,
+    )
+    from fraud_detection_tpu.ops.scorer import _raw_score_linear
+
+    window = DriftWindow(
+        feature_counts=sds((_FEATURES, N_FEATURE_BINS), jnp.float32, mesh, P()),
+        score_counts=sds((N_SCORE_BINS,), jnp.float32, mesh, P()),
+        calib_count=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_conf=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_label=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        n_rows=sds((), jnp.float32, mesh, P()),
+    )
+    x = sds((_ROWS, _FEATURES), jnp.int8, mesh, P(DATA_AXIS))
+    valid = sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((_FEATURES, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = (
+        sds((_FEATURES,), jnp.float32, mesh, P()),
+        sds((), jnp.float32, mesh, P()),
+    )
+    dq = sds((_FEATURES,), jnp.float32, mesh, P())
+    fn = lambda w, xx, vv, dd, fe, se, sa, qs: _fused_flush_quant(  # noqa: E731
+        w, xx, vv, dd, fe, se, sa, qs,
+        score_fn=_raw_score_linear, score_codes=True, out_dtype=jnp.uint8,
+    )
+    return fn, (
+        window, x, valid, decay, feature_edges, score_edges, score_args, dq,
+    )
+
+
 @register_entrypoint("mesh.sharded_flush")
 def _build_mesh_sharded_flush(mesh: Mesh):
     """The switchyard serving flush: the fused score+drift program as ONE
@@ -423,6 +464,50 @@ def _build_mesh_sharded_flush(mesh: Mesh):
         w, xx, vv, dd, fe, se, sa, score_fn=_raw_score_linear, mesh=mesh
     )
     return fn, (window, x, valid, decay, feature_edges, score_edges, score_args)
+
+
+@register_entrypoint("mesh.quickwire_flush")
+def _build_mesh_quickwire_flush(mesh: Mesh):
+    """The quickwire mesh flush: the fused dequant·score·drift program as
+    ONE shard_map dispatch — int8 codes row-sharded, dequant scale + params
+    replicated, per-shard windows donated through, uint8 return wire. The
+    ``MESH_FLUSH_DEVICES>1`` quantized serving topology at every virtual
+    mesh size."""
+    from fraud_detection_tpu.mesh.shardflush import _sharded_flush_quant
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import N_CALIB_BINS, DriftWindow
+    from fraud_detection_tpu.ops.scorer import _raw_score_linear
+
+    n_shards = mesh.shape[DATA_AXIS]
+    shard = P(DATA_AXIS)
+    window = DriftWindow(
+        feature_counts=sds(
+            (n_shards, _FEATURES, N_FEATURE_BINS), jnp.float32, mesh, shard
+        ),
+        score_counts=sds((n_shards, N_SCORE_BINS), jnp.float32, mesh, shard),
+        calib_count=sds((n_shards, N_CALIB_BINS), jnp.float32, mesh, shard),
+        calib_conf=sds((n_shards, N_CALIB_BINS), jnp.float32, mesh, shard),
+        calib_label=sds((n_shards, N_CALIB_BINS), jnp.float32, mesh, shard),
+        n_rows=sds((n_shards,), jnp.float32, mesh, shard),
+    )
+    x = sds((_ROWS, _FEATURES), jnp.int8, mesh, shard)
+    valid = sds((_ROWS,), jnp.float32, mesh, shard)
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((_FEATURES, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = (
+        sds((_FEATURES,), jnp.float32, mesh, P()),
+        sds((), jnp.float32, mesh, P()),
+    )
+    dq = sds((_FEATURES,), jnp.float32, mesh, P())
+    fn = lambda w, xx, vv, dd, fe, se, sa, qs: _sharded_flush_quant(  # noqa: E731
+        w, xx, vv, dd, fe, se, sa, qs,
+        score_fn=_raw_score_linear, mesh=mesh, score_codes=True,
+        out_dtype=jnp.uint8,
+    )
+    return fn, (
+        window, x, valid, decay, feature_edges, score_edges, score_args, dq,
+    )
 
 
 @register_entrypoint("mesh.sharded_update")

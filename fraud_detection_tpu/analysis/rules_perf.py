@@ -45,7 +45,13 @@ _ALLOC_FNS = {
 #: combinators that allocate UNLESS redirected into a preallocated buffer
 #: with ``out=`` — ``np.stack(rows)`` per flush is the exact churn fastlane
 #: removed, ``np.stack(rows, out=slot.f32[:n])`` is its replacement.
-_ALLOC_UNLESS_OUT_FNS = {"stack", "concatenate", "vstack", "hstack"}
+#: ``multiply``/``divide`` joined with quickwire: the return-wire decode
+#: (uint8 score codes → f32 probabilities) must write into the staging
+#: slot's preallocated ``scores`` buffer, not mint a fresh result vector
+#: per flush.
+_ALLOC_UNLESS_OUT_FNS = {
+    "stack", "concatenate", "vstack", "hstack", "multiply", "divide",
+}
 _ALLOC_MODULES = {"np", "numpy", "jnp", "onp"}
 
 

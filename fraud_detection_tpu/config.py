@@ -218,6 +218,35 @@ def scorer_fused_flush() -> bool:
     return env_flag("SCORER_FUSED_FLUSH") is not False
 
 
+def scorer_wire() -> str:
+    """``SCORER_WIRE`` — h2d wire format serving scorers are built with
+    (``float32`` | ``bfloat16`` | ``int8``). ``int8`` is the quickwire hot
+    path: quantization codes on the upload (30 B/row vs 120), the fused
+    dequant·score·drift program on the flush, calibration from the stamped
+    ``quant_calibration.npz`` beside the model artifact (scaler-derived
+    fallback). Default ``float32``."""
+    return _get("SCORER_WIRE", "float32").lower()
+
+
+def scorer_return_wire() -> str:
+    """``SCORER_RETURN_WIRE`` — d2h score wire for the fused serving flush
+    (``float32`` | ``float16`` | ``uint8``). The d2h link measures ~70×
+    slower than h2d (BENCH_r03: ~24.6 MB/s), so narrowing returns matters
+    as much as narrowing uploads: f16 halves, uint8 quarters the bytes/row
+    (scores quantized to 1/255 — ample for alert thresholds). Scores decode
+    to f32 host-side into the staging slot's preallocated return buffer.
+    Honored on the fused flush path; the split A/B path keeps f32 returns.
+    Default ``float32``."""
+    return _get("SCORER_RETURN_WIRE", "float32").lower()
+
+
+def quant_sigma_range() -> float:
+    """``QUANT_SIGMA_RANGE`` — symmetric range (in training sigmas) the
+    int8 wire's per-feature lattice spans when calibration is derived from
+    the scaler profile (stamped calibrations carry their own range)."""
+    return _get_float("QUANT_SIGMA_RANGE", 8.0)
+
+
 def scorer_adaptive_wait() -> bool:
     """``SCORER_ADAPTIVE_WAIT=1``: scale the micro-batcher's collection
     deadline with an arrival-rate EWMA — light traffic flushes almost

@@ -41,6 +41,7 @@ from fraud_detection_tpu.lifecycle.gate import (
 from fraud_detection_tpu.models.logistic import FraudLogisticModel
 from fraud_detection_tpu.monitor.baseline import build_baseline_profile, save_profile
 from fraud_detection_tpu.ops.logistic import LogisticParams, logistic_fit_lbfgs
+from fraud_detection_tpu.ops.quant import derive_calibration, save_calibration
 from fraud_detection_tpu.ops.scaler import scaler_fit, scaler_transform
 from fraud_detection_tpu.ops.scorer import fold_scaler_into_linear
 from fraud_detection_tpu.ops.smote import smote
@@ -227,6 +228,11 @@ def run_retrain(
         # path carries its own monitor profile, train.py contract)
         artifact_dir = run.artifact_path("model")
         save_artifacts(artifact_dir, params, scaler, list(feature_names))
+        if scaler is not None:
+            # quickwire: stamp the int8 wire calibration beside the
+            # challenger's weights — a promotion hot-swaps BOTH, so the
+            # serving quantizer always matches the scored model
+            save_calibration(artifact_dir, derive_calibration(scaler))
         hold_scores = np.asarray(
             challenger.scorer.predict_proba(np.asarray(x_hold, np.float32))
         )

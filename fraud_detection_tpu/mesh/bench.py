@@ -38,21 +38,21 @@ def _build(seed: int = 7, n_rows: int = 4096):
     d = 30
     rng = np.random.default_rng(seed)
     data = rng.standard_normal((n_rows, d)).astype(np.float32)
-    scorer = BatchScorer(
-        LogisticParams(
-            coef=rng.standard_normal(d).astype(np.float32),
-            intercept=np.float32(-1.0),
-        ),
-        ScalerParams(
-            mean=np.zeros(d, np.float32), scale=np.ones(d, np.float32),
-            var=np.ones(d, np.float32), n_samples=np.float32(1),
-        ),
+    params = LogisticParams(
+        coef=rng.standard_normal(d).astype(np.float32),
+        intercept=np.float32(-1.0),
     )
+    scaler = ScalerParams(
+        mean=np.zeros(d, np.float32), scale=np.ones(d, np.float32),
+        var=np.ones(d, np.float32), n_samples=np.float32(1),
+    )
+    scorer = BatchScorer(params, scaler)
+    quant_scorer = BatchScorer(params, scaler, io_dtype="int8")
     profile = build_baseline_profile(
         data, scorer.predict_proba(data),
         feature_names=[f"f{i}" for i in range(d)],
     )
-    return data, scorer, profile
+    return data, scorer, quant_scorer, profile
 
 
 def _flush_once(scorer, monitor, rows) -> np.ndarray:
@@ -61,12 +61,14 @@ def _flush_once(scorer, monitor, rows) -> np.ndarray:
     from fraud_detection_tpu.ops.scorer import _bucket
 
     n = len(rows)
-    score_fn, score_args = scorer.fused_spec()
+    spec = scorer.fused_spec()
     slot = scorer.staging.acquire(_bucket(n, scorer.min_bucket))
     try:
         hx = scorer.stage_rows(slot, list(rows))
         out = monitor.fused_flush(
-            jnp.asarray(hx), jnp.asarray(slot.valid), n, score_args, score_fn
+            jnp.asarray(hx), jnp.asarray(slot.valid), n,
+            spec.score_args, spec.score_fn,
+            dequant_scale=spec.dequant_scale, score_codes=spec.score_codes,
         )
         return np.asarray(out, np.float32)[:n]
     finally:
@@ -82,19 +84,28 @@ def run(bucket: int = 65536, reps: int = 8, sizes=(1, 2, 4, 8)) -> dict:
 
     avail = jax.device_count()
     sizes = tuple(s for s in sizes if s <= avail)
-    data, scorer, profile = _build(n_rows=bucket)
+    data, scorer, quant_scorer, profile = _build(n_rows=bucket)
     rows = [data[i] for i in range(bucket)]
 
-    # single-device fastlane reference: the parity target
+    # single-device fastlane reference: the parity target (f32 and the
+    # quickwire int8 wire — the quantized mesh flush must bitwise-match
+    # the single-device quantized flush, ISSUE 8 acceptance bar)
     ref = _flush_once(scorer, DriftMonitor(profile), rows)
+    quant_ref = _flush_once(quant_scorer, DriftMonitor(profile), rows)
 
     rates: dict[str, float] = {}
     parity = True
+    quant_parity = True
     for n_sh in sizes:
         monitor = MeshDriftMonitor(profile, serving_mesh(n_sh))
         scores = _flush_once(scorer, monitor, rows)  # warm/compile + parity
         parity = parity and bool(
             np.array_equal(scores.view(np.uint32), ref.view(np.uint32))
+        )
+        q_monitor = MeshDriftMonitor(profile, serving_mesh(n_sh))
+        q_scores = _flush_once(quant_scorer, q_monitor, rows)
+        quant_parity = quant_parity and bool(
+            np.array_equal(q_scores.view(np.uint32), quant_ref.view(np.uint32))
         )
         best = 0.0
         for _ in range(3):  # max-of-rounds damps shared-core noise
@@ -104,6 +115,17 @@ def run(bucket: int = 65536, reps: int = 8, sizes=(1, 2, 4, 8)) -> dict:
             np.asarray(monitor.shard_window.n_rows)  # drain the chain
             best = max(best, reps / (time.perf_counter() - t0))
         rates[str(n_sh)] = best
+
+    # quantized throughput at the top size only (the parity loop above is
+    # the gate; one rate shows the quantized mesh flush is in family)
+    top_sh = sizes[-1]
+    q_monitor = MeshDriftMonitor(profile, serving_mesh(top_sh))
+    _flush_once(quant_scorer, q_monitor, rows)  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _flush_once(quant_scorer, q_monitor, rows)
+    np.asarray(q_monitor.shard_window.n_rows)
+    quant_top_rate = reps / (time.perf_counter() - t0)
 
     order = [rates[str(s)] for s in sizes]
     monotone = all(
@@ -117,6 +139,8 @@ def run(bucket: int = 65536, reps: int = 8, sizes=(1, 2, 4, 8)) -> dict:
         "mesh_rows_per_sec_top": round(rates[top] * bucket),
         "mesh_speedup_top_vs_1": round(rates[top] / max(rates["1"], 1e-9), 3),
         "mesh_parity_ok": parity,
+        "mesh_quant_parity_ok": quant_parity,
+        "mesh_quant_flushes_per_sec_top": round(quant_top_rate, 2),
         "mesh_scaling_monotone": monotone,
         "mesh_sizes_measured": list(sizes),
     }

@@ -44,6 +44,7 @@ from fraud_detection_tpu.monitor.drift import (
     N_CALIB_BINS,
     DriftMonitor,
     DriftWindow,
+    _narrow_scores,
 )
 from fraud_detection_tpu.parallel.compat import shard_map
 from fraud_detection_tpu.parallel.mesh import DATA_AXIS
@@ -108,17 +109,24 @@ def _shard_body(
     feature_edges: jax.Array,
     score_edges: jax.Array,
     score_args,
+    dequant_scale=None,
     *,
     score_fn,
+    score_codes: bool = True,
+    out_dtype=jnp.float32,
 ):
     """Per-shard flush body under shard_map: identical math to
-    ``drift._fused_flush`` over this shard's rows and THIS shard's window
-    (the leading shard axis arrives as size 1 inside the block view). The
-    global ``decay`` applies to every shard, so the merged window evolves
-    exactly as the single-device window would for the same batch stream."""
+    ``drift._fused_flush`` (``drift._fused_flush_quant`` when a
+    ``dequant_scale`` rides along — the quickwire quantized wire) over this
+    shard's rows and THIS shard's window (the leading shard axis arrives as
+    size 1 inside the block view). The global ``decay`` applies to every
+    shard, so the merged window evolves exactly as the single-device window
+    would for the same batch stream."""
     w = jax.tree.map(lambda t: t[0], window)
     xf = x.astype(jnp.float32)
-    scores = score_fn(score_args, x).astype(jnp.float32)
+    if dequant_scale is not None:
+        xf = xf * dequant_scale
+    scores = score_fn(score_args, x if score_codes else xf).astype(jnp.float32)
     fc = feature_histogram(xf, feature_edges, weights=valid)
     sc = score_histogram(scores, score_edges, weights=valid)
     new = DriftWindow(
@@ -129,10 +137,15 @@ def _shard_body(
         calib_label=w.calib_label,
         n_rows=w.n_rows * decay + jnp.sum(valid),
     )
-    return scores, jax.tree.map(lambda t: t[None], new)
+    return _narrow_scores(scores, out_dtype), jax.tree.map(
+        lambda t: t[None], new
+    )
 
 
-@partial(jax.jit, static_argnames=("score_fn", "mesh"), donate_argnums=(0,))
+@partial(
+    jax.jit, static_argnames=("score_fn", "mesh", "out_dtype"),
+    donate_argnums=(0,),
+)
 def _sharded_flush(
     window: DriftWindow,  # per-shard windows, leading axis = shard
     x: jax.Array,  # (b, d) staged bucket, b % n_shards == 0
@@ -144,12 +157,13 @@ def _sharded_flush(
     *,
     score_fn,
     mesh,
+    out_dtype=jnp.float32,
 ):
     """The switchyard flush program: ONE dispatch executes the fused
     score+drift-fold on every shard of the serving mesh. Registered in
     meshcheck (``mesh.sharded_flush``) and the compile sentinel."""
     mapped = shard_map(
-        partial(_shard_body, score_fn=score_fn),
+        partial(_shard_body, score_fn=score_fn, out_dtype=out_dtype),
         mesh=mesh,
         in_specs=(
             P(DATA_AXIS),  # window: shard axis
@@ -165,6 +179,60 @@ def _sharded_flush(
     )
     return mapped(
         window, x, valid, decay, feature_edges, score_edges, score_args
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("score_fn", "mesh", "score_codes", "out_dtype"),
+    donate_argnums=(0,),
+)
+def _sharded_flush_quant(
+    window: DriftWindow,  # per-shard windows, leading axis = shard
+    x: jax.Array,  # (b, d) int8 quantization codes, b % n_shards == 0
+    valid: jax.Array,  # (b,)
+    decay: jax.Array,  # () global drift forgetting factor
+    feature_edges: jax.Array,
+    score_edges: jax.Array,
+    score_args,  # pytree, replicated
+    dequant_scale: jax.Array,  # (d,) replicated per-feature dequant scale
+    *,
+    score_fn,
+    mesh,
+    score_codes: bool,
+    out_dtype=jnp.float32,
+):
+    """The quickwire mesh flush: the fused dequant·score·drift program as
+    ONE shard_map dispatch over the data axis — ``MESH_FLUSH_DEVICES>1``
+    keeps the quantized wire. Same shard body as :func:`_sharded_flush`
+    (so N-shard quantized scores bitwise-match the single-device quantized
+    flush), with the codes dequantized per shard for the drift fold.
+    Registered in meshcheck (``mesh.quickwire_flush``) and the compile
+    sentinel."""
+    mapped = shard_map(
+        partial(
+            _shard_body,
+            score_fn=score_fn,
+            score_codes=score_codes,
+            out_dtype=out_dtype,
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),  # window: shard axis
+            P(DATA_AXIS),  # x: rows
+            P(DATA_AXIS),  # valid: rows
+            P(),           # decay
+            P(),           # feature_edges
+            P(),           # score_edges
+            P(),           # score_args (replicated pytree prefix)
+            P(),           # dequant_scale (replicated)
+        ),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
+    )
+    return mapped(
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        dequant_scale,
     )
 
 
@@ -219,26 +287,53 @@ class MeshDriftMonitor(DriftMonitor):
         )
 
     def fused_flush(
-        self, x: jax.Array, valid: jax.Array, n_live: int, score_args, score_fn
+        self,
+        x: jax.Array,
+        valid: jax.Array,
+        n_live: int,
+        score_args,
+        score_fn,
+        dequant_scale=None,
+        score_codes: bool = True,
+        out_dtype=jnp.float32,
     ) -> jax.Array:
         """Score one staged bucket across every shard AND fold each shard's
-        rows into its own window — one dispatch, no collectives. Same
-        locking contract as the base class: the critical section is the
-        async dispatch plus the donated-state store."""
+        rows into its own window — one dispatch, no collectives (the
+        quickwire ``_sharded_flush_quant`` program when ``dequant_scale``
+        rides along for a quantized wire). Same locking contract as the
+        base class: the critical section is the async dispatch plus the
+        donated-state store."""
         # graftcheck: hot-path
         decay = self._decay_for(n_live)
         with self._lock:
-            scores, self.shard_window = _sharded_flush(
-                self.shard_window,
-                x,
-                valid,
-                decay,
-                self._feature_edges,
-                self._score_edges,
-                score_args,
-                score_fn=score_fn,
-                mesh=self.mesh,
-            )
+            if dequant_scale is None:
+                scores, self.shard_window = _sharded_flush(
+                    self.shard_window,
+                    x,
+                    valid,
+                    decay,
+                    self._feature_edges,
+                    self._score_edges,
+                    score_args,
+                    score_fn=score_fn,
+                    mesh=self.mesh,
+                    out_dtype=out_dtype,
+                )
+            else:
+                scores, self.shard_window = _sharded_flush_quant(
+                    self.shard_window,
+                    x,
+                    valid,
+                    decay,
+                    self._feature_edges,
+                    self._score_edges,
+                    score_args,
+                    dequant_scale,
+                    score_fn=score_fn,
+                    mesh=self.mesh,
+                    score_codes=score_codes,
+                    out_dtype=out_dtype,
+                )
             self.rows_seen += n_live
         return scores
 

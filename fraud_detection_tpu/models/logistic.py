@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import numpy as np
 
+import logging
+
 from fraud_detection_tpu.ckpt.checkpoint import (
     export_joblib_artifacts,
     import_joblib_artifacts,
@@ -23,8 +25,16 @@ from fraud_detection_tpu.ops.linear_shap import (
     make_explainer,
 )
 from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.quant import (
+    QuantCalibration,
+    derive_calibration,
+    load_calibration,
+    save_calibration,
+)
 from fraud_detection_tpu.ops.scaler import ScalerParams
 from fraud_detection_tpu.ops.scorer import BatchScorer
+
+log = logging.getLogger("fraud_detection_tpu.models")
 
 
 class FraudLogisticModel(FraudModelBase):
@@ -33,11 +43,33 @@ class FraudLogisticModel(FraudModelBase):
         params: LogisticParams,
         scaler: ScalerParams | None,
         feature_names: list[str],
+        calibration: QuantCalibration | None = None,
+        io_dtype: str | None = None,
     ):
         self.params = params
         self.scaler = scaler
         self.feature_names = list(feature_names)
-        self._scorer = BatchScorer(params, scaler)
+        # quickwire: the serving wire format comes from SCORER_WIRE unless
+        # the caller pins one. int8 needs calibration — the artifact-stamped
+        # one when present (load() passes it through, so a hot-swapped
+        # challenger serves with ITS calibration), else derived from the
+        # scaler. Without either, fall back to f32 loudly rather than
+        # refuse to serve.
+        if io_dtype is None:
+            from fraud_detection_tpu import config
+
+            io_dtype = config.scorer_wire()
+        if io_dtype == "int8" and scaler is None and calibration is None:
+            log.warning(
+                "SCORER_WIRE=int8 but the model carries no scaler stats and "
+                "no stamped quant_calibration.npz — serving on the float32 "
+                "wire instead"
+            )
+            io_dtype = "float32"
+        self.calibration = calibration
+        self._scorer = BatchScorer(
+            params, scaler, io_dtype=io_dtype, calibration=calibration
+        )
         self._raw_explainer = None
 
     # -- explainability ----------------------------------------------------
@@ -75,6 +107,16 @@ class FraudLogisticModel(FraudModelBase):
     # -- persistence -------------------------------------------------------
     def save(self, directory: str, joblib_too: bool = True) -> str:
         save_artifacts(directory, self.params, self.scaler, self.feature_names)
+        # stamp the int8 wire calibration beside the weights regardless of
+        # the CURRENT serving wire: a later SCORER_WIRE=int8 deploy (or a
+        # hot swap into one) must quantize against the training profile
+        # this model was fitted on, not whatever scaler a future process
+        # happens to re-derive
+        cal = self.calibration
+        if cal is None and self.scaler is not None:
+            cal = derive_calibration(self.scaler)
+        if cal is not None:
+            save_calibration(directory, cal)
         if joblib_too:
             try:
                 export_joblib_artifacts(
@@ -87,7 +129,10 @@ class FraudLogisticModel(FraudModelBase):
     @classmethod
     def load(cls, directory: str) -> "FraudLogisticModel":
         params, scaler, feature_names = load_artifacts(directory)
-        return cls(params, scaler, feature_names)
+        return cls(
+            params, scaler, feature_names,
+            calibration=load_calibration(directory),
+        )
 
     @classmethod
     def load_joblib(

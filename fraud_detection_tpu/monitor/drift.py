@@ -85,7 +85,20 @@ def init_window(
     )
 
 
-@partial(jax.jit, static_argnames=("score_fn",), donate_argnums=(0,))
+def _narrow_scores(scores: jax.Array, out_dtype) -> jax.Array:
+    """Cast the fetched score output to the d2h return wire (quickwire
+    compressed d2h). Traced at the END of the fused programs, so the drift
+    fold always bins full-precision f32 scores — only the bytes crossing
+    the device→host link narrow. ``uint8`` ships ``round(p·255)`` codes
+    (decoded host-side by ops/scorer.decode_scores_into)."""
+    if out_dtype == jnp.uint8:
+        return jnp.round(scores * 255.0).astype(jnp.uint8)
+    if out_dtype == jnp.float32:
+        return scores
+    return scores.astype(out_dtype)
+
+
+@partial(jax.jit, static_argnames=("score_fn", "out_dtype"), donate_argnums=(0,))
 def _fused_flush(
     window: DriftWindow,
     x: jax.Array,  # (b, d) staged batch, possibly narrow-IO encoded
@@ -96,6 +109,7 @@ def _fused_flush(
     score_args,  # pytree: the scorer's device params
     *,
     score_fn,  # static: module-level raw score body (ops/scorer)
+    out_dtype=jnp.float32,  # static: d2h return wire (quickwire)
 ) -> tuple[jax.Array, DriftWindow]:
     """The fastlane flush program: scores **and** the drift-window update in
     ONE device dispatch per shape bucket.
@@ -106,23 +120,77 @@ def _fused_flush(
     module-level raw score body, static so jit caches one executable per
     (bucket, scorer-family)) traces inline with the histogram fold, the
     window state is donated through, and the scores come back as the only
-    fetched output. Serving flushes carry no feedback labels, so the
-    calibration state passes through untouched (exactly what
-    ``_window_update`` computes for an unlabeled batch: zero label weights,
-    calibration decay 1.0) — delayed-feedback replays keep using
-    ``_window_update`` off the hot path.
+    fetched output — optionally narrowed to the f16/uint8 return wire
+    (``out_dtype``), since the d2h link measures ~70× slower than h2d.
+    Serving flushes carry no feedback labels, so the calibration state
+    passes through untouched (exactly what ``_window_update`` computes for
+    an unlabeled batch: zero label weights, calibration decay 1.0) —
+    delayed-feedback replays keep using ``_window_update`` off the hot
+    path.
 
     For the bf16 wire the drift histograms bin the bf16-rounded values
     rather than the raw f32 rows — the same values the model actually
-    scored, which is the distribution drift must monitor. (int8 wire codes
-    are not raw-space, so the int8 scorer opts out of fusion entirely —
-    ``BatchScorer.fused_spec`` returns None there.)
+    scored, which is the distribution drift must monitor. The int8 wire
+    ships quantization codes that are NOT raw-space; it dispatches the
+    sibling :func:`_fused_flush_quant` program instead, which dequantizes
+    in-program.
     """
     xf = x.astype(jnp.float32)
     scores = score_fn(score_args, x).astype(jnp.float32)
     fc = feature_histogram(xf, feature_edges, weights=valid)
     sc = score_histogram(scores, score_edges, weights=valid)
-    return scores, DriftWindow(
+    return _narrow_scores(scores, out_dtype), DriftWindow(
+        feature_counts=window.feature_counts * decay + fc,
+        score_counts=window.score_counts * decay + sc,
+        calib_count=window.calib_count,
+        calib_conf=window.calib_conf,
+        calib_label=window.calib_label,
+        n_rows=window.n_rows * decay + jnp.sum(valid),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("score_fn", "score_codes", "out_dtype"),
+    donate_argnums=(0,),
+)
+def _fused_flush_quant(
+    window: DriftWindow,
+    x: jax.Array,  # (b, d) int8 quantization codes
+    valid: jax.Array,  # (b,) 1.0 for real rows, 0.0 for bucket padding
+    decay: jax.Array,  # () drift forgetting factor (live rows this batch)
+    feature_edges: jax.Array,
+    score_edges: jax.Array,
+    score_args,  # pytree: the scorer's device params
+    dequant_scale: jax.Array,  # (d,) per-feature dequant scale
+    *,
+    score_fn,  # static: module-level raw score body (ops/scorer)
+    score_codes: bool,  # static: score_fn consumes codes (True) or xf
+    out_dtype=jnp.float32,  # static: d2h return wire
+) -> tuple[jax.Array, DriftWindow]:
+    """The quickwire flush program: fused dequant·score·drift in ONE
+    device dispatch per shape bucket.
+
+    The int8 wire previously died at the flush boundary — codes aren't
+    raw-space, so the fused path demoted to the split two-dispatch flush.
+    Here the dequantization lives INSIDE the program: ``xf = codes ·
+    dequant_scale`` feeds the drift histograms (they bin the dequantized
+    values the model actually scored, against the same raw-space baseline
+    edges as the f32 path — PSI/KS stay comparable across wire formats
+    within the gated tolerance), while scoring either consumes the codes
+    directly (``score_codes=True`` — linear family, dequant scale folded
+    into the weights, bitwise-identical to the split int8 path) or the
+    shared ``xf`` (``score_codes=False`` — explicit dequant for kernels
+    that need raw-space inputs; the multiply is already paid for the
+    histogram bin). Window donated through, calibration state untouched,
+    return wire narrowable — exactly the fastlane contract, now quantized
+    end to end.
+    """
+    xf = x.astype(jnp.float32) * dequant_scale
+    scores = score_fn(score_args, x if score_codes else xf).astype(jnp.float32)
+    fc = feature_histogram(xf, feature_edges, weights=valid)
+    sc = score_histogram(scores, score_edges, weights=valid)
+    return _narrow_scores(scores, out_dtype), DriftWindow(
         feature_counts=window.feature_counts * decay + fc,
         score_counts=window.score_counts * decay + sc,
         calib_count=window.calib_count,
@@ -287,12 +355,23 @@ class DriftMonitor:
         return decay
 
     def fused_flush(
-        self, x: jax.Array, valid: jax.Array, n_live: int, score_args, score_fn
+        self,
+        x: jax.Array,
+        valid: jax.Array,
+        n_live: int,
+        score_args,
+        score_fn,
+        dequant_scale=None,
+        score_codes: bool = True,
+        out_dtype=jnp.float32,
     ) -> jax.Array:
         """Score one staged batch AND fold it into the drift window in ONE
-        device dispatch (the fastlane hot path — ``_fused_flush``). ``x`` and
-        ``valid`` are already device-resident and bucket-padded; returns the
-        device score vector (padded; caller slices to the live rows).
+        device dispatch (the fastlane hot path — ``_fused_flush``; the
+        quickwire ``_fused_flush_quant`` when ``dequant_scale`` rides along
+        for a quantized wire). ``x`` and ``valid`` are already
+        device-resident and bucket-padded; returns the device score vector
+        (padded, in the ``out_dtype`` return wire; caller slices to the
+        live rows and decodes).
 
         The lock covers only {read window → dispatch → store new window}:
         dispatch is asynchronous, so the critical section is microseconds
@@ -303,29 +382,46 @@ class DriftMonitor:
         # graftcheck: hot-path
         decay = self._decay_for(n_live)
         with self._lock:
-            scores, self.window = _fused_flush(
-                self.window,
-                x,
-                valid,
-                decay,
-                self._feature_edges,
-                self._score_edges,
-                score_args,
-                score_fn=score_fn,
-            )
+            if dequant_scale is None:
+                scores, self.window = _fused_flush(
+                    self.window,
+                    x,
+                    valid,
+                    decay,
+                    self._feature_edges,
+                    self._score_edges,
+                    score_args,
+                    score_fn=score_fn,
+                    out_dtype=out_dtype,
+                )
+            else:
+                scores, self.window = _fused_flush_quant(
+                    self.window,
+                    x,
+                    valid,
+                    decay,
+                    self._feature_edges,
+                    self._score_edges,
+                    score_args,
+                    dequant_scale,
+                    score_fn=score_fn,
+                    score_codes=score_codes,
+                    out_dtype=out_dtype,
+                )
             self.rows_seen += n_live
         return scores
 
-    def warm_fused(self, scorer, bucket: int) -> None:
+    def warm_fused(self, scorer, bucket: int, out_dtype=jnp.float32) -> None:
         """Pre-compile the fused flush executable for one bucket without
         touching the window: an all-padding batch (valid = 0) with decay 1.0
         (``n_live = 0``) folds exact zeros into every histogram, so the
         window state is bitwise unchanged while XLA compiles and caches the
-        executable. Stages through the scorer's real staging/encode path so
-        the warmed executable matches the serving wire dtype. Run under the
-        compile sentinel's expected-compiles mark by the micro-batcher's
-        startup warmup."""
-        score_fn, score_args = scorer.fused_spec()
+        executable. Stages through the scorer's real staging/encode path
+        and the scorer's fused spec (wire dtype, dequant scale, return
+        wire), so the warmed executable is exactly the one serving flushes
+        dispatch. Run under the compile sentinel's expected-compiles mark
+        by the micro-batcher's startup warmup."""
+        spec = scorer.fused_spec()
         slot = scorer.staging.acquire(bucket)
         try:
             slot.f32[:] = 0.0
@@ -333,7 +429,10 @@ class DriftMonitor:
             slot.valid[:] = 0.0
             self.fused_flush(
                 jnp.asarray(hx), jnp.asarray(slot.valid), 0,
-                score_args, score_fn,
+                spec.score_args, spec.score_fn,
+                dequant_scale=spec.dequant_scale,
+                score_codes=spec.score_codes,
+                out_dtype=out_dtype,
             ).block_until_ready()
         finally:
             scorer.staging.release(slot)

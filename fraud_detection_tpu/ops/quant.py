@@ -1,0 +1,105 @@
+"""Quantization calibration: the wire contract behind the int8 hot path.
+
+The int8 h2d wire ships symmetric per-feature quantization codes
+(``x_q = clip(rint(x / scale), ±127)``). Everything downstream — the
+host-side encoder, the dequant scale folded into the linear scoring
+weights, and the fused dequant·score·drift program's histogram binning —
+derives from ONE per-feature ``scale`` vector. This module makes that
+vector a first-class artifact:
+
+- :func:`derive_calibration` computes it from the training scaler profile
+  (``|mean| + sigma_range·sigma`` covers the distribution's body; clipping
+  only bites past-``sigma_range``-sigma outliers);
+- :func:`save_calibration` stamps ``quant_calibration.npz`` beside
+  ``model.npz``/``monitor_profile.npz`` at train/retrain time, so every
+  artifact resolution path (registry alias, native dir, promoted copy)
+  carries the calibration its model was parity-checked against;
+- :func:`load_calibration` rebinds it at serving load — including the
+  ``ModelReloader`` hot-swap path, where a promoted challenger must serve
+  with ITS stamped calibration, not the previous champion's.
+
+A drifted calibration silently degrades scores (codes saturate, or waste
+range), which is exactly why it ships beside the weights instead of being
+re-derived ad hoc per process.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+CALIBRATION_FILE = "quant_calibration.npz"
+
+#: symmetric range in training sigmas the int8 lattice spans per feature.
+#: 8 keeps clipping out at the extreme tail (fraud outliers score saturated,
+#: not wrong-signed) at a quantization step of ~absmax/127.
+DEFAULT_SIGMA_RANGE = 8.0
+
+
+@dataclass(frozen=True)
+class QuantCalibration:
+    """Per-feature int8 wire calibration.
+
+    ``scale`` is the DEQUANT scale: raw value ≈ code · scale. The encoder
+    multiplies by ``1/scale``; the linear scorer folds ``scale`` into its
+    already-scaler-folded weights so the device kernel sees codes with zero
+    extra compute; the fused drift fold multiplies codes back up to bin the
+    values the model actually scored.
+    """
+
+    scale: np.ndarray  # (d,) float32
+    sigma_range: float = DEFAULT_SIGMA_RANGE
+
+    @property
+    def n_features(self) -> int:
+        return int(self.scale.shape[0])
+
+
+def derive_calibration(
+    scaler, sigma_range: float | None = None
+) -> QuantCalibration:
+    """Calibration from a fitted scaler profile (mean ± sigma_range·sigma).
+
+    ``scaler`` is a :class:`~fraud_detection_tpu.ops.scaler.ScalerParams`
+    (or anything with ``.mean``/``.scale`` per-feature arrays).
+    """
+    if sigma_range is None:
+        from fraud_detection_tpu import config
+
+        sigma_range = config.quant_sigma_range()
+    mean = np.asarray(scaler.mean, np.float32)
+    sigma = np.asarray(scaler.scale, np.float32)
+    absmax = np.abs(mean) + float(sigma_range) * sigma
+    # a constant feature (sigma 0, mean 0) must not yield scale 0 — the
+    # encoder would divide by it; one code step of 1/127 keeps it harmless
+    scale = np.maximum(absmax, 1e-12) / 127.0
+    return QuantCalibration(
+        scale=scale.astype(np.float32), sigma_range=float(sigma_range)
+    )
+
+
+def save_calibration(directory: str, cal: QuantCalibration) -> str:
+    """Write ``quant_calibration.npz`` beside the model artifacts."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, CALIBRATION_FILE)
+    np.savez(
+        path,
+        scale=np.asarray(cal.scale, np.float32),
+        sigma_range=np.float64(cal.sigma_range),
+    )
+    return path
+
+
+def load_calibration(directory: str) -> QuantCalibration | None:
+    """Load the stamped calibration; None when absent (models trained before
+    quickwire serve int8 with the scaler-derived fallback)."""
+    path = os.path.join(directory, CALIBRATION_FILE)
+    if not os.path.exists(path):
+        return None
+    with np.load(path, allow_pickle=False) as z:
+        return QuantCalibration(
+            scale=np.asarray(z["scale"], np.float32),
+            sigma_range=float(z["sigma_range"]),
+        )

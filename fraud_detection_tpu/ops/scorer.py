@@ -19,12 +19,14 @@ from __future__ import annotations
 
 import threading
 from functools import partial
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.quant import QuantCalibration, derive_calibration
 from fraud_detection_tpu.ops.scaler import ScalerParams
 
 
@@ -85,6 +87,48 @@ def _bucket(n: int, min_bucket: int = 8) -> int:
 # static callables by id: a per-scorer lambda would recompile per instance.
 
 
+class FusedSpec(NamedTuple):
+    """What a scorer hands the fused flush program (quickwire contract).
+
+    ``score_fn(score_args, x)`` must be a module-level callable (jit hashes
+    statics by identity) over a pytree of device arrays. For a quantized
+    wire, ``dequant_scale`` is the per-feature f32 dequant vector the fused
+    program multiplies codes by for the drift histograms; ``score_codes``
+    says whether ``score_fn`` consumes the wire codes directly (linear
+    family: the dequant scale is folded into the weights — zero extra
+    device compute) or the already-dequantized f32 rows (explicit dequant:
+    pallas / tree families whose kernels need raw-space inputs).
+    """
+
+    score_fn: Callable
+    score_args: Any
+    dequant_scale: jax.Array | None = None
+    score_codes: bool = True
+    wire: str = "float32"
+
+
+#: d2h score wire formats: name → (numpy dtype, jax dtype, bytes/row).
+#: ``uint8`` codes are ``round(p · 255)``; both narrow formats decode to
+#: f32 probabilities host-side (:func:`decode_scores_into`).
+RETURN_WIRES = {
+    "float32": (np.float32, jnp.float32, 4),
+    "float16": (np.float16, jnp.float16, 2),
+    "uint8": (np.uint8, jnp.uint8, 1),
+}
+
+
+def decode_scores_into(raw: np.ndarray, out: np.ndarray) -> np.ndarray:
+    """Decode a fetched score vector (any return wire) into the
+    preallocated f32 buffer ``out`` — the allocation-free host half of the
+    compressed d2h path. Runs once per flush in the executor thread."""
+    # graftcheck: hot-path — decode must reuse the slot's scores buffer
+    if raw.dtype == np.uint8:
+        np.multiply(raw, np.float32(1.0 / 255.0), out=out)
+    else:
+        np.copyto(out, raw, casting="unsafe")
+    return out
+
+
 def _raw_score_linear(score_args, x: jax.Array) -> jax.Array:
     """``sigmoid(x @ coef + intercept)`` over a (possibly narrow-IO) batch;
     ``score_args = (coef, intercept)``. Traced inside the fused flush."""
@@ -115,10 +159,13 @@ def _raw_score_gbt(model, x: jax.Array) -> jax.Array:
 
 class _StagingSlot:
     """One bucket's worth of host staging: the f32 row buffer, the
-    wire-encoded view/buffer the device transfer ships, and the validity
-    mask (1.0 for real rows, 0.0 for bucket padding)."""
+    wire-encoded view/buffer the device transfer ships, the validity
+    mask (1.0 for real rows, 0.0 for bucket padding), and the return-wire
+    decode buffer (quickwire compressed d2h: narrow score codes decode
+    into ``scores`` in place, so steady-state flushes never allocate a
+    fresh result array)."""
 
-    __slots__ = ("bucket", "f32", "io", "scratch", "valid")
+    __slots__ = ("bucket", "f32", "io", "scratch", "valid", "scores")
 
     def __init__(self, bucket: int, n_features: int, io_dtype):
         self.bucket = bucket
@@ -137,6 +184,8 @@ class _StagingSlot:
             else None
         )
         self.valid = np.zeros((bucket,), np.float32)
+        # return-wire decode target: f16/uint8 score codes decode here
+        self.scores = np.zeros((bucket,), np.float32)
 
 
 class StagingPool:
@@ -199,11 +248,11 @@ class _BucketedScorer:
 
     # -- fastlane: fusion + zero-allocation staging -------------------------
 
-    def fused_spec(self):
-        """``(score_fn, score_args)`` for the fused flush program, or None
-        when this scorer can't be traced into it. ``score_fn`` must be a
-        module-level callable (jit hashes statics by identity) and
-        ``score_args`` a pytree of device arrays."""
+    def fused_spec(self) -> FusedSpec | None:
+        """A :class:`FusedSpec` for the fused flush program, or None when
+        this scorer can't be traced into it (the micro-batcher then demotes
+        to the split two-dispatch flush — logged and exported as
+        ``scorer_wire_fused 0`` so the demotion can never be silent)."""
         return None
 
     @property
@@ -332,38 +381,53 @@ class BatchScorer(_BucketedScorer):
         scaler: ScalerParams | None = None,
         min_bucket: int = 8,
         io_dtype: str = "float32",
-        int8_sigma_range: float = 8.0,
+        int8_sigma_range: float | None = None,
+        calibration: QuantCalibration | None = None,
     ):
         folded = fold_scaler_into_linear(params, scaler)
         self.coef = jnp.asarray(folded.coef, dtype=jnp.float32)
+        # the scaler-folded, pre-quant-fold weights: the explicit-dequant
+        # fused families (pallas) score dequantized f32 rows with these
+        self._raw_coef = self.coef
         self.intercept = jnp.asarray(folded.intercept, dtype=jnp.float32)
         self.n_features = int(self.coef.shape[0])
         self.min_bucket = min_bucket
+        self.io_dtype = io_dtype
         # Wire formats for the bandwidth-bound h2d path (compute is f32 on
         # device either way):
         # - bfloat16 halves the bytes; 8 mantissa bits move scores ~1e-3
         #   (test_scorer bf16 parity);
         # - int8 quarters bf16 again (30 B/row): symmetric per-feature
-        #   quantization over mean ± int8_sigma_range*sigma of the training
-        #   distribution. The dequant scale folds INTO the scoring weights
-        #   (x_q·(s∘w') ≡ (x_q∘s)·w'), so the device kernel is the identical
-        #   GEMV — zero extra compute, and clipping only bites >8-sigma
-        #   outliers. Score error ~1e-2 (test_scorer int8 parity): an
-        #   OPT-IN wire format for throughput-critical bulk scoring.
+        #   quantization codes over a stamped :class:`QuantCalibration`
+        #   (mean ± sigma_range·sigma of the training profile — derived
+        #   from the scaler when no artifact calibration is bound). The
+        #   dequant scale folds INTO the scoring weights
+        #   (x_q·(s∘w') ≡ (x_q∘s)·w'), so the device kernel is the
+        #   identical GEMV — zero extra compute, and clipping only bites
+        #   past-sigma_range outliers. Score error ~1e-2 (test_scorer int8
+        #   parity). With quickwire the int8 wire keeps the fused
+        #   single-dispatch flush: the fused program dequantizes the codes
+        #   in-program for the drift histograms (monitor/drift
+        #   ``_fused_flush_quant``).
         if io_dtype not in ("float32", "bfloat16", "int8"):
             raise ValueError(
                 f"io_dtype must be float32|bfloat16|int8, got {io_dtype}"
             )
         self._quant_scale: np.ndarray | None = None
+        self.calibration: QuantCalibration | None = None
         if io_dtype == "int8":
-            if scaler is None:
-                raise ValueError("int8 IO needs scaler stats for calibration")
-            absmax = np.abs(np.asarray(scaler.mean, np.float32)) + (
-                int8_sigma_range * np.asarray(scaler.scale, np.float32)
-            )
-            self._quant_scale = (absmax / 127.0).astype(np.float32)
+            if calibration is None:
+                if scaler is None:
+                    raise ValueError(
+                        "int8 IO needs a stamped QuantCalibration or scaler "
+                        "stats for calibration"
+                    )
+                calibration = derive_calibration(scaler, int8_sigma_range)
+            self.calibration = calibration
+            self._quant_scale = np.asarray(calibration.scale, np.float32)
             self._inv_quant_scale = (1.0 / self._quant_scale).astype(np.float32)
-            self.coef = self.coef * jnp.asarray(self._quant_scale)
+            self._dequant_scale = jnp.asarray(self._quant_scale)
+            self.coef = self.coef * self._dequant_scale
             self._io_np_dtype = np.int8
         elif io_dtype == "bfloat16":
             self._io_np_dtype = _np_bfloat16()
@@ -394,16 +458,36 @@ class BatchScorer(_BucketedScorer):
         np.copyto(slot.io, slot.scratch, casting="unsafe")
         return slot.io
 
-    def fused_spec(self):
+    def fused_spec(self) -> FusedSpec:
         if self._quant_scale is not None:
-            # int8 wire ships quantization CODES (the dequant scale is
-            # folded into coef): the fused program's drift histograms would
-            # bin codes against raw-space edges — opt out of fusion
-            return None
+            # quickwire: the int8 wire ships quantization CODES, and the
+            # fused dequant·score·drift program handles them in-program —
+            # the dequant scale rides along so the drift histograms bin the
+            # dequantized values the model actually scored. The plain
+            # linear family keeps the scale folded into coef and scores the
+            # codes directly (score_codes=True, zero extra device compute);
+            # the pallas kernel wants raw-space f32 rows, so it takes the
+            # explicit-dequant path (score_codes=False, raw weights) — the
+            # dequant multiply is shared with the histogram bin anyway.
+            if self._use_pallas:
+                return FusedSpec(
+                    _raw_score_linear_pallas,
+                    (self._raw_coef, self.intercept),
+                    dequant_scale=self._dequant_scale,
+                    score_codes=False,
+                    wire="int8",
+                )
+            return FusedSpec(
+                _raw_score_linear,
+                (self.coef, self.intercept),
+                dequant_scale=self._dequant_scale,
+                score_codes=True,
+                wire="int8",
+            )
         fn = (
             _raw_score_linear_pallas if self._use_pallas else _raw_score_linear
         )
-        return fn, (self.coef, self.intercept)
+        return FusedSpec(fn, (self.coef, self.intercept), wire=self.io_dtype)
 
     def _score_padded(self, x: jax.Array, out_dtype=jnp.float32) -> jax.Array:
         # bf16/int8-IO inputs ship narrow; the f32 upcast happens inside the
@@ -435,5 +519,5 @@ class GBTBatchScorer(_BucketedScorer):
         p = self._predict(self._model, x)
         return _cast_scores(p, out_dtype) if out_dtype != jnp.float32 else p
 
-    def fused_spec(self):
-        return _raw_score_gbt, self._model
+    def fused_spec(self) -> FusedSpec:
+        return FusedSpec(_raw_score_gbt, self._model)

@@ -118,6 +118,14 @@ scorer_flushes = Counter(
     ["path"],
     registry=registry,
 )
+scorer_wire_fused = Gauge(
+    "scorer_wire_fused",
+    "1 while the served wire format runs the fused single-dispatch flush; "
+    "0 when the wire format opted out of fusion and flushes silently "
+    "demoted to the split two-dispatch path (WireFormatUnfused alert "
+    "input — a config change must never quietly double device dispatches)",
+    registry=registry,
+)
 scorer_queue_depth = Gauge(
     "scorer_queue_depth",
     "Rows waiting in the micro-batcher queue at the last collection cycle",

@@ -59,7 +59,8 @@ import time
 import numpy as np
 
 from fraud_detection_tpu import config
-from fraud_detection_tpu.ops.scorer import BatchScorer, _bucket
+from fraud_detection_tpu.ops import scorer as scorer_mod
+from fraud_detection_tpu.ops.scorer import BatchScorer, _bucket, decode_scores_into
 from fraud_detection_tpu.range.faults import fire
 from fraud_detection_tpu.service import metrics, tracing
 from fraud_detection_tpu.telemetry.timeline import STAGES, FlushInfo
@@ -92,6 +93,7 @@ class MicroBatcher:
         telemetry: bool | None = None,
         fused: bool | None = None,
         adaptive_wait: bool | None = None,
+        return_wire: str | None = None,
     ):
         # Either a fixed scorer (offline tools, tests) or a lifecycle
         # ModelSlot (serving): with a slot, every flush re-reads the slot's
@@ -114,6 +116,29 @@ class MicroBatcher:
             telemetry if telemetry is not None else config.spyglass_enabled()
         )
         self.fused = fused if fused is not None else config.scorer_fused_flush()
+        # quickwire compressed d2h: scores come back over a narrow return
+        # wire (f16/uint8) and decode host-side into the staging slot's
+        # preallocated buffer. Honored on the fused path (whose warmup
+        # compiles the matching executables); split/solo keep f32 returns.
+        self.return_wire = (
+            return_wire
+            if return_wire is not None
+            else config.scorer_return_wire()
+        )
+        if self.return_wire not in scorer_mod.RETURN_WIRES:
+            raise ValueError(
+                f"return wire must be one of {sorted(scorer_mod.RETURN_WIRES)},"
+                f" got {self.return_wire!r}"
+            )
+        self._out_jdtype = scorer_mod.RETURN_WIRES[self.return_wire][1]
+        # last observed wire-fusion state (None = not yet resolved): the
+        # scorer_wire_fused gauge + the one startup demotion log ride this.
+        # The gauge starts at 1 (nothing demoted): a watchtower-less solo
+        # deployment never resolves a fused target, and its single-dispatch
+        # flushes must not read as a demotion (the prometheus default of 0
+        # would page WireFormatUnfused on every such process).
+        self._wire_fused: bool | None = None
+        metrics.scorer_wire_fused.set(1)
         self.adaptive_wait = (
             adaptive_wait
             if adaptive_wait is not None
@@ -172,7 +197,11 @@ class MicroBatcher:
                         drift = target[0]
                         b = scorer.min_bucket
                         while b <= top:
-                            drift.warm_fused(scorer, b)
+                            # warm with the serving return wire so the
+                            # ladder compiles the exact flush executables
+                            drift.warm_fused(
+                                scorer, b, out_dtype=self._out_jdtype
+                            )
                             b *= 2
 
             if warm:
@@ -302,8 +331,31 @@ class MicroBatcher:
         finally:
             self._inflight.release()
 
+    def _note_wire_fused(self, fused: bool, scorer) -> None:
+        """Export + (on transition) log whether the active wire format runs
+        the fused single-dispatch flush. A wire format opting out of fusion
+        silently doubles device dispatches — the one condition quickwire
+        exists to remove — so the demotion must be loud: logged once at
+        startup/transition and exported as ``scorer_wire_fused`` (the
+        WireFormatUnfused alert input). Steady state this is one bool
+        compare per flush."""
+        if fused == self._wire_fused:
+            return
+        self._wire_fused = fused
+        metrics.scorer_wire_fused.set(1 if fused else 0)
+        if not fused:
+            log.warning(
+                "wire format %r opts out of the fused flush: every flush "
+                "demotes to the SPLIT two-dispatch path (2 device calls + a "
+                "second h2d of the batch). scorer_wire_fused=0 exported — "
+                "see the WireFormatUnfused alert",
+                getattr(scorer, "io_dtype", type(scorer).__name__),
+            )
+        else:
+            log.info("wire format runs the fused single-dispatch flush")
+
     def _fused_target(self, scorer):
-        """(drift_monitor, score_fn, score_args) when this flush can run the
+        """(drift_monitor, fused_spec) when this flush can run the
         single-dispatch fused program, else None — re-resolved per flush
         because promotions rebind both the slot's scorer and the
         watchtower's drift monitor."""
@@ -314,8 +366,10 @@ class MicroBatcher:
             return None
         spec = getattr(scorer, "fused_spec", lambda: None)()
         if spec is None:
+            self._note_wire_fused(False, scorer)
             return None
-        return drift, spec[0], spec[1]
+        self._note_wire_fused(True, scorer)
+        return drift, spec
 
     def _flush_device(
         self, scorer, target, batch: list[tuple], telemetry: bool
@@ -327,15 +381,22 @@ class MicroBatcher:
         per-bucket staging slot (zero fresh batch arrays), then either:
 
         - fused (``target`` set): ONE dispatch computing scores AND the
-          drift-window fold (window donated through); or
+          drift-window fold (window donated through) — the quickwire
+          quantized program when the wire ships int8 codes. Scores return
+          over the configured d2h wire (f16/uint8 codes decode host-side
+          into the slot's preallocated ``scores`` buffer — the compressed
+          return rides the same executor-thread d2h overlap); or
         - split: the scoring dispatch alone (the watchtower ingest thread
-          pays the second, split-path dispatch afterwards).
+          pays the second, split-path dispatch afterwards); f32 returns.
 
         Returns (probs, t_flush_start, t_padded, t_synced, t_fetched,
-        device_calls, monitor_rows). ``monitor_rows`` is a copy of the raw
-        f32 rows when the watchtower still needs them (split drift update,
-        or shadow sampling), else None — the staging slot is recycled the
-        moment this returns, so views must never escape.
+        device_calls, monitor_rows, monitor_scores, holdover).
+        ``monitor_rows``/``monitor_scores`` are stable copies for the
+        watchtower when it still needs them (split drift update, or shadow
+        sampling), else None. ``holdover`` is the staging slot when
+        ``probs`` is a view into its decode buffer (narrow return wire) —
+        the caller must release it AFTER resolving the waiters; on the f32
+        return wire the slot is recycled here and ``holdover`` is None.
 
         Note: on tunneled PJRT platforms ``block_until_ready`` can report
         early (see bench.py `_window_barrier`); there the residue shows up
@@ -352,16 +413,21 @@ class MicroBatcher:
         n = len(batch)
         staging = scorer.staging
         slot = staging.acquire(_bucket(n, scorer.min_bucket))
+        holdover = None
+        handed_over = False
         try:
             with annotate("microbatch-score"):
                 t_flush_start = time.perf_counter()
                 hx = scorer.stage_rows(slot, [r for r, _, _ in batch])
                 t_padded = time.perf_counter()
                 if target is not None:
-                    drift, score_fn, score_args = target
+                    drift, spec = target
                     out = drift.fused_flush(
                         jnp.asarray(hx), jnp.asarray(slot.valid), n,
-                        score_args, score_fn,
+                        spec.score_args, spec.score_fn,
+                        dequant_scale=spec.dequant_scale,
+                        score_codes=spec.score_codes,
+                        out_dtype=self._out_jdtype,
                     )
                     device_calls = 1
                     need_rows = getattr(
@@ -376,21 +442,42 @@ class MicroBatcher:
                 if telemetry:
                     out.block_until_ready()
                 t_synced = time.perf_counter()
-                probs = np.asarray(out, dtype=np.float32)[:n]
+                raw = np.asarray(out)  # the d2h fetch (narrow on quickwire)
+                if target is not None and raw.dtype != np.float32:
+                    # decode the return wire in place: the slot's scores
+                    # buffer is the only f32 materialization, so the slot
+                    # must outlive the waiters (holdover)
+                    probs = decode_scores_into(raw, slot.scores)[:n]
+                    holdover = slot
+                else:
+                    probs = raw[:n]
                 t_fetched = time.perf_counter()
                 monitor_rows = slot.f32[:n].copy() if need_rows else None
+                if not need_rows:
+                    monitor_scores = None
+                elif holdover is None:
+                    monitor_scores = probs  # raw is already a fresh array
+                else:
+                    monitor_scores = probs.copy()
+            handed_over = holdover is not None
         finally:
             # after the score fetch the device has consumed the staged
-            # bytes, so the slot is safe to recycle
-            staging.release(slot)
+            # bytes, so the slot is safe to recycle — unless the decoded
+            # scores still live in it (narrow return wire, handed to the
+            # caller to release after the waiters resolve). A failure
+            # between decode and return releases it here either way.
+            if not handed_over:
+                staging.release(slot)
         return (
             probs, t_flush_start, t_padded, t_synced, t_fetched,
-            device_calls, monitor_rows,
+            device_calls, monitor_rows, monitor_scores, holdover,
         )
 
     async def _flush(self, batch: list[tuple]) -> None:
         telemetry = self.telemetry
         fused = False
+        holdover = None
+        scorer = None
         try:
             # Everything that can fail stays inside this try — a raise
             # before the waiters are resolved (e.g. np.stack on a
@@ -410,7 +497,7 @@ class MicroBatcher:
                 fused = target is not None
                 (
                     probs, t_flush, t_padded, t_synced, t_fetched,
-                    device_calls, monitor_rows,
+                    device_calls, monitor_rows, monitor_scores, holdover,
                 ) = await loop.run_in_executor(
                     None, self._flush_device, scorer, target, batch, telemetry
                 )
@@ -427,6 +514,7 @@ class MicroBatcher:
                 telemetry = False
                 device_calls = 2 if self.watchtower is not None else 1
                 monitor_rows = rows
+                monitor_scores = probs
             metrics.scorer_device_calls_per_flush.set(device_calls)
             metrics.scorer_flushes.labels(
                 "fused" if fused
@@ -464,6 +552,10 @@ class MicroBatcher:
             for (_, f, _), p in zip(batch, probs):
                 if not f.done():
                     f.set_result(float(p))
+        if holdover is not None:
+            # narrow return wire: the waiters read their floats out of the
+            # slot's decode buffer above — now it can recycle
+            scorer.staging.release(holdover)
         if fi is not None:
             fi.t_resolved = time.perf_counter()
             self._export_flush(fi, batch)
@@ -475,7 +567,9 @@ class MicroBatcher:
             # full drift update. Either way a slow monitor can never add
             # request latency.
             try:
-                self.watchtower.observe(monitor_rows, probs, drift_done=fused)
+                self.watchtower.observe(
+                    monitor_rows, monitor_scores, drift_done=fused
+                )
             except Exception:
                 log.debug("watchtower observe failed", exc_info=True)
 

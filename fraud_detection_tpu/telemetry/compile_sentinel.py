@@ -66,8 +66,14 @@ WRAP_TARGETS: dict[str, list[tuple[str, str]]] = {
     ],
     "drift_window": [("fraud_detection_tpu.monitor.drift", "_window_update")],
     "fastlane.flush": [("fraud_detection_tpu.monitor.drift", "_fused_flush")],
+    "quickwire.flush": [
+        ("fraud_detection_tpu.monitor.drift", "_fused_flush_quant")
+    ],
     "mesh.sharded_flush": [
         ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush")
+    ],
+    "mesh.quickwire_flush": [
+        ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush_quant")
     ],
     "mesh.sharded_update": [
         ("fraud_detection_tpu.mesh.retrain", "_sharded_update_epoch")

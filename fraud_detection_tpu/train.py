@@ -40,6 +40,7 @@ from fraud_detection_tpu.models.gbt import FraudGBTModel
 from fraud_detection_tpu.models.logistic import FraudLogisticModel
 from fraud_detection_tpu.monitor.baseline import build_baseline_profile, save_profile
 from fraud_detection_tpu.ops.gbt import GBTConfig, gbt_fit, gbt_predict_proba
+from fraud_detection_tpu.ops.quant import derive_calibration, save_calibration
 from fraud_detection_tpu.ops.logistic import (
     logistic_fit_lbfgs,
     logistic_fit_sgd,
@@ -253,6 +254,11 @@ def train(
             model = FraudLogisticModel(params, scaler, feature_names)
             model.save(out_dir)
             save_artifacts(model_artifact, params, scaler, feature_names)
+            if scaler is not None:
+                # quickwire int8 wire calibration: stamped beside the
+                # weights so the serving quantizer is pinned to THIS
+                # model's training profile (rebound on hot swap)
+                save_calibration(model_artifact, derive_calibration(scaler))
         # Beside model.npz in BOTH destinations: registry registration
         # copytrees the run artifact dir, so every resolution path (alias,
         # native dir, promoted copy) carries its own drift baseline.

@@ -1,0 +1,19 @@
+"""Known-bad fixture for the hot-path-alloc rule's quickwire extension:
+the d2h return-wire decode allocating fresh result arrays per flush
+instead of writing into the staging slot's preallocated scores buffer."""
+
+import numpy as np
+
+_SCORES = np.zeros((1024,), np.float32)
+
+
+def decode_flush(raw_codes):
+    # graftcheck: hot-path — per-flush d2h decode
+    probs = np.multiply(raw_codes, 1.0 / 255.0)  # finding: no out=
+    half = np.divide(raw_codes, 255.0)  # finding: no out=
+    return probs, half
+
+
+def decode_cold(raw_codes):
+    # no marker: offline decode may allocate freely
+    return np.multiply(raw_codes, 1.0 / 255.0)

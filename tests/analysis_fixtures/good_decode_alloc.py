@@ -1,0 +1,19 @@
+"""Known-good fixture for the hot-path-alloc rule's quickwire extension:
+the return-wire decode reuses the preallocated scores buffer (the
+ops/scorer.decode_scores_into discipline)."""
+
+import numpy as np
+
+_SCORES = np.zeros((1024,), np.float32)
+
+
+def decode_flush(raw_codes):
+    # graftcheck: hot-path — decodes into the slot's preallocated buffer
+    np.multiply(raw_codes, np.float32(1.0 / 255.0), out=_SCORES)
+    return _SCORES
+
+
+def decode_f16(raw_codes):
+    # graftcheck: hot-path — widening copy, no fresh array
+    np.copyto(_SCORES, raw_codes, casting="unsafe")
+    return _SCORES

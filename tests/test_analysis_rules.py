@@ -68,6 +68,18 @@ def test_hot_path_alloc_true_positives():
     assert not any("cold_path" in m for m in msgs), msgs
 
 
+def test_decode_alloc_true_positives():
+    """Quickwire extension of hot-path-alloc: the d2h return-wire decode
+    must reuse the staging slot's scores buffer — np.multiply/np.divide
+    without out= inside a marked region is per-flush churn."""
+    counts, findings = rule_counts("bad_decode_alloc.py")
+    assert counts["hot-path-alloc"] == 2, findings
+    msgs = [f.message for f in findings if f.rule_id == "hot-path-alloc"]
+    assert any("np.multiply" in m and "without out=" in m for m in msgs), msgs
+    assert any("np.divide" in m for m in msgs), msgs
+    assert not any("decode_cold" in m for m in msgs), msgs
+
+
 def test_service_rules_true_positives():
     counts, findings = rule_counts("bad_service.py")
     assert counts["socket-no-timeout"] == 3, findings
@@ -98,6 +110,7 @@ def test_retry_no_backoff_true_positives():
         "good_service.py",
         "good_prometheus.py",
         "good_hot_path_alloc.py",
+        "good_decode_alloc.py",
         "good_retry_backoff.py",
     ],
 )

@@ -60,12 +60,14 @@ def profile(data):
 
 def _fused_once(scorer, monitor, batch_rows):
     n = len(batch_rows)
-    score_fn, score_args = scorer.fused_spec()
+    spec = scorer.fused_spec()
     slot = scorer.staging.acquire(_bucket(n, scorer.min_bucket))
     try:
         hx = scorer.stage_rows(slot, list(batch_rows))
         out = monitor.fused_flush(
-            jnp.asarray(hx), jnp.asarray(slot.valid), n, score_args, score_fn
+            jnp.asarray(hx), jnp.asarray(slot.valid), n,
+            spec.score_args, spec.score_fn,
+            dequant_scale=spec.dequant_scale, score_codes=spec.score_codes,
         )
         return np.asarray(out, np.float32)[:n]
     finally:
@@ -300,7 +302,11 @@ def test_staging_encodes_like_prepare_host(data):
         scorer.staging.release(slot)
 
 
-def test_int8_scorer_opts_out_of_fusion():
+def test_int8_scorer_fuses_via_quickwire():
+    """PR 8 (quickwire) removed the int8 fusion opt-out: the int8 wire now
+    carries a dequant scale through the fused spec instead of demoting to
+    the split two-dispatch flush (tests/test_quickwire.py covers the fused
+    dequant·score·drift program itself)."""
     rng = np.random.default_rng(3)
     scorer = BatchScorer(
         LogisticParams(
@@ -313,7 +319,10 @@ def test_int8_scorer_opts_out_of_fusion():
         ),
         io_dtype="int8",
     )
-    assert scorer.fused_spec() is None
+    spec = scorer.fused_spec()
+    assert spec is not None and spec.wire == "int8"
+    assert spec.dequant_scale is not None
+    assert spec.dequant_scale.shape == (D,)
 
 
 # -- adaptive deadline ------------------------------------------------------

@@ -80,12 +80,14 @@ def profile(data):
 
 def _fused_once(scorer, monitor, batch_rows):
     n = len(batch_rows)
-    score_fn, score_args = scorer.fused_spec()
+    spec = scorer.fused_spec()
     slot = scorer.staging.acquire(_bucket(n, scorer.min_bucket))
     try:
         hx = scorer.stage_rows(slot, list(batch_rows))
         out = monitor.fused_flush(
-            jnp.asarray(hx), jnp.asarray(slot.valid), n, score_args, score_fn
+            jnp.asarray(hx), jnp.asarray(slot.valid), n,
+            spec.score_args, spec.score_fn,
+            dequant_scale=spec.dequant_scale, score_codes=spec.score_codes,
         )
         return np.asarray(out, np.float32)[:n]
     finally:

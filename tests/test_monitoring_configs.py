@@ -170,6 +170,27 @@ def test_fastlane_alert_and_panels_present():
         assert "scorer_device_calls_per_flush" in dash, rel
 
 
+def test_quickwire_alert_and_panels_present():
+    """The quickwire contract (ISSUE 8): the WireFormatUnfused alert ships
+    promlint-clean, its gauge is exported by service/metrics.py, and both
+    dashboards carry the wire-fusion stat — a wire format opting out of the
+    fused flush can never again be silent."""
+    path = os.path.join(RULES_DIR, "telemetry-alerts.yml")
+    with open(path) as f:
+        text = f.read()
+    assert "WireFormatUnfused" in text
+    assert "scorer_wire_fused" in text
+    assert promlint.lint_rules_file(path) == []
+    assert "scorer_wire_fused" in _exported_metric_names()
+    for rel in (
+        "grafana_dashboard.json",
+        os.path.join("grafana_provisioning", "dashboards", "fraud-tpu.json"),
+    ):
+        with open(os.path.join(MONITORING, rel)) as f:
+            dash = f.read()
+        assert "scorer_wire_fused" in dash, rel
+
+
 def test_mesh_rules_file_ships():
     """The switchyard contract (ISSUE 7): mesh-alerts.yml ships
     promlint-clean with the two promised alerts."""

@@ -1,0 +1,682 @@
+"""Quickwire acceptance tests (ISSUE 8): the quantized end-to-end hot path.
+
+The int8 wire keeps the fused single-dispatch flush (fused
+dequant·score·drift — ``monitor/drift._fused_flush_quant``), quantized
+scores match f32 within the gated tolerance, drift histograms bin
+comparably across wire formats, the compressed d2h return wire (f16/uint8)
+decodes allocation-free, the N-shard mesh flush bitwise-matches the
+single-device quantized flush, calibration is a stamped artifact rebound on
+hot swap, and a wire format opting out of fusion is loud (log + gauge).
+"""
+
+import asyncio
+import logging
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fraud_detection_tpu.monitor.baseline import build_baseline_profile
+from fraud_detection_tpu.monitor.drift import DriftMonitor, psi_np
+from fraud_detection_tpu.monitor.watchtower import Thresholds, Watchtower
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.quant import (
+    QuantCalibration,
+    derive_calibration,
+    load_calibration,
+    save_calibration,
+)
+from fraud_detection_tpu.ops.scaler import ScalerParams, scaler_fit
+from fraud_detection_tpu.ops.scorer import (
+    BatchScorer,
+    _bucket,
+    _raw_score_linear,
+    decode_scores_into,
+)
+from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.service.microbatch import MicroBatcher
+
+D = 30
+THR = Thresholds(psi=0.2, ks=0.15, ece=0.1, disagree=0.05, min_rows=64)
+
+#: gated drift-comparability epsilon: PSI between the int8-path and
+#: f32-path windows on IDENTICAL traffic (measured ~0.001 score /
+#: ~0.03 feature-max on standard-normal data at sigma_range 8 — the gates
+#: carry ~3× margin and sit far under the 0.2 drift alert threshold).
+SCORE_PSI_EPS = 0.02
+FEATURE_PSI_EPS = 0.1
+
+#: gated score-parity tolerance of the int8 wire vs f32 (quantization
+#: error of the mean±8σ lattice; measured max ~0.023, mean ~0.004).
+QUANT_ATOL = 5e-2
+QUANT_MEAN_TOL = 1e-2
+
+
+def _params(seed: int = 0) -> LogisticParams:
+    rng = np.random.default_rng(seed)
+    return LogisticParams(
+        coef=rng.standard_normal(D).astype(np.float32) * 0.3,
+        intercept=np.float32(-1.0),
+    )
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    return (rng.standard_normal((4096, D)) * 2.0 + 0.5).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def scaler(data):
+    return scaler_fit(data)
+
+
+@pytest.fixture(scope="module")
+def profile(data, scaler):
+    scorer = BatchScorer(_params(), scaler)
+    return build_baseline_profile(
+        data, scorer.predict_proba(data),
+        feature_names=[f"f{i}" for i in range(D)],
+    )
+
+
+def _fused_once(scorer, monitor, batch_rows, out_dtype=jnp.float32):
+    n = len(batch_rows)
+    spec = scorer.fused_spec()
+    slot = scorer.staging.acquire(_bucket(n, scorer.min_bucket))
+    try:
+        hx = scorer.stage_rows(slot, list(batch_rows))
+        out = monitor.fused_flush(
+            jnp.asarray(hx), jnp.asarray(slot.valid), n,
+            spec.score_args, spec.score_fn,
+            dequant_scale=spec.dequant_scale, score_codes=spec.score_codes,
+            out_dtype=out_dtype,
+        )
+        return np.asarray(out)[:n]
+    finally:
+        scorer.staging.release(slot)
+
+
+# -- calibration artifact ----------------------------------------------------
+
+
+def test_calibration_roundtrip(tmp_path, scaler):
+    cal = derive_calibration(scaler, sigma_range=6.0)
+    save_calibration(str(tmp_path), cal)
+    got = load_calibration(str(tmp_path))
+    assert got is not None
+    assert got.sigma_range == 6.0
+    np.testing.assert_array_equal(got.scale, cal.scale)
+    assert load_calibration(str(tmp_path / "nope")) is None
+
+
+def test_stamped_calibration_matches_scaler_derived(data, scaler):
+    """A scorer bound to the stamped calibration quantizes bitwise like the
+    legacy scaler-derived path (same mean±8σ math, now artifact-pinned)."""
+    cal = derive_calibration(scaler)
+    a = BatchScorer(_params(), scaler, io_dtype="int8")
+    b = BatchScorer(_params(), scaler, io_dtype="int8", calibration=cal)
+    np.testing.assert_array_equal(a._quant_scale, b._quant_scale)
+    pa = a.predict_proba(data[:257])
+    pb = b.predict_proba(data[:257])
+    assert np.array_equal(pa.view(np.uint32), pb.view(np.uint32))
+
+
+def test_calibration_guards_constant_features():
+    sp = ScalerParams(
+        mean=np.zeros(D, np.float32), scale=np.zeros(D, np.float32),
+        var=np.zeros(D, np.float32), n_samples=np.float32(1),
+    )
+    cal = derive_calibration(sp)
+    assert np.all(cal.scale > 0), "zero scale would blow up the encoder"
+
+
+# -- the fused dequant·score·drift program ------------------------------------
+
+
+def test_quant_fused_scores_match_split_bitwise(data, scaler, profile):
+    """Linear family (score_codes=True): the fused quant program scores the
+    codes with the dequant-folded weights — bitwise-identical to the split
+    int8 path (scorer._score over the same codes)."""
+    scorer = BatchScorer(_params(), scaler, io_dtype="int8")
+    for n in (1, 7, 64, 700):
+        fused = _fused_once(scorer, DriftMonitor(profile), data[:n])
+        split = scorer.predict_proba(data[:n])
+        assert np.array_equal(
+            fused.view(np.uint32), split.view(np.uint32)
+        ), f"quant fused scores diverge from the split int8 path at n={n}"
+
+
+def test_quant_fused_parity_vs_f32(data, scaler, profile):
+    """The gated score-parity tolerance: fused-int8 vs fused-f32."""
+    f32 = BatchScorer(_params(), scaler)
+    q8 = BatchScorer(_params(), scaler, io_dtype="int8")
+    s_f = _fused_once(f32, DriftMonitor(profile), data[:700])
+    s_q = _fused_once(q8, DriftMonitor(profile), data[:700])
+    np.testing.assert_allclose(s_q, s_f, atol=QUANT_ATOL)
+    assert np.abs(s_q - s_f).mean() < QUANT_MEAN_TOL
+
+
+def test_quant_drift_windows_bin_comparably(data, scaler, profile):
+    """Identical traffic through the f32 fused flush and the int8 quant
+    flush: PSI between the two windows stays under the gated epsilon, so
+    watchtower PSI/KS thresholds mean the same thing on both wires."""
+    f32 = BatchScorer(_params(), scaler)
+    q8 = BatchScorer(_params(), scaler, io_dtype="int8")
+    mon_f, mon_q = DriftMonitor(profile), DriftMonitor(profile)
+    for lo in range(0, 4096, 512):
+        batch = data[lo : lo + 512]
+        _fused_once(f32, mon_f, batch)
+        _fused_once(q8, mon_q, batch)
+    wf, wq = mon_f.window, mon_q.window
+    score_psi = psi_np(np.asarray(wq.score_counts), np.asarray(wf.score_counts))
+    assert score_psi <= SCORE_PSI_EPS, score_psi
+    fc_q = np.asarray(wq.feature_counts)
+    fc_f = np.asarray(wf.feature_counts)
+    feature_psi = max(psi_np(fc_q[i], fc_f[i]) for i in range(D))
+    assert feature_psi <= FEATURE_PSI_EPS, feature_psi
+    # both windows saw the same live-row mass
+    assert float(wq.n_rows) == pytest.approx(float(wf.n_rows))
+
+
+def test_quant_drift_bins_dequantized_values(data, scaler, profile):
+    """The histograms must bin xf = codes·scale (the values the model
+    actually scored), not the raw f32 rows and not the codes: exact count
+    match against a host-side rebin of the dequantized codes."""
+    from fraud_detection_tpu.monitor.baseline import feature_histogram
+
+    scorer = BatchScorer(_params(), scaler, io_dtype="int8")
+    mon = DriftMonitor(profile)
+    batch = data[:64]
+    _fused_once(scorer, mon, batch)
+    codes = scorer._prepare_host(batch.copy())
+    xf = codes.astype(np.float32) * scorer._quant_scale
+    want = np.asarray(
+        feature_histogram(
+            jnp.asarray(xf), jnp.asarray(profile.feature_edges),
+            weights=jnp.ones((64,), jnp.float32),
+        )
+    )
+    got = np.asarray(mon.window.feature_counts)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_explicit_dequant_path_matches_folded(data, scaler, profile):
+    """score_codes=False (the pallas/tree families): scoring the dequantized
+    xf with the RAW scaler-folded weights matches the folded-weights-on-codes
+    path within float error — the two fused variants agree."""
+    scorer = BatchScorer(_params(), scaler, io_dtype="int8")
+    folded = _fused_once(scorer, DriftMonitor(profile), data[:256])
+
+    spec = scorer.fused_spec()
+    mon = DriftMonitor(profile)
+    slot = scorer.staging.acquire(256)
+    try:
+        hx = scorer.stage_rows(slot, [data[i] for i in range(256)])
+        out = mon.fused_flush(
+            jnp.asarray(hx), jnp.asarray(slot.valid), 256,
+            (scorer._raw_coef, scorer.intercept), _raw_score_linear,
+            dequant_scale=spec.dequant_scale, score_codes=False,
+        )
+        explicit = np.asarray(out)[:256]
+    finally:
+        scorer.staging.release(slot)
+    np.testing.assert_allclose(explicit, folded, atol=1e-5)
+
+
+def test_quant_warmup_leaves_window_untouched(data, scaler, profile):
+    scorer = BatchScorer(_params(), scaler, io_dtype="int8")
+    mon = DriftMonitor(profile)
+    _fused_once(scorer, mon, data[:100])
+    before = {
+        f: np.asarray(getattr(mon.window, f)).copy()
+        for f in mon.window._fields
+    }
+    rows_before = mon.rows_seen
+    mon.warm_fused(scorer, 64, out_dtype=jnp.uint8)
+    for f, a in before.items():
+        b = np.asarray(getattr(mon.window, f))
+        assert np.array_equal(a, b), f"quant warmup disturbed window field {f}"
+    assert mon.rows_seen == rows_before
+
+
+def test_all_padding_quant_flush(data, scaler, profile):
+    """valid = 0 everywhere (the warmup shape): finite scores, window and
+    row counts bitwise-unchanged, uint8 return decodes without incident."""
+    scorer = BatchScorer(_params(), scaler, io_dtype="int8")
+    mon = DriftMonitor(profile)
+    spec = scorer.fused_spec()
+    slot = scorer.staging.acquire(64)
+    try:
+        slot.f32[:] = 0.0
+        hx = scorer._encode_slot(slot)
+        slot.valid[:] = 0.0
+        before = np.asarray(mon.window.feature_counts).copy()
+        out = mon.fused_flush(
+            jnp.asarray(hx), jnp.asarray(slot.valid), 0,
+            spec.score_args, spec.score_fn,
+            dequant_scale=spec.dequant_scale, score_codes=spec.score_codes,
+            out_dtype=jnp.uint8,
+        )
+        raw = np.asarray(out)
+        assert raw.dtype == np.uint8
+        decoded = decode_scores_into(raw, slot.scores)
+        assert np.all(np.isfinite(decoded))
+        assert np.all((decoded >= 0.0) & (decoded <= 1.0))
+        np.testing.assert_array_equal(
+            np.asarray(mon.window.feature_counts), before
+        )
+        assert float(mon.window.n_rows) == 0.0
+    finally:
+        scorer.staging.release(slot)
+
+
+def test_same_seed_quant_runs_bitwise_reproducible(data, scaler, profile):
+    """The fraud-range invariant, extended to the quantized wire: two
+    same-seed runs leave bitwise-identical drift windows."""
+    from fraud_detection_tpu.range.invariants import windows_bitwise_equal
+
+    def run():
+        scorer = BatchScorer(_params(), scaler, io_dtype="int8")
+        mon = DriftMonitor(profile)
+        for lo in range(0, 2048, 512):
+            _fused_once(scorer, mon, data[lo : lo + 512], out_dtype=jnp.uint8)
+        return mon.window
+
+    outcome = windows_bitwise_equal(run(), run())
+    assert outcome.ok, outcome
+
+
+# -- compressed d2h return wire ----------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "out_dtype,np_dtype,tol",
+    [(jnp.float16, np.float16, 2e-3), (jnp.uint8, np.uint8, 1.0 / 255 + 1e-6)],
+)
+def test_return_wire_roundtrip_parity(
+    data, scaler, profile, out_dtype, np_dtype, tol
+):
+    scorer = BatchScorer(_params(), scaler)
+    ref = _fused_once(scorer, DriftMonitor(profile), data[:700])
+    raw = _fused_once(
+        scorer, DriftMonitor(profile), data[:700], out_dtype=out_dtype
+    )
+    assert raw.dtype == np_dtype
+    decoded = np.zeros(raw.shape, np.float32)
+    decode_scores_into(raw, decoded)
+    np.testing.assert_allclose(decoded, ref, atol=tol)
+
+
+def test_return_wire_does_not_touch_drift_fold(data, scaler, profile):
+    """The output cast narrows ONLY the fetched bytes: window state from a
+    uint8-return flush is bitwise-identical to the f32-return flush."""
+    scorer = BatchScorer(_params(), scaler, io_dtype="int8")
+    mon_a, mon_b = DriftMonitor(profile), DriftMonitor(profile)
+    _fused_once(scorer, mon_a, data[:256])
+    _fused_once(scorer, mon_b, data[:256], out_dtype=jnp.uint8)
+    for f in mon_a.window._fields:
+        a = np.asarray(getattr(mon_a.window, f), np.float32)
+        b = np.asarray(getattr(mon_b.window, f), np.float32)
+        assert np.array_equal(a.view(np.uint32), b.view(np.uint32)), f
+
+
+def test_return_wire_decode_zero_alloc_steady_state(data, scaler, profile):
+    scorer = BatchScorer(_params(), scaler, io_dtype="int8")
+    mon = DriftMonitor(profile)
+    rows = data[:64]
+    _fused_once(scorer, mon, rows, out_dtype=jnp.uint8)  # create the slot
+    before = scorer.staging.allocations
+    for _ in range(50):
+        _fused_once(scorer, mon, rows, out_dtype=jnp.uint8)
+    assert scorer.staging.allocations == before, (
+        "steady-state quant flushes allocated fresh staging buffers"
+    )
+
+
+# -- compile sentinel exactness ----------------------------------------------
+
+
+def _compiles(entrypoint: str) -> float:
+    return metrics.xla_compiles.labels(entrypoint)._value.get()
+
+
+def test_quickwire_sentinel_exact_across_bucket_ladder(data, scaler, profile):
+    """xla_compiles_total{entrypoint="quickwire.flush"} counts exactly one
+    compile per shape bucket; re-driving the buckets adds zero (the
+    RecompileStorm discipline, extended to the quant program)."""
+    import jax
+
+    from fraud_detection_tpu.telemetry import compile_sentinel
+
+    jax.clear_caches()
+    compile_sentinel.install()
+    try:
+        scorer = BatchScorer(_params(11), scaler, io_dtype="int8")
+        mon = DriftMonitor(profile)
+        base = _compiles("quickwire.flush")
+        fastlane_base = _compiles("fastlane.flush")
+        for n in (3, 12, 20):  # buckets 8, 16, 32
+            _fused_once(scorer, mon, data[:n], out_dtype=jnp.uint8)
+        assert _compiles("quickwire.flush") - base == 3
+        for n in (5, 9, 31):  # same buckets: cache hits only
+            _fused_once(scorer, mon, data[:n], out_dtype=jnp.uint8)
+        assert _compiles("quickwire.flush") - base == 3
+        # the f32 fastlane program was never dispatched by the quant wire
+        assert _compiles("fastlane.flush") == fastlane_base
+    finally:
+        compile_sentinel.uninstall()
+
+
+# -- the mesh variant ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_mesh_quant_flush_bitwise_matches_single_device(
+    data, scaler, profile, n_shards
+):
+    """The quickwire acceptance bar: N-shard quantized mesh flush scores
+    bitwise-match the single-device quantized flush, and the merged shard
+    windows equal the single-device window exactly."""
+    from fraud_detection_tpu.mesh.shardflush import (
+        MeshDriftMonitor,
+        merge_window,
+    )
+    from fraud_detection_tpu.mesh.topology import serving_mesh
+
+    scorer = BatchScorer(_params(), scaler, io_dtype="int8")
+    rows = data[:1024]
+    ref_mon = DriftMonitor(profile)
+    ref = _fused_once(scorer, ref_mon, rows)
+
+    mesh_mon = MeshDriftMonitor(profile, serving_mesh(n_shards))
+    got = _fused_once(scorer, mesh_mon, rows)
+    assert np.array_equal(got.view(np.uint32), ref.view(np.uint32)), (
+        f"{n_shards}-shard quant scores diverge from single-device"
+    )
+    merged = merge_window(mesh_mon.shard_window)
+    for f in merged._fields:
+        a = np.asarray(getattr(merged, f), np.float32)
+        b = np.asarray(getattr(ref_mon.window, f), np.float32)
+        assert np.array_equal(a, b), f"merged shard window field {f} diverges"
+
+
+def test_mesh_quant_uint8_return(data, scaler, profile):
+    from fraud_detection_tpu.mesh.shardflush import MeshDriftMonitor
+    from fraud_detection_tpu.mesh.topology import serving_mesh
+
+    scorer = BatchScorer(_params(), scaler, io_dtype="int8")
+    ref = _fused_once(scorer, DriftMonitor(profile), data[:1024])
+    mesh_mon = MeshDriftMonitor(profile, serving_mesh(4))
+    raw = _fused_once(scorer, mesh_mon, data[:1024], out_dtype=jnp.uint8)
+    assert raw.dtype == np.uint8
+    np.testing.assert_allclose(
+        raw.astype(np.float32) / 255.0, ref, atol=1.0 / 255 + 1e-6
+    )
+
+
+# -- the serving path end to end ----------------------------------------------
+
+
+def test_microbatcher_int8_wire_single_dispatch(data, scaler, profile):
+    """Through the real MicroBatcher with a watchtower: the int8 wire runs
+    the fused path (ONE device dispatch, scorer_wire_fused=1), the split
+    update never fires, and scores match the f32 reference within the
+    quantization tolerance."""
+    scorer = BatchScorer(_params(), scaler, io_dtype="int8")
+    ref = BatchScorer(_params(), scaler)
+    wt = Watchtower(profile, thresholds=THR)
+    calls = {"fused": 0, "split_update": 0}
+    real_fused = DriftMonitor.fused_flush
+    real_update = DriftMonitor.update
+
+    def spy_fused(self, *a, **k):
+        calls["fused"] += 1
+        return real_fused(self, *a, **k)
+
+    def spy_update(self, *a, **k):
+        calls["split_update"] += 1
+        return real_update(self, *a, **k)
+
+    async def run():
+        mb = MicroBatcher(
+            scorer, max_batch=64, max_wait_ms=1.0, watchtower=wt,
+            telemetry=False, fused=True,
+        )
+        await mb.start()
+        DriftMonitor.fused_flush = spy_fused
+        DriftMonitor.update = spy_update
+        try:
+            out = await asyncio.gather(*(mb.score(data[i]) for i in range(48)))
+        finally:
+            DriftMonitor.fused_flush = real_fused
+            DriftMonitor.update = real_update
+            await mb.stop()
+        return out
+
+    try:
+        out = asyncio.run(run())
+    finally:
+        wt.drain()
+        wt.close()
+    assert len(out) == 48
+    want = ref.predict_proba(data[:48])
+    np.testing.assert_allclose(out, want, atol=QUANT_ATOL)
+    assert calls["fused"] >= 1
+    assert calls["split_update"] == 0, (
+        "int8 wire demoted to the split flush — quickwire regression"
+    )
+    assert metrics.scorer_device_calls_per_flush._value.get() == 1
+    assert metrics.scorer_wire_fused._value.get() == 1
+    assert wt.drift.rows_seen == 48
+
+
+@pytest.mark.parametrize("wire", ["float16", "uint8"])
+def test_microbatcher_return_wire_end_to_end(data, scaler, profile, wire):
+    """SCORER_RETURN_WIRE narrows the d2h bytes; decoded request scores
+    stay within the wire's tolerance of the f32-return run."""
+    tol = 2e-3 if wire == "float16" else 1.0 / 255 + 1e-6
+    scorer = BatchScorer(_params(), scaler)
+    wt = Watchtower(profile, thresholds=THR)
+
+    async def run():
+        mb = MicroBatcher(
+            scorer, max_batch=64, max_wait_ms=1.0, watchtower=wt,
+            telemetry=False, fused=True, return_wire=wire,
+        )
+        await mb.start()
+        out = await asyncio.gather(*(mb.score(data[i]) for i in range(48)))
+        await mb.stop()
+        return out
+
+    try:
+        out = asyncio.run(run())
+    finally:
+        wt.drain()
+        wt.close()
+    want = scorer.predict_proba(data[:48])
+    np.testing.assert_allclose(out, want, atol=tol)
+    assert wt.drift.rows_seen == 48
+
+
+def test_microbatcher_rejects_unknown_return_wire(scaler):
+    scorer = BatchScorer(_params(), scaler)
+    with pytest.raises(ValueError, match="return wire"):
+        MicroBatcher(scorer, telemetry=False, return_wire="int4")
+
+
+def test_demotion_is_logged_and_exported(data, profile, caplog):
+    """A scorer whose wire format opts out of fusion must be loud: one
+    startup warning + scorer_wire_fused latched to 0 (the WireFormatUnfused
+    alert input) — never a silent double dispatch."""
+
+    class NoFuseScorer(BatchScorer):
+        io_dtype = "exotic"
+
+        def fused_spec(self):
+            return None
+
+    scorer = NoFuseScorer(
+        _params(),
+        ScalerParams(
+            mean=np.zeros(D, np.float32), scale=np.ones(D, np.float32),
+            var=np.ones(D, np.float32), n_samples=np.float32(1),
+        ),
+    )
+    wt = Watchtower(profile, thresholds=THR)
+
+    async def run():
+        mb = MicroBatcher(
+            scorer, max_batch=32, max_wait_ms=1.0, watchtower=wt,
+            telemetry=False, fused=True,
+        )
+        with caplog.at_level(
+            logging.WARNING, logger="fraud_detection_tpu.microbatch"
+        ):
+            await mb.start()  # startup warmup resolves the target → logs
+            out = await asyncio.gather(*(mb.score(data[i]) for i in range(8)))
+            await mb.stop()
+        return out
+
+    try:
+        out = asyncio.run(run())
+    finally:
+        wt.drain()
+        wt.close()
+    assert len(out) == 8
+    assert metrics.scorer_wire_fused._value.get() == 0
+    demotions = [
+        r for r in caplog.records if "opts out of the fused flush" in r.message
+    ]
+    assert len(demotions) == 1, "demotion must log exactly once at startup"
+    assert metrics.scorer_device_calls_per_flush._value.get() == 2
+
+
+# -- calibration lifecycle (stamp + hot-swap rebind) ---------------------------
+
+
+def test_model_save_stamps_calibration(tmp_path, data, scaler):
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+    from fraud_detection_tpu.ops.quant import CALIBRATION_FILE
+
+    m = FraudLogisticModel(_params(), scaler, [f"f{i}" for i in range(D)])
+    art = str(tmp_path / "art")
+    m.save(art, joblib_too=False)
+    assert (tmp_path / "art" / CALIBRATION_FILE).exists()
+    cal = load_calibration(art)
+    np.testing.assert_allclose(
+        cal.scale, derive_calibration(scaler).scale, rtol=1e-6
+    )
+
+
+def test_load_binds_stamped_calibration_on_int8_wire(
+    tmp_path, data, scaler, monkeypatch
+):
+    """SCORER_WIRE=int8: a loaded model quantizes with the artifact-stamped
+    calibration, not a re-derivation — pin it by stamping a DIFFERENT range
+    and checking the scorer picked it up."""
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+
+    m = FraudLogisticModel(_params(), scaler, [f"f{i}" for i in range(D)])
+    art = str(tmp_path / "art")
+    m.save(art, joblib_too=False)
+    stamped = QuantCalibration(
+        scale=derive_calibration(scaler, sigma_range=4.0).scale,
+        sigma_range=4.0,
+    )
+    save_calibration(art, stamped)  # overwrite with the distinctive range
+    monkeypatch.setenv("SCORER_WIRE", "int8")
+    loaded = FraudLogisticModel.load(art)
+    assert loaded.scorer._io_np_dtype == np.int8
+    np.testing.assert_array_equal(loaded.scorer._quant_scale, stamped.scale)
+    assert loaded.scorer.calibration.sigma_range == 4.0
+
+
+def test_int8_wire_without_calibration_falls_back_loudly(monkeypatch, caplog):
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+
+    monkeypatch.setenv("SCORER_WIRE", "int8")
+    with caplog.at_level(logging.WARNING, logger="fraud_detection_tpu.models"):
+        m = FraudLogisticModel(_params(), None, [f"f{i}" for i in range(D)])
+    assert m.scorer._io_np_dtype == np.float32
+    assert any("float32 wire" in r.message for r in caplog.records)
+
+
+def test_hot_swap_rebinds_calibration(tmp_path, data, scaler, monkeypatch):
+    """ModelPromotion contract: when the reloader swaps the champion, the
+    new scorer serves with the NEW artifact's stamped calibration."""
+    from fraud_detection_tpu.lifecycle.swap import ModelReloader, ModelSlot
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+
+    monkeypatch.setenv("SCORER_WIRE", "int8")
+    names = [f"f{i}" for i in range(D)]
+
+    def make(seed, sigma_range):
+        m = FraudLogisticModel(_params(seed), scaler, names)
+        art = str(tmp_path / f"v{seed}")
+        m.save(art, joblib_too=False)
+        save_calibration(
+            art,
+            QuantCalibration(
+                scale=derive_calibration(scaler, sigma_range).scale,
+                sigma_range=sigma_range,
+            ),
+        )
+        return FraudLogisticModel.load(art), art
+
+    model_a, art_a = make(1, 8.0)
+    model_b, art_b = make(2, 5.0)
+
+    class _Reg:
+        def __init__(self):
+            self.aliases = {"prod": 1}
+            self.dirs = {1: art_a, 2: art_b}
+
+        def get_version_by_alias(self, name, alias):
+            return self.aliases.get(alias)
+
+        def artifact_dir(self, name, version):
+            return self.dirs[version]
+
+    reg = _Reg()
+    slot = ModelSlot(model_a, "test:a", 1)
+    reloader = ModelReloader(slot, max_batch=32)
+    reloader._registry = lambda: reg
+    assert slot.model.scorer.calibration.sigma_range == 8.0
+
+    reg.aliases["prod"] = 2
+    out = reloader.check_once()
+    assert out["champion"].startswith("swapped")
+    assert slot.model.scorer.calibration.sigma_range == 5.0
+    np.testing.assert_array_equal(
+        slot.model.scorer._quant_scale,
+        derive_calibration(scaler, 5.0).scale,
+    )
+
+
+def test_shadow_challenger_gets_quantized_treatment(data, scaler, profile):
+    """The shadow-challenger sample path scores through the challenger's
+    OWN wire: an int8-wire challenger shadow-scores within quantization
+    tolerance and its disagreement stats accumulate normally."""
+    champion = BatchScorer(_params(), scaler)
+    challenger = BatchScorer(_params(), scaler, io_dtype="int8")
+    wt = Watchtower(
+        profile,
+        challenger=types.SimpleNamespace(scorer=challenger),
+        challenger_source="test:int8-challenger",
+        thresholds=THR,
+        sample_rate=1.0,
+    )
+    try:
+        rows = data[:256]
+        scores = champion.predict_proba(rows)
+        assert wt.observe(rows, scores)
+        assert wt.drain()
+        sh = wt.shadow.stats()
+        assert sh["window_rows"] > 0
+        # same model params either side of the wire: decisions agree
+        assert sh["disagreement"] < 0.05
+    finally:
+        wt.close()
