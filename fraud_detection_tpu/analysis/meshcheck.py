@@ -428,6 +428,51 @@ def _build_quickwire_flush(mesh: Mesh):
     )
 
 
+@register_entrypoint("lantern.flush")
+def _build_lantern_flush(mesh: Mesh):
+    """The fused score+explain flush (lantern): scores, per-row top-k SHAP
+    reason codes, AND the drift-window fold in ONE donated dispatch — the
+    serving hot path once SCORER_EXPLAIN=topk, proven at every mesh size
+    like ``fastlane.flush``."""
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import (
+        N_CALIB_BINS,
+        DriftWindow,
+        _fused_flush_explain,
+    )
+    from fraud_detection_tpu.ops.scorer import _raw_score_linear
+
+    window = DriftWindow(
+        feature_counts=sds((_FEATURES, N_FEATURE_BINS), jnp.float32, mesh, P()),
+        score_counts=sds((N_SCORE_BINS,), jnp.float32, mesh, P()),
+        calib_count=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_conf=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_label=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        n_rows=sds((), jnp.float32, mesh, P()),
+    )
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, P(DATA_AXIS))
+    valid = sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((_FEATURES, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = (
+        sds((_FEATURES,), jnp.float32, mesh, P()),
+        sds((), jnp.float32, mesh, P()),
+    )
+    explain_args = (
+        sds((_FEATURES,), jnp.float32, mesh, P()),
+        sds((_FEATURES,), jnp.float32, mesh, P()),
+    )
+    fn = lambda w, xx, vv, dd, fe, se, sa, ea: _fused_flush_explain(  # noqa: E731
+        w, xx, vv, dd, fe, se, sa, ea,
+        score_fn=_raw_score_linear, explain_k=3,
+    )
+    return fn, (
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        explain_args,
+    )
+
+
 @register_entrypoint("mesh.sharded_flush")
 def _build_mesh_sharded_flush(mesh: Mesh):
     """The switchyard serving flush: the fused score+drift program as ONE
@@ -507,6 +552,53 @@ def _build_mesh_quickwire_flush(mesh: Mesh):
     )
     return fn, (
         window, x, valid, decay, feature_edges, score_edges, score_args, dq,
+    )
+
+
+@register_entrypoint("mesh.lantern_flush")
+def _build_mesh_lantern_flush(mesh: Mesh):
+    """The lantern mesh flush: fused score+explain+drift as ONE shard_map
+    dispatch over the data axis — rows AND reason codes row-sharded,
+    explain params replicated, per-shard windows donated through. The
+    ``MESH_FLUSH_DEVICES>1`` explain-at-serve topology at every virtual
+    mesh size."""
+    from fraud_detection_tpu.mesh.shardflush import _sharded_flush_explain
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import N_CALIB_BINS, DriftWindow
+    from fraud_detection_tpu.ops.scorer import _raw_score_linear
+
+    n_shards = mesh.shape[DATA_AXIS]
+    shard = P(DATA_AXIS)
+    window = DriftWindow(
+        feature_counts=sds(
+            (n_shards, _FEATURES, N_FEATURE_BINS), jnp.float32, mesh, shard
+        ),
+        score_counts=sds((n_shards, N_SCORE_BINS), jnp.float32, mesh, shard),
+        calib_count=sds((n_shards, N_CALIB_BINS), jnp.float32, mesh, shard),
+        calib_conf=sds((n_shards, N_CALIB_BINS), jnp.float32, mesh, shard),
+        calib_label=sds((n_shards, N_CALIB_BINS), jnp.float32, mesh, shard),
+        n_rows=sds((n_shards,), jnp.float32, mesh, shard),
+    )
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, shard)
+    valid = sds((_ROWS,), jnp.float32, mesh, shard)
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((_FEATURES, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = (
+        sds((_FEATURES,), jnp.float32, mesh, P()),
+        sds((), jnp.float32, mesh, P()),
+    )
+    explain_args = (
+        sds((_FEATURES,), jnp.float32, mesh, P()),
+        sds((_FEATURES,), jnp.float32, mesh, P()),
+    )
+    fn = lambda w, xx, vv, dd, fe, se, sa, ea: _sharded_flush_explain(  # noqa: E731
+        w, xx, vv, dd, fe, se, sa, ea,
+        score_fn=_raw_score_linear, mesh=mesh, explain_k=3,
+    )
+    return fn, (
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        explain_args,
     )
 
 

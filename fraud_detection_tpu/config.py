@@ -240,6 +240,25 @@ def scorer_return_wire() -> str:
     return _get("SCORER_RETURN_WIRE", "float32").lower()
 
 
+def scorer_explain() -> str:
+    """``SCORER_EXPLAIN`` — serve-time explanation mode for the fused flush
+    (``off`` | ``topk``). ``topk`` (lantern) adds a third output to the
+    fused serving program: per-row top-``SCORER_EXPLAIN_K`` SHAP reason
+    codes (arg-top-k of per-feature attributions), computed in the SAME
+    donated dispatch as scores + drift — every ``/predict`` response then
+    carries its "why" at flush latency. Families without a fused explain
+    program (GBT) keep fused scoring and demote explanations to the async
+    worker path, loudly (``scorer_explain_fused 0`` + ExplainUnfused).
+    Default ``off``."""
+    return _get("SCORER_EXPLAIN", "off").lower()
+
+
+def scorer_explain_k() -> int:
+    """``SCORER_EXPLAIN_K`` — reason codes per scored row when
+    ``SCORER_EXPLAIN=topk`` (clamped to the feature count). Default 3."""
+    return _get_int("SCORER_EXPLAIN_K", 3)
+
+
 def quant_sigma_range() -> float:
     """``QUANT_SIGMA_RANGE`` — symmetric range (in training sigmas) the
     int8 wire's per-feature lattice spans when calibration is derived from

@@ -159,6 +159,12 @@ class ShardFront:
     def telemetry(self) -> bool:
         return self.shards[0].batcher.telemetry
 
+    @property
+    def explain(self) -> bool:
+        """Serve-time reason codes configured (lantern) — the shards share
+        the config, so shard 0 speaks for the front."""
+        return bool(getattr(self.shards[0].batcher, "explain", False))
+
     async def start(self) -> None:
         # Shards share the slot's scorer and the watchtower's drift
         # monitor, so ONE bucket-ladder warmup covers every shard —
@@ -232,6 +238,16 @@ class ShardFront:
         """Route one row; a failing shard is retried elsewhere in the same
         call (at most once per shard), so callers see a score or one final
         error — never a dead shard's exception."""
+        return await self._route("score", row, timeline)
+
+    async def score_ex(self, row, timeline=None):
+        """Route one row through the explain surface: ``(score, reasons)``
+        with the lantern reason codes from whichever shard scored it —
+        same shed/retry semantics as :meth:`score`, so a shard dying
+        mid-burst re-routes the row WITH its explain output intact."""
+        return await self._route("score_ex", row, timeline)
+
+    async def _route(self, method: str, row, timeline=None):
         last_exc: BaseException | None = None
         tried: set[int] = set()
         for _ in range(len(self.shards)):
@@ -249,7 +265,7 @@ class ShardFront:
                 # shard's scoring here (the kill-a-shard drill). Disarmed
                 # this is one global load.
                 fire("mesh.shard_flush", shard=h.shard_id)
-                out = await h.batcher.score(row, timeline)
+                out = await getattr(h.batcher, method)(row, timeline)
             except Exception as e:
                 last_exc = e
                 if h.note_error(e):

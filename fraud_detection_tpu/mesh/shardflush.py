@@ -35,16 +35,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from fraud_detection_tpu.monitor.baseline import (
-    BaselineProfile,
-    feature_histogram,
-    score_histogram,
-)
+from fraud_detection_tpu.monitor.baseline import BaselineProfile
 from fraud_detection_tpu.monitor.drift import (
     N_CALIB_BINS,
     DriftMonitor,
     DriftWindow,
+    _fold_serving_batch,
+    _narrow_reasons,
     _narrow_scores,
+    _topk_attributions,
 )
 from fraud_detection_tpu.parallel.compat import shard_map
 from fraud_detection_tpu.parallel.mesh import DATA_AXIS
@@ -110,35 +109,51 @@ def _shard_body(
     score_edges: jax.Array,
     score_args,
     dequant_scale=None,
+    explain_args=None,
     *,
     score_fn,
     score_codes: bool = True,
+    explain_k: int = 0,
     out_dtype=jnp.float32,
 ):
     """Per-shard flush body under shard_map: identical math to
     ``drift._fused_flush`` (``drift._fused_flush_quant`` when a
-    ``dequant_scale`` rides along — the quickwire quantized wire) over this
-    shard's rows and THIS shard's window (the leading shard axis arrives as
-    size 1 inside the block view). The global ``decay`` applies to every
-    shard, so the merged window evolves exactly as the single-device window
-    would for the same batch stream."""
+    ``dequant_scale`` rides along — the quickwire quantized wire;
+    ``drift._fused_flush_explain``/``_quant_explain`` when ``explain_k >
+    0`` adds the lantern reason-code leg) over this shard's rows and THIS
+    shard's window (the leading shard axis arrives as size 1 inside the
+    block view). The global ``decay`` applies to every shard, so the
+    merged window evolves exactly as the single-device window would for
+    the same batch stream. Reason codes are per-row over the full feature
+    axis (columns are unsharded), so each shard emits ITS rows' top-k with
+    no collective — row-sharded exactly like the scores."""
     w = jax.tree.map(lambda t: t[0], window)
     xf = x.astype(jnp.float32)
     if dequant_scale is not None:
         xf = xf * dequant_scale
     scores = score_fn(score_args, x if score_codes else xf).astype(jnp.float32)
-    fc = feature_histogram(xf, feature_edges, weights=valid)
-    sc = score_histogram(scores, score_edges, weights=valid)
-    new = DriftWindow(
-        feature_counts=w.feature_counts * decay + fc,
-        score_counts=w.score_counts * decay + sc,
-        calib_count=w.calib_count,
-        calib_conf=w.calib_conf,
-        calib_label=w.calib_label,
-        n_rows=w.n_rows * decay + jnp.sum(valid),
+    new = _fold_serving_batch(
+        w, xf, scores, valid, decay, feature_edges, score_edges
     )
-    return _narrow_scores(scores, out_dtype), jax.tree.map(
-        lambda t: t[None], new
+    shard_window = jax.tree.map(lambda t: t[None], new)
+    if explain_k > 0:
+        idx, val = _topk_attributions(xf, explain_args, explain_k)
+        idx, val = _narrow_reasons(idx, val, x.shape[1], out_dtype)
+        return _narrow_scores(scores, out_dtype), idx, val, shard_window
+    return _narrow_scores(scores, out_dtype), shard_window
+
+
+def _shard_body_explain(
+    window, x, valid, decay, feature_edges, score_edges, score_args,
+    explain_args, *, score_fn, explain_k, out_dtype,
+):
+    """Positional adapter for the plain-wire explain shard body (shard_map
+    maps arguments positionally against ``in_specs``, so the optional
+    ``dequant_scale`` slot cannot simply be skipped)."""
+    return _shard_body(
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        None, explain_args,
+        score_fn=score_fn, explain_k=explain_k, out_dtype=out_dtype,
     )
 
 
@@ -236,6 +251,116 @@ def _sharded_flush_quant(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("score_fn", "mesh", "explain_k", "out_dtype"),
+    donate_argnums=(0,),
+)
+def _sharded_flush_explain(
+    window: DriftWindow,  # per-shard windows, leading axis = shard
+    x: jax.Array,  # (b, d) staged bucket, b % n_shards == 0
+    valid: jax.Array,  # (b,)
+    decay: jax.Array,  # () global drift forgetting factor
+    feature_edges: jax.Array,
+    score_edges: jax.Array,
+    score_args,  # pytree, replicated
+    explain_args,  # (coef (d,), background_mean (d,)), replicated
+    *,
+    score_fn,
+    mesh,
+    explain_k: int,
+    out_dtype=jnp.float32,
+):
+    """The lantern mesh flush: fused score+explain+drift as ONE shard_map
+    dispatch over the data axis. Reason codes are row-sharded like the
+    scores (each shard top-k's its own rows over the replicated explain
+    params — no new collective on the hot path), so N-shard fused explain
+    output bitwise-matches the single-device lantern flush. Registered in
+    meshcheck (``mesh.lantern_flush``) and the compile sentinel."""
+    mapped = shard_map(
+        partial(
+            _shard_body_explain,
+            score_fn=score_fn,
+            explain_k=explain_k,
+            out_dtype=out_dtype,
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),  # window: shard axis
+            P(DATA_AXIS),  # x: rows
+            P(DATA_AXIS),  # valid: rows
+            P(),           # decay
+            P(),           # feature_edges
+            P(),           # score_edges
+            P(),           # score_args (replicated pytree prefix)
+            P(),           # explain_args (replicated)
+        ),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
+    )
+    return mapped(
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        explain_args,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "score_fn", "mesh", "score_codes", "explain_k", "out_dtype"
+    ),
+    donate_argnums=(0,),
+)
+def _sharded_flush_quant_explain(
+    window: DriftWindow,  # per-shard windows, leading axis = shard
+    x: jax.Array,  # (b, d) int8 quantization codes, b % n_shards == 0
+    valid: jax.Array,  # (b,)
+    decay: jax.Array,  # () global drift forgetting factor
+    feature_edges: jax.Array,
+    score_edges: jax.Array,
+    score_args,  # pytree, replicated
+    dequant_scale: jax.Array,  # (d,) replicated per-feature dequant scale
+    explain_args,  # (coef (d,), background_mean (d,)), replicated
+    *,
+    score_fn,
+    mesh,
+    score_codes: bool,
+    explain_k: int,
+    out_dtype=jnp.float32,
+):
+    """The lantern mesh flush on the quantized wire: fused
+    dequant·score·explain·drift as ONE shard_map dispatch — each shard
+    attributes over ITS dequantized rows (the multiply already paid for
+    the drift fold), reason codes row-sharded, no new collectives."""
+    mapped = shard_map(
+        partial(
+            _shard_body,
+            score_fn=score_fn,
+            score_codes=score_codes,
+            explain_k=explain_k,
+            out_dtype=out_dtype,
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(DATA_AXIS),  # window: shard axis
+            P(DATA_AXIS),  # x: rows
+            P(DATA_AXIS),  # valid: rows
+            P(),           # decay
+            P(),           # feature_edges
+            P(),           # score_edges
+            P(),           # score_args (replicated pytree prefix)
+            P(),           # dequant_scale (replicated)
+            P(),           # explain_args (replicated)
+        ),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        check_vma=False,
+    )
+    return mapped(
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        dequant_scale, explain_args,
+    )
+
+
 class MeshDriftMonitor(DriftMonitor):
     """Drift monitoring for the sharded serving mesh.
 
@@ -296,16 +421,60 @@ class MeshDriftMonitor(DriftMonitor):
         dequant_scale=None,
         score_codes: bool = True,
         out_dtype=jnp.float32,
-    ) -> jax.Array:
+        explain_args=None,
+        explain_k: int = 0,
+    ):
         """Score one staged bucket across every shard AND fold each shard's
         rows into its own window — one dispatch, no collectives (the
         quickwire ``_sharded_flush_quant`` program when ``dequant_scale``
-        rides along for a quantized wire). Same locking contract as the
-        base class: the critical section is the async dispatch plus the
-        donated-state store."""
+        rides along for a quantized wire; the lantern
+        ``_sharded_flush_explain``/``_quant_explain`` when ``explain_k >
+        0`` adds the row-sharded reason-code leg). Same locking contract
+        as the base class: the critical section is the async dispatch plus
+        the donated-state store."""
         # graftcheck: hot-path
         decay = self._decay_for(n_live)
+        explain_k = min(int(explain_k), int(x.shape[1]))  # k ≥ d clamps to d
         with self._lock:
+            if explain_k > 0 and explain_args is not None:
+                if dequant_scale is None:
+                    scores, eidx, eval_, self.shard_window = (
+                        _sharded_flush_explain(
+                            self.shard_window,
+                            x,
+                            valid,
+                            decay,
+                            self._feature_edges,
+                            self._score_edges,
+                            score_args,
+                            explain_args,
+                            score_fn=score_fn,
+                            mesh=self.mesh,
+                            explain_k=explain_k,
+                            out_dtype=out_dtype,
+                        )
+                    )
+                else:
+                    scores, eidx, eval_, self.shard_window = (
+                        _sharded_flush_quant_explain(
+                            self.shard_window,
+                            x,
+                            valid,
+                            decay,
+                            self._feature_edges,
+                            self._score_edges,
+                            score_args,
+                            dequant_scale,
+                            explain_args,
+                            score_fn=score_fn,
+                            mesh=self.mesh,
+                            score_codes=score_codes,
+                            explain_k=explain_k,
+                            out_dtype=out_dtype,
+                        )
+                    )
+                self.rows_seen += n_live
+                return scores, eidx, eval_
             if dequant_scale is None:
                 scores, self.shard_window = _sharded_flush(
                     self.shard_window,

@@ -98,6 +98,70 @@ def _narrow_scores(scores: jax.Array, out_dtype) -> jax.Array:
     return scores.astype(out_dtype)
 
 
+def _narrow_reasons(
+    idx: jax.Array, val: jax.Array, n_features: int, out_dtype
+) -> tuple[jax.Array, jax.Array]:
+    """Compress the fetched reason codes for the d2h link (lantern).
+
+    Indices are feature positions: one byte covers any schema up to 256
+    features (the Kaggle schema is 30), so they always ship ``uint8`` when
+    they fit. Values follow the score return wire's spirit — f16 halves
+    the bytes when any narrow wire is configured — except ``uint8``:
+    attributions are signed and unbounded, so the probability lattice does
+    not apply and the uint8 wire ships f16 values instead. Both decode
+    host-side into the staging slot's preallocated explain buffers
+    (ops/scorer.decode_explain_into)."""
+    if n_features <= 256:
+        idx = idx.astype(jnp.uint8)
+    if out_dtype != jnp.float32:
+        val = val.astype(jnp.float16)
+    return idx, val
+
+
+def _topk_attributions(
+    xf: jax.Array, explain_args, explain_k: int
+) -> tuple[jax.Array, jax.Array]:
+    """The lantern explain leg: exact interventional linear-SHAP
+    attributions over the values the model actually scored (``xf`` is the
+    dequantized/upcast f32 batch the drift histograms bin), reduced to the
+    per-row arg-top-k. Shares the ``ops/linear_shap`` body, so fused
+    attributions are bitwise the standalone explainer's on the f32 wire."""
+    from fraud_detection_tpu.ops.linear_shap import (
+        _raw_linear_shap,
+        topk_reasons,
+    )
+
+    coef, background_mean = explain_args
+    return topk_reasons(_raw_linear_shap(coef, background_mean, xf), explain_k)
+
+
+def _fold_serving_batch(
+    window: DriftWindow,
+    xf: jax.Array,
+    scores: jax.Array,
+    valid: jax.Array,
+    decay: jax.Array,
+    feature_edges: jax.Array,
+    score_edges: jax.Array,
+) -> DriftWindow:
+    """The serving-flush window fold — ONE body shared by every fused
+    program (plain/quant × with/without the explain leg, single-device and
+    the shard_map body): bin the batch the model actually scored,
+    decay-fold the drift histograms, pass calibration state through
+    untouched (serving batches carry no labels). A fold change edited here
+    reaches all the fused programs at once — they can never desync."""
+    fc = feature_histogram(xf, feature_edges, weights=valid)
+    sc = score_histogram(scores, score_edges, weights=valid)
+    return DriftWindow(
+        feature_counts=window.feature_counts * decay + fc,
+        score_counts=window.score_counts * decay + sc,
+        calib_count=window.calib_count,
+        calib_conf=window.calib_conf,
+        calib_label=window.calib_label,
+        n_rows=window.n_rows * decay + jnp.sum(valid),
+    )
+
+
 @partial(jax.jit, static_argnames=("score_fn", "out_dtype"), donate_argnums=(0,))
 def _fused_flush(
     window: DriftWindow,
@@ -137,15 +201,8 @@ def _fused_flush(
     """
     xf = x.astype(jnp.float32)
     scores = score_fn(score_args, x).astype(jnp.float32)
-    fc = feature_histogram(xf, feature_edges, weights=valid)
-    sc = score_histogram(scores, score_edges, weights=valid)
-    return _narrow_scores(scores, out_dtype), DriftWindow(
-        feature_counts=window.feature_counts * decay + fc,
-        score_counts=window.score_counts * decay + sc,
-        calib_count=window.calib_count,
-        calib_conf=window.calib_conf,
-        calib_label=window.calib_label,
-        n_rows=window.n_rows * decay + jnp.sum(valid),
+    return _narrow_scores(scores, out_dtype), _fold_serving_batch(
+        window, xf, scores, valid, decay, feature_edges, score_edges
     )
 
 
@@ -188,15 +245,103 @@ def _fused_flush_quant(
     """
     xf = x.astype(jnp.float32) * dequant_scale
     scores = score_fn(score_args, x if score_codes else xf).astype(jnp.float32)
-    fc = feature_histogram(xf, feature_edges, weights=valid)
-    sc = score_histogram(scores, score_edges, weights=valid)
-    return _narrow_scores(scores, out_dtype), DriftWindow(
-        feature_counts=window.feature_counts * decay + fc,
-        score_counts=window.score_counts * decay + sc,
-        calib_count=window.calib_count,
-        calib_conf=window.calib_conf,
-        calib_label=window.calib_label,
-        n_rows=window.n_rows * decay + jnp.sum(valid),
+    return _narrow_scores(scores, out_dtype), _fold_serving_batch(
+        window, xf, scores, valid, decay, feature_edges, score_edges
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("score_fn", "explain_k", "out_dtype"),
+    donate_argnums=(0,),
+)
+def _fused_flush_explain(
+    window: DriftWindow,
+    x: jax.Array,  # (b, d) staged batch, possibly narrow-IO encoded
+    valid: jax.Array,  # (b,) 1.0 for real rows, 0.0 for bucket padding
+    decay: jax.Array,  # () drift forgetting factor (live rows this batch)
+    feature_edges: jax.Array,
+    score_edges: jax.Array,
+    score_args,  # pytree: the scorer's device params
+    explain_args,  # (coef (d,), background_mean (d,)) — linear-SHAP params
+    *,
+    score_fn,  # static: module-level raw score body (ops/scorer)
+    explain_k: int,  # static: reason codes per row (pre-clamped to d)
+    out_dtype=jnp.float32,  # static: d2h return wire (quickwire)
+) -> tuple[jax.Array, jax.Array, jax.Array, DriftWindow]:
+    """The lantern flush program: scores, per-row top-k SHAP reason codes,
+    AND the drift-window fold in ONE device dispatch per shape bucket.
+
+    The reference system ships explanations minutes behind the score on an
+    async worker; device-side linear SHAP measures ~3.9B values/s
+    (BENCH_r03), so the attribution belongs INSIDE the accelerator program
+    (GPUTreeShap, arXiv 2010.13972; TPU-XAI, arXiv 2103.11927). The explain
+    leg is one fused elementwise expression + a top-k over d=30 features —
+    the same ``xf`` the drift histograms already bin feeds it, so the
+    marginal device cost is bounded (bench gate: ≥0.8× the plain fused
+    flush). Attributions are bitwise the standalone ``ops/linear_shap``
+    values (shared body), and the window fold is bitwise the plain
+    ``_fused_flush``'s — enabling explanations cannot move monitoring
+    state. Returns ``(scores, reason_idx, reason_val, window)``; the
+    reason outputs ride the compressed d2h wire (uint8 indices, f16 values
+    on narrow return wires — :func:`_narrow_reasons`)."""
+    xf = x.astype(jnp.float32)
+    scores = score_fn(score_args, x).astype(jnp.float32)
+    idx, val = _topk_attributions(xf, explain_args, explain_k)
+    idx, val = _narrow_reasons(idx, val, x.shape[1], out_dtype)
+    return (
+        _narrow_scores(scores, out_dtype),
+        idx,
+        val,
+        _fold_serving_batch(
+            window, xf, scores, valid, decay, feature_edges, score_edges
+        ),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("score_fn", "score_codes", "explain_k", "out_dtype"),
+    donate_argnums=(0,),
+)
+def _fused_flush_quant_explain(
+    window: DriftWindow,
+    x: jax.Array,  # (b, d) int8 quantization codes
+    valid: jax.Array,  # (b,) 1.0 for real rows, 0.0 for bucket padding
+    decay: jax.Array,  # () drift forgetting factor (live rows this batch)
+    feature_edges: jax.Array,
+    score_edges: jax.Array,
+    score_args,  # pytree: the scorer's device params
+    dequant_scale: jax.Array,  # (d,) per-feature dequant scale
+    explain_args,  # (coef (d,), background_mean (d,)) — RAW-space SHAP params
+    *,
+    score_fn,  # static: module-level raw score body (ops/scorer)
+    score_codes: bool,  # static: score_fn consumes codes (True) or xf
+    explain_k: int,  # static: reason codes per row (pre-clamped to d)
+    out_dtype=jnp.float32,  # static: d2h return wire
+) -> tuple[jax.Array, jax.Array, jax.Array, DriftWindow]:
+    """The lantern flush on the quantized wire: fused
+    dequant·score·explain·drift in ONE dispatch.
+
+    The attribution is EXPLICIT-DEQUANT: ``xf = codes · dequant_scale`` —
+    already paid for the drift histograms — feeds the raw-space linear-SHAP
+    body, so reason codes explain the values the model actually scored
+    (the quantized lattice points), not the pre-quantization floats the
+    client sent. Versus the f32 wire the attributions therefore carry the
+    quantization error and parity is tolerance-gated, exactly like the
+    quant score parity; versus a standalone explainer over the SAME
+    dequantized rows they are bitwise."""
+    xf = x.astype(jnp.float32) * dequant_scale
+    scores = score_fn(score_args, x if score_codes else xf).astype(jnp.float32)
+    idx, val = _topk_attributions(xf, explain_args, explain_k)
+    idx, val = _narrow_reasons(idx, val, x.shape[1], out_dtype)
+    return (
+        _narrow_scores(scores, out_dtype),
+        idx,
+        val,
+        _fold_serving_batch(
+            window, xf, scores, valid, decay, feature_edges, score_edges
+        ),
     )
 
 
@@ -364,14 +509,19 @@ class DriftMonitor:
         dequant_scale=None,
         score_codes: bool = True,
         out_dtype=jnp.float32,
-    ) -> jax.Array:
+        explain_args=None,
+        explain_k: int = 0,
+    ):
         """Score one staged batch AND fold it into the drift window in ONE
         device dispatch (the fastlane hot path — ``_fused_flush``; the
         quickwire ``_fused_flush_quant`` when ``dequant_scale`` rides along
-        for a quantized wire). ``x`` and ``valid`` are already
+        for a quantized wire; the lantern ``_fused_flush_explain`` /
+        ``_fused_flush_quant_explain`` when ``explain_k > 0`` adds the
+        top-k reason-code leg). ``x`` and ``valid`` are already
         device-resident and bucket-padded; returns the device score vector
         (padded, in the ``out_dtype`` return wire; caller slices to the
-        live rows and decodes).
+        live rows and decodes) — or, with the explain leg, the
+        ``(scores, reason_idx, reason_val)`` device triple.
 
         The lock covers only {read window → dispatch → store new window}:
         dispatch is asynchronous, so the critical section is microseconds
@@ -381,7 +531,43 @@ class DriftMonitor:
         output future."""
         # graftcheck: hot-path
         decay = self._decay_for(n_live)
+        explain_k = min(int(explain_k), int(x.shape[1]))  # k ≥ d clamps to d
         with self._lock:
+            if explain_k > 0 and explain_args is not None:
+                if dequant_scale is None:
+                    scores, eidx, eval_, self.window = _fused_flush_explain(
+                        self.window,
+                        x,
+                        valid,
+                        decay,
+                        self._feature_edges,
+                        self._score_edges,
+                        score_args,
+                        explain_args,
+                        score_fn=score_fn,
+                        explain_k=explain_k,
+                        out_dtype=out_dtype,
+                    )
+                else:
+                    scores, eidx, eval_, self.window = (
+                        _fused_flush_quant_explain(
+                            self.window,
+                            x,
+                            valid,
+                            decay,
+                            self._feature_edges,
+                            self._score_edges,
+                            score_args,
+                            dequant_scale,
+                            explain_args,
+                            score_fn=score_fn,
+                            score_codes=score_codes,
+                            explain_k=explain_k,
+                            out_dtype=out_dtype,
+                        )
+                    )
+                self.rows_seen += n_live
+                return scores, eidx, eval_
             if dequant_scale is None:
                 scores, self.window = _fused_flush(
                     self.window,
@@ -411,29 +597,35 @@ class DriftMonitor:
             self.rows_seen += n_live
         return scores
 
-    def warm_fused(self, scorer, bucket: int, out_dtype=jnp.float32) -> None:
+    def warm_fused(
+        self, scorer, bucket: int, out_dtype=jnp.float32, explain_k: int = 0
+    ) -> None:
         """Pre-compile the fused flush executable for one bucket without
         touching the window: an all-padding batch (valid = 0) with decay 1.0
         (``n_live = 0``) folds exact zeros into every histogram, so the
         window state is bitwise unchanged while XLA compiles and caches the
         executable. Stages through the scorer's real staging/encode path
         and the scorer's fused spec (wire dtype, dequant scale, return
-        wire), so the warmed executable is exactly the one serving flushes
-        dispatch. Run under the compile sentinel's expected-compiles mark
-        by the micro-batcher's startup warmup."""
+        wire, explain leg when ``explain_k > 0``), so the warmed executable
+        is exactly the one serving flushes dispatch. Run under the compile
+        sentinel's expected-compiles mark by the micro-batcher's startup
+        warmup."""
         spec = scorer.fused_spec()
         slot = scorer.staging.acquire(bucket)
         try:
             slot.f32[:] = 0.0
             hx = scorer._encode_slot(slot)
             slot.valid[:] = 0.0
-            self.fused_flush(
+            out = self.fused_flush(
                 jnp.asarray(hx), jnp.asarray(slot.valid), 0,
                 spec.score_args, spec.score_fn,
                 dequant_scale=spec.dequant_scale,
                 score_codes=spec.score_codes,
                 out_dtype=out_dtype,
-            ).block_until_ready()
+                explain_args=spec.explain_args if explain_k else None,
+                explain_k=explain_k,
+            )
+            jax.block_until_ready(out)
         finally:
             scorer.staging.release(slot)
 

@@ -13,6 +13,7 @@ everywhere, vmapped so a whole batch of explanations is one device launch.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -37,6 +38,19 @@ def make_explainer(coef, intercept, background_x=None, background_mean=None):
     return LinearShapExplainer(coef, background_mean, ev)
 
 
+def _raw_linear_shap(
+    coef: jax.Array, background_mean: jax.Array, x: jax.Array
+) -> jax.Array:
+    """Un-jitted batched linear-SHAP body — the lantern fusion surface.
+
+    The fused flush programs (monitor/drift ``_fused_flush_explain`` and
+    siblings) trace THIS expression inline so the serve-time reason codes
+    are bitwise the standalone :func:`linear_shap` attributions: both paths
+    share one body, so the parity contract holds by construction rather
+    than by floating-point luck."""
+    return coef[None, :] * (x - background_mean[None, :])
+
+
 @jax.jit
 def linear_shap_single(explainer: LinearShapExplainer, x: jax.Array) -> jax.Array:
     """SHAP values (d,) for one row; Σφ + E[f] = f(x) exactly."""
@@ -46,4 +60,28 @@ def linear_shap_single(explainer: LinearShapExplainer, x: jax.Array) -> jax.Arra
 @jax.jit
 def linear_shap(explainer: LinearShapExplainer, x: jax.Array) -> jax.Array:
     """SHAP values (n, d) for a batch — one fused elementwise kernel."""
-    return explainer.coef[None, :] * (x - explainer.background_mean[None, :])
+    return _raw_linear_shap(explainer.coef, explainer.background_mean, x)
+
+
+def topk_reasons(phi: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Arg-top-k reason codes over per-feature attributions (n, d) →
+    ``(indices (n, k) int32, values (n, k))``, ranked by SIGNED attribution
+    (the features pushing the score hardest toward fraud come first).
+
+    Deterministic: ``jax.lax.top_k`` resolves ties toward the lower feature
+    index, so two runs over identical inputs emit identical reason codes —
+    the property the consistency check between the serve-time codes and the
+    worker's full-vector backfill relies on."""
+    val, idx = jax.lax.top_k(phi, k)
+    return idx.astype(jnp.int32), val
+
+
+@partial(jax.jit, static_argnames=("k",))
+def linear_shap_topk(
+    explainer: LinearShapExplainer, x: jax.Array, k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Standalone top-k reason codes — the parity reference the fused
+    score+explain flush (lantern) is gated against bitwise on the f32 wire."""
+    return topk_reasons(
+        _raw_linear_shap(explainer.coef, explainer.background_mean, x), k
+    )

@@ -98,6 +98,13 @@ class FusedSpec(NamedTuple):
     family: the dequant scale is folded into the weights — zero extra
     device compute) or the already-dequantized f32 rows (explicit dequant:
     pallas / tree families whose kernels need raw-space inputs).
+
+    ``explain_args`` (lantern) is the fused explain leg's parameter pair
+    ``(coef, background_mean)`` — the RAW-space linear-SHAP params, exactly
+    what ``models/logistic.raw_explainer`` builds — or None for a family
+    without a fused explain program (the micro-batcher then serves scores
+    fused but demotes explanations to the async worker path, loudly:
+    ``scorer_explain_fused 0`` + the ExplainUnfused alert).
     """
 
     score_fn: Callable
@@ -105,6 +112,7 @@ class FusedSpec(NamedTuple):
     dequant_scale: jax.Array | None = None
     score_codes: bool = True
     wire: str = "float32"
+    explain_args: Any = None
 
 
 #: d2h score wire formats: name → (numpy dtype, jax dtype, bytes/row).
@@ -127,6 +135,21 @@ def decode_scores_into(raw: np.ndarray, out: np.ndarray) -> np.ndarray:
     else:
         np.copyto(out, raw, casting="unsafe")
     return out
+
+
+def decode_explain_into(
+    raw_idx: np.ndarray, raw_val: np.ndarray, slot: "_StagingSlot"
+) -> tuple[np.ndarray, np.ndarray]:
+    """Decode fetched top-k reason codes (uint8/int32 indices, f16/f32
+    values — whatever the explain return wire shipped) into the slot's
+    preallocated explain buffers (lantern compressed d2h). Runs once per
+    flush in the executor thread; the slot is held (holdover) until the
+    waiters resolved their rows, then recycles — steady-state zero-alloc."""
+    slot.ensure_explain(raw_idx.shape[1])
+    # graftcheck: hot-path — decode must reuse the slot's explain buffers
+    np.copyto(slot.ei, raw_idx, casting="unsafe")
+    np.copyto(slot.ev, raw_val, casting="unsafe")
+    return slot.ei, slot.ev
 
 
 def _raw_score_linear(score_args, x: jax.Array) -> jax.Array:
@@ -165,9 +188,12 @@ class _StagingSlot:
     into ``scores`` in place, so steady-state flushes never allocate a
     fresh result array)."""
 
-    __slots__ = ("bucket", "f32", "io", "scratch", "valid", "scores")
+    __slots__ = (
+        "bucket", "f32", "io", "scratch", "valid", "scores", "ei", "ev",
+        "pool",
+    )
 
-    def __init__(self, bucket: int, n_features: int, io_dtype):
+    def __init__(self, bucket: int, n_features: int, io_dtype, pool=None):
         self.bucket = bucket
         self.f32 = np.zeros((bucket, n_features), np.float32)
         # f32 wire: encode is the identity, io aliases f32 (no second copy)
@@ -186,6 +212,26 @@ class _StagingSlot:
         self.valid = np.zeros((bucket,), np.float32)
         # return-wire decode target: f16/uint8 score codes decode here
         self.scores = np.zeros((bucket,), np.float32)
+        # lantern explain decode targets, created on first explain-enabled
+        # flush (ensure_explain) and recycled with the slot thereafter
+        self.ei: np.ndarray | None = None  # (bucket, k) int32 reason indices
+        self.ev: np.ndarray | None = None  # (bucket, k) f32 reason values
+        self.pool = pool  # owning StagingPool — explain allocations count there
+
+    def ensure_explain(self, k: int) -> None:
+        """Materialize the (bucket, k) explain decode buffers. Allocates
+        only on the first explain flush of a slot (or a k change — a
+        config knob, not a per-flush value), so the steady state draws the
+        same buffers from the pool forever. Each materialization counts in
+        the owning pool's ``allocations`` — a regression that reallocates
+        these per flush shows up in the bench/CI zero-alloc gate, exactly
+        like a fresh staging slot would."""
+        if self.ei is None or self.ei.shape[1] != k:
+            if self.pool is not None:
+                with self.pool._lock:
+                    self.pool.allocations += 1
+            self.ei = np.zeros((self.bucket, k), np.int32)
+            self.ev = np.zeros((self.bucket, k), np.float32)
 
 
 class StagingPool:
@@ -217,7 +263,7 @@ class StagingPool:
             if free:
                 return free.pop()
             self.allocations += 1
-        return _StagingSlot(bucket, self.n_features, self.io_dtype)
+        return _StagingSlot(bucket, self.n_features, self.io_dtype, pool=self)
 
     def release(self, slot: _StagingSlot) -> None:
         with self._lock:
@@ -391,6 +437,16 @@ class BatchScorer(_BucketedScorer):
         self._raw_coef = self.coef
         self.intercept = jnp.asarray(folded.intercept, dtype=jnp.float32)
         self.n_features = int(self.coef.shape[0])
+        # lantern: the fused explain leg's raw-space linear-SHAP params —
+        # the scaler-folded coef over raw inputs with the scaler mean as
+        # background (φⱼ = w′ⱼ·(xⱼ − μⱼ)), exactly what
+        # models/logistic.raw_explainer builds, so fused reason codes are
+        # bitwise the async worker's full-vector attributions
+        self._explain_mean = jnp.asarray(
+            scaler.mean if scaler is not None
+            else np.zeros(self.n_features, np.float32),
+            dtype=jnp.float32,
+        )
         self.min_bucket = min_bucket
         self.io_dtype = io_dtype
         # Wire formats for the bandwidth-bound h2d path (compute is f32 on
@@ -476,6 +532,7 @@ class BatchScorer(_BucketedScorer):
                     dequant_scale=self._dequant_scale,
                     score_codes=False,
                     wire="int8",
+                    explain_args=(self._raw_coef, self._explain_mean),
                 )
             return FusedSpec(
                 _raw_score_linear,
@@ -483,11 +540,15 @@ class BatchScorer(_BucketedScorer):
                 dequant_scale=self._dequant_scale,
                 score_codes=True,
                 wire="int8",
+                explain_args=(self._raw_coef, self._explain_mean),
             )
         fn = (
             _raw_score_linear_pallas if self._use_pallas else _raw_score_linear
         )
-        return FusedSpec(fn, (self.coef, self.intercept), wire=self.io_dtype)
+        return FusedSpec(
+            fn, (self.coef, self.intercept), wire=self.io_dtype,
+            explain_args=(self._raw_coef, self._explain_mean),
+        )
 
     def _score_padded(self, x: jax.Array, out_dtype=jnp.float32) -> jax.Array:
         # bf16/int8-IO inputs ship narrow; the f32 upcast happens inside the

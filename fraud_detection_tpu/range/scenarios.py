@@ -37,6 +37,11 @@ The suite (``run_scenario(name)``):
 ``replica_burst``         burst across replica shards while one drains;
                           p99 holds, in-flight empties cleanly, survivors
                           share the load
+``explain_under_burst``   Pareto burst with SCORER_EXPLAIN=topk fused into
+                          every flush + a shard killed mid-burst; p99
+                          holds, EVERY scored row carries its k reason
+                          codes, the kill sheds load without dropping the
+                          explain output
 ========================  ==================================================
 """
 
@@ -989,6 +994,156 @@ def scenario_replica_burst(
     return result
 
 
+def scenario_explain_under_burst(
+    seed: int = 2026, total_rows: int = 4096, n_shards: int = 3,
+    victim: int = 1, explain_k: int = 3,
+) -> ScenarioResult:
+    """Pareto burst with SCORER_EXPLAIN=topk on a shard front, a shard
+    killed mid-burst: the p99 invariant holds with the explain leg fused
+    into every flush, EVERY scored row carries its k reason codes (the
+    lantern contract — explanations at flush latency, not minutes behind),
+    and the mid-burst shard kill sheds load WITHOUT dropping the explain
+    output (a re-routed row gets its reason codes from the surviving
+    shard)."""
+    from fraud_detection_tpu.mesh.front import DEAD, ShardFront
+    from fraud_detection_tpu.service.microbatch import MicroBatcher
+
+    rm = build_model(seed=seed)
+    wt = _watchtower(rm.profile)
+    spec = CampaignSpec(
+        total_rows=total_rows, seed=seed, w_true=rm.w_true,
+        arrivals=ArrivalProcess(rate_hz=4000.0, window_s=0.01),
+    )
+    kill_armed = {"on": False}
+    injected = {"n": 0}
+    fronts: list = []
+
+    def shard_fault(shard=None, **_):
+        if kill_armed["on"] and shard == victim:
+            injected["n"] += 1
+            raise RuntimeError("range: injected shard flush failure")
+
+    def factory():
+        front = ShardFront(
+            [
+                MicroBatcher(
+                    scorer=rm.model.scorer, watchtower=wt,
+                    max_batch=512, max_wait_ms=2.0, telemetry=False,
+                    explain=True, explain_k=explain_k,
+                )
+                for _ in range(n_shards)
+            ],
+            max_consecutive_errors=3,
+        )
+        fronts.append(front)
+        return front
+
+    async def run() -> dict:
+        front = factory()
+        await front.start()
+        try:
+            traffic = CampaignTraffic(spec)
+            warm = traffic.rng.standard_normal((64, D)).astype(np.float32)
+            base_lat: list[float] = []
+            for r in warm:
+                t0 = time.perf_counter()
+                s, reasons = await front.score_ex(r)
+                base_lat.append(time.perf_counter() - t0)
+                assert reasons is not None
+            base_p99 = float(np.percentile(np.asarray(base_lat), 99))
+            lat: list[float] = []
+            n_scored = 0
+            n_with_reasons = 0
+
+            async def one(row) -> None:
+                nonlocal n_scored, n_with_reasons
+                t0 = time.perf_counter()
+                s, reasons = await front.score_ex(row)
+                lat.append(time.perf_counter() - t0)
+                n_scored += 1
+                if (
+                    reasons is not None
+                    and len(reasons[0]) == explain_k
+                    and len(reasons[1]) == explain_k
+                ):
+                    n_with_reasons += 1
+
+            batches = list(traffic.batches())
+            fire_mid = len(batches) // 2
+            for bi, batch in enumerate(batches):
+                if bi == fire_mid:
+                    kill_armed["on"] = True  # the victim dies under load
+                await asyncio.gather(*(one(r) for r in batch.rows))
+                await asyncio.sleep(traffic.spec.arrivals.window_s)
+            return {
+                "baseline_p99_s": base_p99,
+                "latencies_s": lat,
+                "rows_scored": n_scored,
+                "rows_with_reasons": n_with_reasons,
+            }
+        finally:
+            await front.stop()
+
+    plan = faults.FaultPlan().call("mesh.shard_flush", shard_fault, times=-1)
+    result = ScenarioResult("explain_under_burst")
+    try:
+        with plan.armed():
+            out = asyncio.run(run())
+    finally:
+        wt.close()
+    front = fronts[0]
+    status = front.status()
+    result.metrics = {
+        "rows": total_rows,
+        "rows_scored": out["rows_scored"],
+        "rows_with_reasons": out["rows_with_reasons"],
+        "explain_k": explain_k,
+        "shards": n_shards,
+        "victim": victim,
+        "victim_state": status["per_shard"][victim]["state"],
+        "failures_injected": injected["n"],
+        "baseline_p99_ms": round(out["baseline_p99_s"] * 1e3, 3),
+        "burst_p99_ms": round(
+            float(np.percentile(out["latencies_s"], 99)) * 1e3, 3
+        ),
+    }
+    result.add(
+        p99_within(
+            out["latencies_s"], out["baseline_p99_s"],
+            factor=10.0, absolute_floor_s=0.25,
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "all-rows-scored",
+            out["rows_scored"] == total_rows,
+            f"{out['rows_scored']}/{total_rows} rows returned a score "
+            "with the explain leg fused and a shard dying mid-burst",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "reasons-on-every-row",
+            out["rows_with_reasons"] == total_rows,
+            f"{out['rows_with_reasons']}/{total_rows} rows carried their "
+            f"{explain_k} reason codes — the lantern contract is every "
+            "scored row, including rows re-routed off the dead shard",
+        )
+    )
+    result.add(
+        InvariantOutcome(
+            "shard-killed-and-shed",
+            status["per_shard"][victim]["state"] == DEAD
+            and injected["n"] > 0,
+            f"victim shard {victim} ended "
+            f"{status['per_shard'][victim]['state']!r} after "
+            f"{injected['n']} injected failure(s); load shed without "
+            "dropping explain output",
+        )
+    )
+    return result
+
+
 # -- registry ----------------------------------------------------------------
 
 SCENARIOS = {
@@ -1000,6 +1155,7 @@ SCENARIOS = {
     "hot_swap": scenario_hot_swap,
     "shard_kill_mid_swap": scenario_shard_kill_mid_swap,
     "replica_burst": scenario_replica_burst,
+    "explain_under_burst": scenario_explain_under_burst,
 }
 
 #: scenarios that need a scratch directory as their first argument

@@ -414,12 +414,22 @@ def create_app(
             if state["batcher"].telemetry
             else None
         )
+        # lantern: with SCORER_EXPLAIN=topk the same dispatch that scores
+        # the row also emits its top-k reason codes (score_ex); reasons is
+        # None when the served family demoted (scorer_explain_fused 0)
+        explain_on = bool(getattr(state["batcher"], "explain", False))
+        reasons = None
         with span("predict", correlation_id=corr_id):
             with metrics.timed(metrics.inference_duration):
                 try:
-                    score = await state["batcher"].score(
-                        row, timeline=timeline
-                    )
+                    if explain_on:
+                        score, reasons = await state["batcher"].score_ex(
+                            row, timeline=timeline
+                        )
+                    else:
+                        score = await state["batcher"].score(
+                            row, timeline=timeline
+                        )
                 except NoHealthyShards as e:
                     # every switchyard shard dead/draining: a known,
                     # retryable capacity outage — same 503 + Retry-After
@@ -440,6 +450,21 @@ def create_app(
             # the task args so the worker's compute_shap span links back
             traceparent = tracing.current_traceparent()
         prediction = int(score >= 0.5)
+        reason_codes = None
+        serve_topk = None
+        if reasons is not None:
+            idxs, vals = reasons
+            names = model.feature_names
+            reason_codes = [
+                {"feature": names[int(i)], "attribution": float(v)}
+                for i, v in zip(idxs, vals)
+            ]
+            # the serve-time top-k rides the task payload so the worker's
+            # full-vector backfill can consistency-check the fused leg
+            serve_topk = {
+                "indices": [int(i) for i in idxs],
+                "values": [float(v) for v in vals],
+            }
 
         # Persist the PENDING row and enqueue the async explanation.
         feature_dict = dict(zip(model.feature_names, row.tolist()))
@@ -448,13 +473,19 @@ def create_app(
         # The store clients are synchronous with a multi-second retry budget
         # (sized to ride through a sentinel failover); run them off-loop so
         # an outage stalls only this request, never /health or scoring.
+        # the serve-time top-k rides as an optional 5th task arg ONLY when
+        # the fused explain leg produced one: explain-off deployments keep
+        # the 4-arg payload, so a not-yet-upgraded worker (4-arg
+        # compute_shap) keeps draining the queue through a rolling deploy
+        task_args = [tx_id, feature_dict, corr_id, traceparent]
+        if serve_topk is not None:
+            task_args.append(serve_topk)
+
         def _persist_and_enqueue():
             with metrics.timed(metrics.db_latency):
                 state["db"].create_pending(tx_id, feature_dict, corr_id)
             state["broker"].send_task(
-                TASK_NAME,
-                [tx_id, feature_dict, corr_id, traceparent],
-                correlation_id=corr_id,
+                TASK_NAME, task_args, correlation_id=corr_id
             )
 
         try:
@@ -471,6 +502,7 @@ def create_app(
                 transaction_id=tx_id,
                 correlation_id=corr_id,
                 explanation_status=explanation_status,
+                reason_codes=reason_codes,
             ).model_dump()
         )
 

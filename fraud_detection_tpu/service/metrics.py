@@ -63,6 +63,14 @@ xai_task_success = Counter(
 xai_task_failures = Counter(
     "xai_task_failures", "Failed XAI tasks", registry=registry
 )
+xai_explain_consistency_failures = Counter(
+    "xai_explain_consistency_failures",
+    "Worker full-vector SHAP backfills that disagreed with the serve-time "
+    "top-k reason codes riding the task payload (lantern consistency "
+    "check) — nonzero means the fused explain leg and the async explainer "
+    "have drifted apart (stale swap, wire corruption)",
+    registry=registry,
+)
 queue_depth = Gauge(
     "xai_queue_depth", "Queued XAI tasks (KEDA scaling signal)", registry=registry
 )
@@ -124,6 +132,21 @@ scorer_wire_fused = Gauge(
     "0 when the wire format opted out of fusion and flushes silently "
     "demoted to the split two-dispatch path (WireFormatUnfused alert "
     "input — a config change must never quietly double device dispatches)",
+    registry=registry,
+)
+scorer_explain_fused = Gauge(
+    "scorer_explain_fused",
+    "1 while serve-time reason codes (SCORER_EXPLAIN=topk) ride the fused "
+    "single-dispatch flush; 0 when the active wire/model family has no "
+    "fused explain program and explanations silently demote to the async "
+    "worker path (ExplainUnfused alert input — the lantern counterpart of "
+    "scorer_wire_fused). Stays 1 when explanation is off or unrequested",
+    registry=registry,
+)
+scorer_explained_rows = Counter(
+    "scorer_explained_rows",
+    "Scored rows whose response carried fused top-k reason codes (the "
+    "lantern serve-time explain output)",
     registry=registry,
 )
 scorer_queue_depth = Gauge(

@@ -60,7 +60,12 @@ import numpy as np
 
 from fraud_detection_tpu import config
 from fraud_detection_tpu.ops import scorer as scorer_mod
-from fraud_detection_tpu.ops.scorer import BatchScorer, _bucket, decode_scores_into
+from fraud_detection_tpu.ops.scorer import (
+    BatchScorer,
+    _bucket,
+    decode_explain_into,
+    decode_scores_into,
+)
 from fraud_detection_tpu.range.faults import fire
 from fraud_detection_tpu.service import metrics, tracing
 from fraud_detection_tpu.telemetry.timeline import STAGES, FlushInfo
@@ -94,6 +99,8 @@ class MicroBatcher:
         fused: bool | None = None,
         adaptive_wait: bool | None = None,
         return_wire: str | None = None,
+        explain: bool | None = None,
+        explain_k: int | None = None,
     ):
         # Either a fixed scorer (offline tools, tests) or a lifecycle
         # ModelSlot (serving): with a slot, every flush re-reads the slot's
@@ -139,6 +146,29 @@ class MicroBatcher:
         # would page WireFormatUnfused on every such process).
         self._wire_fused: bool | None = None
         metrics.scorer_wire_fused.set(1)
+        # lantern: serve-time top-k reason codes riding the fused flush.
+        # SCORER_EXPLAIN=topk turns the fused program into the three-output
+        # lantern variant; SCORER_EXPLAIN_K picks k (clamped to the feature
+        # count per flush). Same gauge discipline as the wire: starts at 1
+        # (nothing demoted) so explain-off deployments never read as a
+        # demotion.
+        if explain is None:
+            mode = config.scorer_explain()
+            if mode not in ("off", "topk"):
+                raise ValueError(
+                    f"SCORER_EXPLAIN must be off|topk, got {mode!r}"
+                )
+            explain = mode == "topk"
+        self.explain = explain
+        self.explain_k = (
+            explain_k if explain_k is not None else config.scorer_explain_k()
+        )
+        if self.explain and self.explain_k < 1:
+            raise ValueError(
+                f"SCORER_EXPLAIN_K must be >= 1, got {self.explain_k}"
+            )
+        self._explain_fused: bool | None = None
+        metrics.scorer_explain_fused.set(1)
         self.adaptive_wait = (
             adaptive_wait
             if adaptive_wait is not None
@@ -193,14 +223,25 @@ class MicroBatcher:
                 with expected_compiles():
                     scorer.warmup(top)
                     target = self._fused_target(scorer)
-                    if target is not None:
-                        drift = target[0]
+                    if target is None:
+                        if self.explain:
+                            # explanations need the fused program; without
+                            # one the demotion is latched at STARTUP, not
+                            # at first traffic
+                            self._note_explain_fused(False, scorer)
+                    else:
+                        drift, spec = target
+                        # resolves (and logs, once, at startup) whether the
+                        # family carries a fused explain leg
+                        k = self._explain_k_for(spec, scorer)
                         b = scorer.min_bucket
                         while b <= top:
-                            # warm with the serving return wire so the
-                            # ladder compiles the exact flush executables
+                            # warm with the serving return wire + explain
+                            # leg so the ladder compiles the exact flush
+                            # executables serving will dispatch
                             drift.warm_fused(
-                                scorer, b, out_dtype=self._out_jdtype
+                                scorer, b, out_dtype=self._out_jdtype,
+                                explain_k=k,
                             )
                             b *= 2
 
@@ -227,14 +268,29 @@ class MicroBatcher:
             if not fut.done():
                 fut.set_exception(RuntimeError("scorer shutting down"))
 
+    async def _submit(self, row: np.ndarray, timeline=None):
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((row, fut, timeline))
+        return await fut
+
     async def score(self, row: np.ndarray, timeline=None) -> float:
         """Submit one feature row; returns P(fraud). ``timeline`` (a
         RequestTimeline) rides along and is stamped at every stage
         boundary — pass one to get the request into the stage histograms,
         child spans, and the flight recorder."""
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((row, fut, timeline))
-        return await fut
+        res = await self._submit(row, timeline)
+        return res[0] if isinstance(res, tuple) else res
+
+    async def score_ex(self, row: np.ndarray, timeline=None):
+        """Submit one feature row; returns ``(P(fraud), reasons)`` where
+        ``reasons`` is ``(indices, values)`` — the lantern top-k reason
+        codes computed in the SAME device dispatch as the score — or None
+        when this flush carried no fused explain leg (SCORER_EXPLAIN off,
+        or the family demoted)."""
+        res = await self._submit(row, timeline)
+        if isinstance(res, tuple):
+            return res[0], (res[1], res[2])
+        return res, None
 
     @staticmethod
     def _stamp_collected(item: tuple) -> tuple:
@@ -354,6 +410,44 @@ class MicroBatcher:
         else:
             log.info("wire format runs the fused single-dispatch flush")
 
+    def _note_explain_fused(self, fused: bool, scorer) -> None:
+        """Export + (on transition) log whether serve-time reason codes
+        ride the fused flush. A family/wire combo without a fused explain
+        program silently shipping scores WITHOUT their reason codes is the
+        quickwire lesson all over again — the demotion must be loud: logged
+        once at startup/transition, latched on ``scorer_explain_fused``
+        (the ExplainUnfused alert input)."""
+        if fused == self._explain_fused:
+            return
+        self._explain_fused = fused
+        metrics.scorer_explain_fused.set(1 if fused else 0)
+        if not fused:
+            log.warning(
+                "SCORER_EXPLAIN=topk but scorer %r has no fused explain "
+                "program: responses ship WITHOUT serve-time reason codes "
+                "(the async worker backfill still explains). "
+                "scorer_explain_fused=0 exported — see the ExplainUnfused "
+                "alert",
+                getattr(scorer, "io_dtype", type(scorer).__name__),
+            )
+        else:
+            log.info(
+                "serve-time reason codes ride the fused flush (k=%d)",
+                self.explain_k,
+            )
+
+    def _explain_k_for(self, spec, scorer) -> int:
+        """The explain leg's k for this flush: 0 when explanation is off or
+        the spec carries no fused explain params (demotion, noted loudly),
+        else SCORER_EXPLAIN_K clamped to the feature count."""
+        if not self.explain:
+            return 0
+        if getattr(spec, "explain_args", None) is None:
+            self._note_explain_fused(False, scorer)
+            return 0
+        self._note_explain_fused(True, scorer)
+        return min(self.explain_k, getattr(scorer, "n_features", self.explain_k))
+
     def _fused_target(self, scorer):
         """(drift_monitor, fused_spec) when this flush can run the
         single-dispatch fused program, else None — re-resolved per flush
@@ -389,14 +483,17 @@ class MicroBatcher:
         - split: the scoring dispatch alone (the watchtower ingest thread
           pays the second, split-path dispatch afterwards); f32 returns.
 
-        Returns (probs, t_flush_start, t_padded, t_synced, t_fetched,
-        device_calls, monitor_rows, monitor_scores, holdover).
+        Returns (probs, explain_out, t_flush_start, t_padded, t_synced,
+        t_fetched, device_calls, monitor_rows, monitor_scores, holdover).
         ``monitor_rows``/``monitor_scores`` are stable copies for the
         watchtower when it still needs them (split drift update, or shadow
-        sampling), else None. ``holdover`` is the staging slot when
-        ``probs`` is a view into its decode buffer (narrow return wire) —
-        the caller must release it AFTER resolving the waiters; on the f32
-        return wire the slot is recycled here and ``holdover`` is None.
+        sampling), else None. ``explain_out`` is the ``(indices, values)``
+        reason-code matrices (views into the slot's explain buffers, live
+        rows only) when the lantern leg rode this flush, else None.
+        ``holdover`` is the staging slot when ``probs`` or ``explain_out``
+        is a view into its decode buffers (narrow return wire / explain) —
+        the caller must release it AFTER resolving the waiters; otherwise
+        the slot is recycled here and ``holdover`` is None.
 
         Note: on tunneled PJRT platforms ``block_until_ready`` can report
         early (see bench.py `_window_barrier`); there the residue shows up
@@ -404,6 +501,7 @@ class MicroBatcher:
         """
         # graftcheck: hot-path — steady-state flushes must not allocate
         # fresh batch arrays (bench.py microbatch_flush asserts this)
+        import jax
         import jax.numpy as jnp
 
         # fraud-range injection point: a chaos plan adds device-latency or
@@ -415,34 +513,47 @@ class MicroBatcher:
         slot = staging.acquire(_bucket(n, scorer.min_bucket))
         holdover = None
         handed_over = False
+        explain_out = None
         try:
             with annotate("microbatch-score"):
                 t_flush_start = time.perf_counter()
                 hx = scorer.stage_rows(slot, [r for r, _, _ in batch])
                 t_padded = time.perf_counter()
+                explain_k = 0
                 if target is not None:
                     drift, spec = target
+                    explain_k = self._explain_k_for(spec, scorer)
                     out = drift.fused_flush(
                         jnp.asarray(hx), jnp.asarray(slot.valid), n,
                         spec.score_args, spec.score_fn,
                         dequant_scale=spec.dequant_scale,
                         score_codes=spec.score_codes,
                         out_dtype=self._out_jdtype,
+                        explain_args=spec.explain_args if explain_k else None,
+                        explain_k=explain_k,
                     )
                     device_calls = 1
                     need_rows = getattr(
                         self.watchtower, "wants_rows", lambda: True
                     )()
                 else:
+                    if self.explain:
+                        # no fused program at all (solo/split) → reason
+                        # codes cannot ride the flush; latch the demotion
+                        self._note_explain_fused(False, scorer)
                     out = scorer._score_padded(jnp.asarray(hx))
                     # the ingest thread will issue the drift-window dispatch
                     # for this batch — the split path's second device call
                     device_calls = 2 if self.watchtower is not None else 1
                     need_rows = self.watchtower is not None
                 if telemetry:
-                    out.block_until_ready()
+                    jax.block_until_ready(out)
                 t_synced = time.perf_counter()
-                raw = np.asarray(out)  # the d2h fetch (narrow on quickwire)
+                if explain_k:
+                    score_dev, eidx_dev, eval_dev = out
+                else:
+                    score_dev = out
+                raw = np.asarray(score_dev)  # the d2h fetch (narrow on quickwire)
                 if target is not None and raw.dtype != np.float32:
                     # decode the return wire in place: the slot's scores
                     # buffer is the only f32 materialization, so the slot
@@ -451,6 +562,15 @@ class MicroBatcher:
                     holdover = slot
                 else:
                     probs = raw[:n]
+                if explain_k:
+                    # reason codes decode into the slot's preallocated
+                    # explain buffers — same holdover discipline as the
+                    # narrow score wire (the waiters read rows out of them)
+                    ei, ev = decode_explain_into(
+                        np.asarray(eidx_dev), np.asarray(eval_dev), slot
+                    )
+                    explain_out = (ei[:n], ev[:n])
+                    holdover = slot
                 t_fetched = time.perf_counter()
                 monitor_rows = slot.f32[:n].copy() if need_rows else None
                 if not need_rows:
@@ -463,13 +583,14 @@ class MicroBatcher:
         finally:
             # after the score fetch the device has consumed the staged
             # bytes, so the slot is safe to recycle — unless the decoded
-            # scores still live in it (narrow return wire, handed to the
-            # caller to release after the waiters resolve). A failure
-            # between decode and return releases it here either way.
+            # scores/reason codes still live in it (narrow return wire or
+            # explain leg, handed to the caller to release after the
+            # waiters resolve). A failure between decode and return
+            # releases it here either way.
             if not handed_over:
                 staging.release(slot)
         return (
-            probs, t_flush_start, t_padded, t_synced, t_fetched,
+            probs, explain_out, t_flush_start, t_padded, t_synced, t_fetched,
             device_calls, monitor_rows, monitor_scores, holdover,
         )
 
@@ -492,18 +613,25 @@ class MicroBatcher:
             else:
                 scorer, source, version = self.scorer, None, None
             loop = asyncio.get_running_loop()
+            explain_out = None
             if hasattr(scorer, "stage_rows") and hasattr(scorer, "_score_padded"):
                 target = self._fused_target(scorer)
                 fused = target is not None
                 (
-                    probs, t_flush, t_padded, t_synced, t_fetched,
-                    device_calls, monitor_rows, monitor_scores, holdover,
+                    probs, explain_out, t_flush, t_padded, t_synced,
+                    t_fetched, device_calls, monitor_rows, monitor_scores,
+                    holdover,
                 ) = await loop.run_in_executor(
                     None, self._flush_device, scorer, target, batch, telemetry
                 )
             else:
                 # Legacy scorers (test doubles, exotic models) without the
                 # staging protocol: opaque predict_proba, no decomposition.
+                if self.explain:
+                    # no fused program possible → reason codes cannot ride;
+                    # the demotion must latch here too (the quickwire
+                    # silent-demotion lesson)
+                    self._note_explain_fused(False, scorer)
                 rows = np.stack([r for r, _, _ in batch])
 
                 def _score() -> np.ndarray:
@@ -515,6 +643,8 @@ class MicroBatcher:
                 device_calls = 2 if self.watchtower is not None else 1
                 monitor_rows = rows
                 monitor_scores = probs
+            if explain_out is not None:
+                metrics.scorer_explained_rows.inc(len(batch))
             metrics.scorer_device_calls_per_flush.set(device_calls)
             metrics.scorer_flushes.labels(
                 "fused" if fused
@@ -538,20 +668,35 @@ class MicroBatcher:
                 bucket=_bucket(n, scorer.min_bucket),
                 model_version=version, model_source=source, drift=drift_flag,
             )
+        if explain_out is not None:
+            # materialize each row's reason codes at resolve time (the
+            # slot's explain buffers recycle once the holdover releases
+            # below, and waiters read their results on a later loop turn)
+            eidx, evals = explain_out
+            results = [
+                (float(p), eidx[j].tolist(), evals[j].tolist())
+                for j, p in enumerate(probs)
+            ]
+        else:
+            results = None
         if fi is not None and tracing._tracer is not None:
             # Link rows to the flush ONLY when a tracer will read the
             # timelines back (emit_stage_spans): one ref per row is ~60ns
             # and the telemetry budget lives and dies on this loop — the
             # flight recorder gets the FlushInfo through its entry instead.
-            for (_, f, tl), p in zip(batch, probs):
+            for j, ((_, f, tl), p) in enumerate(zip(batch, probs)):
                 if not f.done():
-                    f.set_result(float(p))
+                    f.set_result(
+                        results[j] if results is not None else float(p)
+                    )
                 if tl is not None:
                     tl.flush = fi
         else:
-            for (_, f, _), p in zip(batch, probs):
+            for j, ((_, f, _), p) in enumerate(zip(batch, probs)):
                 if not f.done():
-                    f.set_result(float(p))
+                    f.set_result(
+                        results[j] if results is not None else float(p)
+                    )
         if holdover is not None:
             # narrow return wire: the waiters read their floats out of the
             # slot's decode buffer above — now it can recycle

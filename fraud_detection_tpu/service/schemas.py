@@ -19,12 +19,25 @@ class TransactionIn(BaseModel):
     )
 
 
+class ReasonCodeOut(BaseModel):
+    """One serve-time reason code (lantern): the feature and its exact
+    interventional linear-SHAP attribution toward the fraud score, computed
+    in the same device dispatch that produced the score."""
+
+    feature: str
+    attribution: float
+
+
 class PredictionOut(BaseModel):
     prediction: int
     score: float
     transaction_id: str
     correlation_id: str
     explanation_status: str
+    #: top-k reason codes, highest attribution first — present when
+    #: SCORER_EXPLAIN=topk and the served family runs the fused explain
+    #: leg; null otherwise (the async /explain readback always works)
+    reason_codes: list[ReasonCodeOut] | None = None
 
 
 class ExplanationOut(BaseModel):

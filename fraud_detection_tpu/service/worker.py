@@ -73,17 +73,65 @@ class XaiWorker:
         log.info("worker %s up; model from %s", self.worker_id, source)
 
     # -- task bodies -------------------------------------------------------
+    #: tolerance of the serve-time vs backfill attribution comparison: must
+    #: cover the int8 wire's quantization error (the fused leg attributes
+    #: the dequantized lattice values the model actually scored) — same
+    #: order as the quickwire score-parity gate.
+    EXPLAIN_CONSISTENCY_ATOL = 5e-2
+
+    def _check_explain_consistency(
+        self, phi, serve_topk, correlation_id, transaction_id
+    ) -> bool:
+        """Lantern consistency check: the serve-time top-k reason codes
+        riding the task payload must agree with this full-vector backfill.
+        Value-based (the serve indices' attributions re-derived here within
+        tolerance, and the serve top-1 within tolerance of the true max):
+        strict index equality would false-alarm on near-ties across the
+        quantized wire. A mismatch counts + warns — the fused explain leg
+        and the async explainer drifting apart is a deployment bug
+        (stale swap, wire corruption), not a rounding story."""
+        if not isinstance(serve_topk, dict):
+            return True
+        try:
+            idxs = [int(i) for i in serve_topk.get("indices") or []]
+            vals = np.asarray(serve_topk.get("values") or [], np.float64)
+        except (TypeError, ValueError):
+            idxs, vals = [], np.zeros(0)
+        phi = np.asarray(phi, np.float64).reshape(-1)
+        if not idxs or len(idxs) != vals.shape[0] or max(idxs) >= phi.shape[0]:
+            return True  # malformed/absent payload: nothing to check
+        atol = self.EXPLAIN_CONSISTENCY_ATOL
+        ok = bool(
+            np.all(np.abs(phi[idxs] - vals) <= atol)
+            and abs(float(phi.max()) - float(vals[0])) <= atol
+        )
+        if not ok:
+            metrics.xai_explain_consistency_failures.inc()
+            log.warning(
+                "[%s] serve-time reason codes disagree with the backfill "
+                "for %s: serve %s=%s vs recomputed %s (fused explain leg "
+                "and worker explainer out of sync?)",
+                correlation_id, transaction_id, idxs,
+                np.round(vals, 4).tolist(),
+                np.round(phi[idxs], 4).tolist(),
+            )
+        return ok
+
     def compute_shap(
         self,
         transaction_id: str,
         input_data: dict,
         correlation_id: str | None,
         traceparent: str | None = None,
+        serve_topk: dict | None = None,
     ) -> None:
         # ``traceparent`` is the optional 4th task arg (W3C header string
         # captured inside the API's predict span): it links this worker
-        # span to the originating request's trace. Tasks enqueued by older
-        # producers carry 3 args and still work.
+        # span to the originating request's trace. ``serve_topk`` is the
+        # optional 5th arg (lantern): the top-k reason codes the fused
+        # serving flush shipped with the score, consistency-checked against
+        # this full-vector backfill. Tasks enqueued by older producers
+        # carry 3 or 4 args and still work.
         with span(
             "compute_shap",
             traceparent=traceparent,
@@ -92,6 +140,9 @@ class XaiWorker:
             row = self.model.prepare_row(input_data)
             score = float(self.model.scorer.predict_proba(row[None, :])[0])
             phi, expected_value = self.model.explain_one(row)
+            self._check_explain_consistency(
+                phi, serve_topk, correlation_id, transaction_id
+            )
             shap_values = dict(zip(self.model.feature_names, phi.astype(float)))
             self.db.complete(
                 transaction_id,
@@ -249,13 +300,18 @@ class XaiWorker:
             scorer.staging.release(slot)
         names = self.model.feature_names
         for (t, _), score, phi in zip(prepared, scores, phis):
-            tx_id, _, corr_id, traceparent = (t.args + [None] * 4)[:4]
+            tx_id, _, corr_id, traceparent, serve_topk = (
+                t.args + [None] * 5
+            )[:5]
             try:
                 with span(
                     "compute_shap",
                     traceparent=traceparent,
                     correlation_id=corr_id or "",
                 ):
+                    self._check_explain_consistency(
+                        phi, serve_topk, corr_id, tx_id
+                    )
                     self.db.complete(
                         tx_id,
                         dict(zip(names, phi.astype(float))),

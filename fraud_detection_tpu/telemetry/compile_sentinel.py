@@ -69,11 +69,19 @@ WRAP_TARGETS: dict[str, list[tuple[str, str]]] = {
     "quickwire.flush": [
         ("fraud_detection_tpu.monitor.drift", "_fused_flush_quant")
     ],
+    "lantern.flush": [
+        ("fraud_detection_tpu.monitor.drift", "_fused_flush_explain"),
+        ("fraud_detection_tpu.monitor.drift", "_fused_flush_quant_explain"),
+    ],
     "mesh.sharded_flush": [
         ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush")
     ],
     "mesh.quickwire_flush": [
         ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush_quant")
+    ],
+    "mesh.lantern_flush": [
+        ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush_explain"),
+        ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush_quant_explain"),
     ],
     "mesh.sharded_update": [
         ("fraud_detection_tpu.mesh.retrain", "_sharded_update_epoch")

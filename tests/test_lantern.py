@@ -1,0 +1,716 @@
+"""Lantern acceptance tests (ISSUE 9): explain-at-serve — fused score+SHAP
+reason codes in the single-dispatch flush.
+
+The fused flush's opt-in third output (per-row arg-top-k of per-feature
+linear-SHAP attributions) bitwise-matches the standalone ``ops/linear_shap``
+explainer on the f32 wire (tolerance-gated on the int8 wire, where the
+attributions explain the dequantized lattice values the model actually
+scored), runs as ONE donated dispatch per flush on every wire and on the
+N-shard mesh (bitwise vs single-device), rides the compressed-d2h staging
+path with zero steady-state allocations, clamps k to the feature count,
+breaks ties deterministically, leaves the drift window bitwise untouched on
+warmup, rebinds on hot swap with zero recompiles, and demotes LOUDLY
+(log + ``scorer_explain_fused 0``) when the served family has no fused
+explain program. The worker's full-vector backfill consistency-checks the
+serve-time top-k riding the task payload.
+"""
+
+import asyncio
+import logging
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fraud_detection_tpu.monitor.baseline import build_baseline_profile
+from fraud_detection_tpu.monitor.drift import DriftMonitor
+from fraud_detection_tpu.monitor.watchtower import Thresholds, Watchtower
+from fraud_detection_tpu.ops.linear_shap import (
+    linear_shap,
+    linear_shap_topk,
+    make_explainer,
+)
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import ScalerParams, scaler_fit
+from fraud_detection_tpu.ops.scorer import (
+    BatchScorer,
+    _bucket,
+    decode_explain_into,
+)
+from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.service.microbatch import MicroBatcher
+
+D = 30
+K = 3
+THR = Thresholds(psi=0.2, ks=0.15, ece=0.1, disagree=0.05, min_rows=64)
+
+#: attribution tolerance of the int8 wire vs f32 (the explain leg
+#: attributes the dequantized lattice values — same error family as the
+#: quickwire score parity gate).
+QUANT_PHI_ATOL = 5e-2
+
+
+def _params(seed: int = 0, shift: float = 0.0) -> LogisticParams:
+    rng = np.random.default_rng(seed)
+    return LogisticParams(
+        coef=rng.standard_normal(D).astype(np.float32) * 0.3 + shift,
+        intercept=np.float32(-1.0),
+    )
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    return (rng.standard_normal((4096, D)) * 2.0 + 0.5).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def scaler(data):
+    return scaler_fit(data)
+
+
+@pytest.fixture(scope="module")
+def profile(data, scaler):
+    scorer = BatchScorer(_params(), scaler)
+    return build_baseline_profile(
+        data, scorer.predict_proba(data),
+        feature_names=[f"f{i}" for i in range(D)],
+    )
+
+
+def _reference_explainer(scorer):
+    """Standalone explainer over the scorer's fused explain params — the
+    same (coef, background_mean) pair models/logistic.raw_explainer builds."""
+    spec = scorer.fused_spec()
+    coef, mean = spec.explain_args
+    return make_explainer(np.asarray(coef), 0.0, background_mean=np.asarray(mean))
+
+
+def _explain_once(scorer, monitor, batch_rows, k=K, out_dtype=jnp.float32):
+    """One fused score+explain flush through the real staging path; returns
+    (scores, idx (n,k) int32, val (n,k) f32) decoded host-side."""
+    n = len(batch_rows)
+    spec = scorer.fused_spec()
+    slot = scorer.staging.acquire(_bucket(n, scorer.min_bucket))
+    try:
+        hx = scorer.stage_rows(slot, list(batch_rows))
+        s, ei, ev = monitor.fused_flush(
+            jnp.asarray(hx), jnp.asarray(slot.valid), n,
+            spec.score_args, spec.score_fn,
+            dequant_scale=spec.dequant_scale, score_codes=spec.score_codes,
+            out_dtype=out_dtype,
+            explain_args=spec.explain_args, explain_k=k,
+        )
+        ei, ev = decode_explain_into(np.asarray(ei), np.asarray(ev), slot)
+        return np.asarray(s)[:n], ei[:n].copy(), ev[:n].copy()
+    finally:
+        scorer.staging.release(slot)
+
+
+# -- top-k correctness -------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 7, 64, 700])
+def test_fused_topk_bitwise_matches_standalone(data, scaler, profile, n):
+    """Fused reason codes (indices AND values) are bitwise the standalone
+    linear_shap top-k on the f32 wire — the lantern parity contract."""
+    scorer = BatchScorer(_params(), scaler)
+    mon = DriftMonitor(profile)
+    batch = data[:n]
+    scores, idx, val = _explain_once(scorer, mon, [batch[i] for i in range(n)])
+    ref_idx, ref_val = linear_shap_topk(
+        _reference_explainer(scorer), jnp.asarray(batch), K
+    )
+    assert np.array_equal(idx, np.asarray(ref_idx))
+    assert np.array_equal(
+        val.view(np.uint32), np.asarray(ref_val).view(np.uint32)
+    ), "fused attribution values diverge from standalone linear_shap"
+    # and the scores themselves stayed the fused-flush scores
+    ref_scores = scorer.predict_proba(batch)
+    assert np.array_equal(
+        np.asarray(scores, np.float32).view(np.uint32),
+        ref_scores.view(np.uint32),
+    )
+
+
+def test_fused_topk_matches_worker_explainer(data, scaler):
+    """The fused explain params are EXACTLY the async worker's raw
+    explainer: per-row top-k of model.explain_batch equals the fused output
+    bitwise — the consistency check the task payload rides on."""
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+
+    model = FraudLogisticModel(
+        _params(), scaler, [f"f{i}" for i in range(D)], io_dtype="float32"
+    )
+    batch = data[:32]
+    phi, _ = model.explain_batch(batch)
+    spec = model.scorer.fused_spec()
+    coef, mean = np.asarray(spec.explain_args[0]), np.asarray(spec.explain_args[1])
+    fused_phi = coef[None, :] * (batch - mean[None, :])
+    assert np.array_equal(
+        phi.astype(np.float32).view(np.uint32),
+        fused_phi.astype(np.float32).view(np.uint32),
+    )
+
+
+def test_tie_breaking_is_deterministic(profile, scaler):
+    """Equal attributions resolve toward the LOWER feature index, stably
+    across runs — reason codes must never flap between equally-guilty
+    features."""
+    # identity scaler → folded coef = raw coef; craft exact ties
+    ident = ScalerParams(
+        mean=np.zeros(D, np.float32), scale=np.ones(D, np.float32),
+        var=np.ones(D, np.float32), n_samples=np.float32(1),
+    )
+    scorer = BatchScorer(
+        LogisticParams(
+            coef=np.ones(D, np.float32), intercept=np.float32(0.0)
+        ),
+        ident,
+    )
+    row = np.zeros(D, np.float32)
+    row[[4, 9, 20]] = 2.0  # three exactly-equal top attributions
+    mon = DriftMonitor(profile)
+    _, idx_a, val_a = _explain_once(scorer, mon, [row])
+    _, idx_b, val_b = _explain_once(scorer, mon, [row])
+    assert idx_a[0].tolist() == [4, 9, 20], (
+        "ties must prefer the lower feature index"
+    )
+    assert np.array_equal(idx_a, idx_b)
+    assert np.array_equal(val_a.view(np.uint32), val_b.view(np.uint32))
+
+
+def test_k_clamps_to_n_features(data, scaler, profile):
+    """k ≥ d clamps to d and returns every feature, ranked — no crash, no
+    garbage columns."""
+    scorer = BatchScorer(_params(), scaler)
+    mon = DriftMonitor(profile)
+    _, idx, val = _explain_once(scorer, mon, [data[0], data[1]], k=D + 34)
+    assert idx.shape == (2, D) and val.shape == (2, D)
+    # every feature exactly once per row, values sorted descending
+    for r in range(2):
+        assert sorted(idx[r].tolist()) == list(range(D))
+        assert np.all(np.diff(val[r]) <= 0)
+
+
+def test_explain_warmup_leaves_window_bitwise_unchanged(data, scaler, profile):
+    """warm_fused with the explain leg compiles through an all-padding
+    batch: drift-window state must stay bitwise identical."""
+    scorer = BatchScorer(_params(), scaler)
+    mon = DriftMonitor(profile)
+    mon.update(data[:100], scorer.predict_proba(data[:100]))
+    before = {
+        f: np.asarray(getattr(mon.window, f)).copy()
+        for f in mon.window._fields
+    }
+    rows_before = mon.rows_seen
+    mon.warm_fused(scorer, 64, explain_k=K)
+    for f, a in before.items():
+        b = np.asarray(getattr(mon.window, f))
+        assert np.array_equal(a, b), f"explain warmup disturbed {f}"
+    assert mon.rows_seen == rows_before
+
+
+def test_explain_leg_does_not_move_the_window(data, scaler, profile):
+    """Identical traffic through the plain fused flush and the explain
+    flush ends in bitwise-identical windows — turning explanations on can
+    never change monitoring state."""
+    scorer = BatchScorer(_params(), scaler)
+    mon_plain, mon_explain = DriftMonitor(profile), DriftMonitor(profile)
+    rows = [data[i] for i in range(200)]
+    spec = scorer.fused_spec()
+    slot = scorer.staging.acquire(_bucket(200, scorer.min_bucket))
+    try:
+        hx = scorer.stage_rows(slot, rows)
+        np.asarray(mon_plain.fused_flush(
+            jnp.asarray(hx), jnp.asarray(slot.valid), 200,
+            spec.score_args, spec.score_fn,
+        ))
+    finally:
+        scorer.staging.release(slot)
+    _explain_once(scorer, mon_explain, rows)
+    for f in mon_plain.window._fields:
+        a = np.asarray(getattr(mon_plain.window, f), np.float32)
+        b = np.asarray(getattr(mon_explain.window, f), np.float32)
+        assert np.array_equal(a.view(np.uint32), b.view(np.uint32)), (
+            f"explain leg moved window field {f}"
+        )
+
+
+# -- the quantized wire ------------------------------------------------------
+
+
+def test_quant_explain_matches_dequant_reference(data, scaler, profile):
+    """Int8 wire: fused attributions match the standalone explainer over
+    the DEQUANTIZED rows to ulp-scale — reason codes explain the lattice
+    values the model actually scored. (Not bitwise: XLA fuses the in-
+    program dequant multiply into the attribution FMA, a 1-ulp
+    reassociation vs the host-staged two-step reference — which is exactly
+    why the quant wire's parity contract is tolerance-gated.)"""
+    q8 = BatchScorer(_params(), scaler, io_dtype="int8")
+    mon = DriftMonitor(profile)
+    batch = [data[i] for i in range(64)]
+    _, idx, val = _explain_once(q8, mon, batch)
+    # rebuild the dequantized rows exactly as the device sees them
+    spec = q8.fused_spec()
+    codes = q8._prepare_host(np.stack(batch)).astype(np.float32)
+    xf = codes * np.asarray(spec.dequant_scale)
+    ref_idx, ref_val = linear_shap_topk(
+        _reference_explainer(q8), jnp.asarray(xf), K
+    )
+    assert np.array_equal(idx, np.asarray(ref_idx))
+    np.testing.assert_allclose(
+        val, np.asarray(ref_val), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_quant_explain_tolerance_vs_f32(data, scaler, profile):
+    """Int8-wire attributions track the f32-wire attributions within the
+    quantization tolerance (the gated parity of the quant explain leg)."""
+    f32 = BatchScorer(_params(), scaler)
+    q8 = BatchScorer(_params(), scaler, io_dtype="int8")
+    batch = [data[i] for i in range(128)]
+    _, _, val_f = _explain_once(f32, DriftMonitor(profile), batch)
+    _, _, val_q = _explain_once(q8, DriftMonitor(profile), batch)
+    assert float(np.abs(
+        val_q.astype(np.float64) - val_f.astype(np.float64)
+    ).max()) <= QUANT_PHI_ATOL
+
+
+# -- compressed d2h + staging ------------------------------------------------
+
+
+def test_explain_return_wire_narrows_and_decodes(data, scaler, profile):
+    """uint8 return wire: indices ship as one byte, values as f16; the
+    host decode recovers them within f16 resolution."""
+    scorer = BatchScorer(_params(), scaler)
+    mon = DriftMonitor(profile)
+    batch = [data[i] for i in range(32)]
+    s, idx, val = _explain_once(
+        scorer, mon, batch, out_dtype=jnp.uint8
+    )
+    assert s.dtype == np.uint8  # score codes (decoded elsewhere)
+    _, ref_val = linear_shap_topk(
+        _reference_explainer(scorer), jnp.asarray(np.stack(batch)), K
+    )
+    ref_idx, _ = linear_shap_topk(
+        _reference_explainer(scorer), jnp.asarray(np.stack(batch)), K
+    )
+    assert np.array_equal(idx, np.asarray(ref_idx))
+    np.testing.assert_allclose(
+        val, np.asarray(ref_val), rtol=2e-3, atol=2e-3
+    )  # f16 value wire
+
+
+def test_explain_staging_zero_alloc_steady_state(data, scaler, profile):
+    """Steady-state explain flushes draw every buffer — staging rows,
+    score decode, AND the reason-code decode pair — from the pool."""
+    scorer = BatchScorer(_params(), scaler)
+    mon = DriftMonitor(profile)
+    rows = [data[i] for i in range(64)]
+    _explain_once(scorer, mon, rows)  # creates the bucket slot + explain bufs
+    before = scorer.staging.allocations
+    slot_probe = scorer.staging.acquire(_bucket(64, scorer.min_bucket))
+    ei_id, ev_id = id(slot_probe.ei), id(slot_probe.ev)
+    scorer.staging.release(slot_probe)
+    for _ in range(50):
+        _explain_once(scorer, mon, rows)
+    assert scorer.staging.allocations == before
+    slot_probe = scorer.staging.acquire(_bucket(64, scorer.min_bucket))
+    assert id(slot_probe.ei) == ei_id and id(slot_probe.ev) == ev_id, (
+        "explain decode buffers were reallocated in steady state"
+    )
+    scorer.staging.release(slot_probe)
+
+
+# -- mesh --------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+def test_mesh_explain_bitwise_matches_single_device(
+    data, scaler, profile, n_shards
+):
+    """N-shard fused explain (scores, indices, values, merged window) is
+    bitwise the single-device lantern flush — reason codes row-shard with
+    zero collectives."""
+    import jax
+
+    from fraud_detection_tpu.mesh.shardflush import MeshDriftMonitor, merge_window
+    from fraud_detection_tpu.parallel.mesh import MeshSpec, create_mesh
+
+    scorer = BatchScorer(_params(), scaler)
+    mono = DriftMonitor(profile)
+    rows = [data[i] for i in range(256)]
+    s1, i1, v1 = _explain_once(scorer, mono, rows)
+
+    mesh = create_mesh(MeshSpec(data=n_shards), devices=jax.devices()[:n_shards])
+    mm = MeshDriftMonitor(profile, mesh)
+    sN, iN, vN = _explain_once(scorer, mm, rows)
+    assert np.array_equal(
+        np.asarray(s1, np.float32).view(np.uint32),
+        np.asarray(sN, np.float32).view(np.uint32),
+    )
+    assert np.array_equal(i1, iN)
+    assert np.array_equal(v1.view(np.uint32), vN.view(np.uint32))
+    merged = merge_window(mm.shard_window)
+    for f in mono.window._fields:
+        a = np.asarray(getattr(mono.window, f), np.float32)
+        b = np.asarray(getattr(merged, f), np.float32)
+        assert np.array_equal(a.view(np.uint32), b.view(np.uint32)), f
+
+
+def test_meshcheck_registers_lantern_entrypoints():
+    """The two new entrypoints verify at every virtual mesh size."""
+    from fraud_detection_tpu.analysis.meshcheck import (
+        _ENTRYPOINTS,
+        verify_entrypoint,
+    )
+
+    for name in ("lantern.flush", "mesh.lantern_flush"):
+        res = verify_entrypoint(_ENTRYPOINTS[name])
+        assert res and all(r["ok"] for r in res), res
+
+
+# -- compile sentinel --------------------------------------------------------
+
+
+def _compiles(entrypoint: str) -> float:
+    return metrics.xla_compiles.labels(entrypoint)._value.get()
+
+
+def test_compile_sentinel_exact_across_bucket_ladder(data, scaler, profile):
+    """xla_compiles_total{entrypoint="lantern.flush"} counts exactly one
+    compile per shape bucket; re-driving the buckets adds zero."""
+    import jax
+
+    from fraud_detection_tpu.telemetry import compile_sentinel
+
+    jax.clear_caches()
+    compile_sentinel.install()
+    try:
+        scorer = BatchScorer(_params(seed=11), scaler)
+        mon = DriftMonitor(profile)
+        rows = [data[i] for i in range(40)]
+        base = _compiles("lantern.flush")
+        for n in (3, 12, 20):  # buckets 8, 16, 32
+            _explain_once(scorer, mon, rows[:n])
+        assert _compiles("lantern.flush") - base == 3
+        for n in (5, 9, 31):  # same buckets: cache hits only
+            _explain_once(scorer, mon, rows[:n])
+        assert _compiles("lantern.flush") - base == 3
+    finally:
+        compile_sentinel.uninstall()
+
+
+# -- the micro-batcher hot path ----------------------------------------------
+
+
+def test_microbatcher_explain_single_dispatch(data, scaler, profile):
+    """Through the real MicroBatcher with SCORER_EXPLAIN=topk: every score
+    carries k reason codes, the flush stays ONE device dispatch, the
+    explain gauge latches 1 and the explained-rows counter advances."""
+    scorer = BatchScorer(_params(), scaler)
+    wt = Watchtower(profile, thresholds=THR)
+    names = [f"f{i}" for i in range(D)]
+
+    async def run():
+        mb = MicroBatcher(
+            scorer, max_batch=64, max_wait_ms=1.0, watchtower=wt,
+            telemetry=False, fused=True, explain=True, explain_k=K,
+        )
+        await mb.start()
+        try:
+            return await asyncio.gather(
+                *(mb.score_ex(data[i]) for i in range(48))
+            )
+        finally:
+            await mb.stop()
+
+    explained_before = metrics.scorer_explained_rows._value.get()
+    try:
+        out = asyncio.run(run())
+    finally:
+        wt.drain()
+        wt.close()
+    assert len(out) == 48
+    ref = _reference_explainer(scorer)
+    for i, (score, reasons) in enumerate(out):
+        assert 0.0 <= score <= 1.0
+        assert reasons is not None
+        idxs, vals = reasons
+        assert len(idxs) == K and len(vals) == K
+        phi = np.asarray(linear_shap(ref, jnp.asarray(data[i][None, :])))[0]
+        order = np.argsort(-phi, kind="stable")[:K]
+        assert list(order) == idxs
+        np.testing.assert_allclose(phi[order], vals, rtol=1e-6, atol=1e-6)
+        assert all(0 <= j < len(names) for j in idxs)
+    assert metrics.scorer_device_calls_per_flush._value.get() == 1
+    assert metrics.scorer_explain_fused._value.get() == 1
+    assert metrics.scorer_explained_rows._value.get() - explained_before == 48
+
+
+def test_score_unwraps_and_score_ex_degrades(data, scaler, profile):
+    """score() returns a bare float even with explain on; score_ex()
+    returns (score, None) with explain off — both surfaces stay usable
+    regardless of configuration."""
+    scorer = BatchScorer(_params(), scaler)
+    wt = Watchtower(profile, thresholds=THR)
+
+    async def run():
+        mb_on = MicroBatcher(
+            scorer, max_batch=32, max_wait_ms=1.0, watchtower=wt,
+            telemetry=False, explain=True, explain_k=K,
+        )
+        await mb_on.start()
+        s_plain = await mb_on.score(data[0])
+        await mb_on.stop()
+        mb_off = MicroBatcher(
+            scorer, max_batch=32, max_wait_ms=1.0, watchtower=wt,
+            telemetry=False, explain=False,
+        )
+        await mb_off.start()
+        s_off, reasons_off = await mb_off.score_ex(data[0])
+        await mb_off.stop()
+        return s_plain, s_off, reasons_off
+
+    try:
+        s_plain, s_off, reasons_off = asyncio.run(run())
+    finally:
+        wt.drain()
+        wt.close()
+    assert isinstance(s_plain, float) and 0.0 <= s_plain <= 1.0
+    assert isinstance(s_off, float)
+    assert reasons_off is None
+
+
+def test_demotion_is_logged_and_latched(data, scaler, profile, caplog):
+    """A family whose fused spec carries no explain leg: scores still flow
+    fused, responses ship without reason codes, the demotion is logged
+    once and scorer_explain_fused latches 0 (the ExplainUnfused input)."""
+
+    class NoExplainScorer(BatchScorer):
+        def fused_spec(self):
+            return super().fused_spec()._replace(explain_args=None)
+
+    scorer = NoExplainScorer(_params(), scaler)
+    wt = Watchtower(profile, thresholds=THR)
+
+    async def run():
+        mb = MicroBatcher(
+            scorer, max_batch=32, max_wait_ms=1.0, watchtower=wt,
+            telemetry=False, explain=True, explain_k=K,
+        )
+        await mb.start()
+        try:
+            return await asyncio.gather(
+                *(mb.score_ex(data[i]) for i in range(8))
+            )
+        finally:
+            await mb.stop()
+
+    with caplog.at_level(
+        logging.WARNING, logger="fraud_detection_tpu.microbatch"
+    ):
+        try:
+            out = asyncio.run(run())
+        finally:
+            wt.drain()
+            wt.close()
+    assert all(r is None for _, r in out), "demoted family shipped reasons?"
+    assert all(0.0 <= s <= 1.0 for s, _ in out)
+    assert metrics.scorer_explain_fused._value.get() == 0
+    assert metrics.scorer_device_calls_per_flush._value.get() == 1, (
+        "scores must STAY fused when only the explain leg demotes"
+    )
+    assert any(
+        "no fused explain program" in r.message for r in caplog.records
+    )
+    metrics.scorer_explain_fused.set(1)  # un-latch for later tests
+
+
+def test_hot_swap_rebinds_explain_leg(data, scaler, profile):
+    """A ModelSlot swap mid-traffic: post-swap reason codes reflect the
+    promoted champion's params (not the old explainer), with ZERO new
+    lantern compiles — the explain leg rebinds through the per-flush spec
+    exactly like the score leg."""
+    from fraud_detection_tpu.lifecycle.swap import ModelSlot
+    from fraud_detection_tpu.telemetry import compile_sentinel
+
+    scorer_a = BatchScorer(_params(seed=0), scaler)
+    scorer_b = BatchScorer(_params(seed=1, shift=0.4), scaler)
+    wt = Watchtower(profile, thresholds=THR)
+    slot = ModelSlot(types.SimpleNamespace(scorer=scorer_a), "test:a", 1)
+
+    compile_sentinel.install()
+    try:
+        async def run():
+            mb = MicroBatcher(
+                slot=slot, max_batch=32, max_wait_ms=1.0, max_inflight=4,
+                watchtower=wt, telemetry=False, fused=True,
+                explain=True, explain_k=K,
+            )
+            await mb.start()
+            base = _compiles("lantern.flush")
+            first = await asyncio.gather(
+                *(mb.score_ex(data[i]) for i in range(32))
+            )
+            slot.swap(types.SimpleNamespace(scorer=scorer_b), "test:b", 2)
+            second = await asyncio.gather(
+                *(mb.score_ex(data[i]) for i in range(32))
+            )
+            await mb.stop()
+            return first, second, _compiles("lantern.flush") - base
+
+        first, second, new_compiles = asyncio.run(run())
+    finally:
+        compile_sentinel.uninstall()
+        wt.drain()
+        wt.close()
+
+    ref_b = _reference_explainer(scorer_b)
+    ri, rv = linear_shap_topk(ref_b, jnp.asarray(data[:32]), K)
+    ri, rv = np.asarray(ri), np.asarray(rv)
+    for i, (_, reasons) in enumerate(second):
+        assert reasons is not None
+        assert reasons[0] == ri[i].tolist(), (
+            "post-swap reason codes still reflect the old champion"
+        )
+        np.testing.assert_allclose(reasons[1], rv[i], rtol=1e-6, atol=1e-6)
+    # pre-swap codes were the OLD champion's (sanity that the swap mattered)
+    ra, _ = linear_shap_topk(
+        _reference_explainer(scorer_a), jnp.asarray(data[:32]), K
+    )
+    assert any(
+        first[i][1][0] != second[i][1][0] for i in range(32)
+    ) or not np.array_equal(np.asarray(ra), ri)
+    assert new_compiles == 0, "the swap recompiled the lantern program"
+
+
+# -- worker consistency check ------------------------------------------------
+
+
+def _worker_stub():
+    """An XaiWorker shell with just enough state for the check method."""
+    from fraud_detection_tpu.service.worker import XaiWorker
+
+    w = XaiWorker.__new__(XaiWorker)
+    return w
+
+
+def test_worker_consistency_check_passes_and_fails():
+    w = _worker_stub()
+    phi = np.array([0.5, -0.2, 1.5, 0.9], np.float64)
+    good = {"indices": [2, 3, 0], "values": [1.5, 0.9, 0.5]}
+    before = metrics.xai_explain_consistency_failures._value.get()
+    assert w._check_explain_consistency(phi, good, "c", "t") is True
+    # within the quant tolerance still passes
+    fuzzy = {"indices": [2, 3, 0], "values": [1.52, 0.88, 0.51]}
+    assert w._check_explain_consistency(phi, fuzzy, "c", "t") is True
+    assert metrics.xai_explain_consistency_failures._value.get() == before
+    # a genuinely different attribution fails and counts
+    bad = {"indices": [1, 3, 0], "values": [1.5, 0.9, 0.5]}
+    assert w._check_explain_consistency(phi, bad, "c", "t") is False
+    assert metrics.xai_explain_consistency_failures._value.get() == before + 1
+    # malformed / legacy payloads are a no-op, never a crash
+    assert w._check_explain_consistency(phi, None, "c", "t") is True
+    assert w._check_explain_consistency(phi, {}, "c", "t") is True
+    assert w._check_explain_consistency(
+        phi, {"indices": [99], "values": [1.0]}, "c", "t"
+    ) is True
+    assert w._check_explain_consistency(
+        phi, {"indices": "garbage", "values": None}, "c", "t"
+    ) is True
+
+
+def test_predict_response_and_task_payload_carry_reason_codes(
+    tmp_path, monkeypatch
+):
+    """End to end through the API: with SCORER_EXPLAIN=topk the /predict
+    response carries named reason codes (highest attribution first) and
+    the enqueued compute_shap task rides the serve-time top-k as its 5th
+    arg — the worker's consistency-check input."""
+    import json as jsonlib
+    import os
+    import sqlite3
+
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+    from fraud_detection_tpu.monitor.baseline import save_profile
+    from fraud_detection_tpu.service.app import create_app
+    from fraud_detection_tpu.service.http import TestClient
+
+    rng = np.random.default_rng(5)
+    params = _params(seed=5)
+    x = (rng.standard_normal((300, D)) * 2.0).astype(np.float32)
+    scaler = scaler_fit(x)
+    names = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+    model = FraudLogisticModel(params, scaler, names, io_dtype="float32")
+    model_dir = str(tmp_path / "models")
+    model.save(model_dir, joblib_too=False)
+    save_profile(
+        model_dir,
+        build_baseline_profile(
+            x, model.scorer.predict_proba(x), feature_names=names
+        ),
+    )
+    monkeypatch.setenv(
+        "MODEL_PATH", os.path.join(model_dir, "logistic_model.joblib")
+    )
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    monkeypatch.setenv("SCORER_EXPLAIN", "topk")
+    monkeypatch.setenv("SCORER_EXPLAIN_K", "4")
+    db_url = f"sqlite:///{tmp_path}/fraud.db"
+    broker_url = f"sqlite:///{tmp_path}/taskq.db"
+    client = TestClient(create_app(database_url=db_url, broker_url=broker_url))
+    try:
+        feats = x[0].tolist()
+        r = client.post(
+            "/predict", json={"features": feats},
+            headers={"X-Correlation-ID": "lantern-1"},
+        )
+        assert r.status_code == 200
+        body = r.json()
+        codes = body["reason_codes"]
+        assert codes is not None and len(codes) == 4
+        assert all(c["feature"] in names for c in codes)
+        vals = [c["attribution"] for c in codes]
+        assert vals == sorted(vals, reverse=True)
+        # parity with the worker explainer at the named features
+        phi, _ = model.explain_batch(x[:1])
+        by_name = dict(zip(names, phi[0].tolist()))
+        for c in codes:
+            assert abs(by_name[c["feature"]] - c["attribution"]) < 1e-5
+        # the task payload's 5th arg is the serve-time top-k
+        conn = sqlite3.connect(broker_url[len("sqlite:///"):])
+        (args_json,) = conn.execute(
+            "SELECT args FROM tasks WHERE correlation_id='lantern-1'"
+        ).fetchone()
+        conn.close()
+        args = jsonlib.loads(args_json)
+        assert len(args) == 5
+        assert args[4] is not None
+        assert args[4]["values"] == pytest.approx(vals)
+        assert [names[i] for i in args[4]["indices"]] == [
+            c["feature"] for c in codes
+        ]
+    finally:
+        client.close()
+
+
+def test_prediction_out_schema_carries_reason_codes():
+    from fraud_detection_tpu.service.schemas import PredictionOut
+
+    out = PredictionOut(
+        prediction=1, score=0.9, transaction_id="t", correlation_id="c",
+        explanation_status="queued",
+        reason_codes=[{"feature": "V14", "attribution": 1.2}],
+    )
+    d = out.model_dump()
+    assert d["reason_codes"] == [{"feature": "V14", "attribution": 1.2}]
+    # absent stays null (explain off / demoted family)
+    d2 = PredictionOut(
+        prediction=0, score=0.1, transaction_id="t", correlation_id="c",
+        explanation_status="queued",
+    ).model_dump()
+    assert d2["reason_codes"] is None

@@ -191,6 +191,38 @@ def test_quickwire_alert_and_panels_present():
         assert "scorer_wire_fused" in dash, rel
 
 
+def test_lantern_alert_and_panels_present():
+    """The lantern contract (ISSUE 9): the ExplainUnfused alert ships
+    promlint-clean, its gauge + the explained-rows counter are exported by
+    service/metrics.py, and both dashboards carry the explain-fusion stat —
+    a family without a fused explain program silently shipping scores
+    without their reason codes can never be silent."""
+    path = os.path.join(RULES_DIR, "telemetry-alerts.yml")
+    with open(path) as f:
+        text = f.read()
+    assert "ExplainUnfused" in text
+    assert "scorer_explain_fused" in text
+    assert promlint.lint_rules_file(path) == []
+    exported = _exported_metric_names()
+    assert "scorer_explain_fused" in exported
+    assert (
+        "scorer_explained_rows" in exported
+        or "scorer_explained_rows_total" in exported
+    )
+    assert (
+        "xai_explain_consistency_failures" in exported
+        or "xai_explain_consistency_failures_total" in exported
+    )
+    for rel in (
+        "grafana_dashboard.json",
+        os.path.join("grafana_provisioning", "dashboards", "fraud-tpu.json"),
+    ):
+        with open(os.path.join(MONITORING, rel)) as f:
+            dash = f.read()
+        assert "scorer_explain_fused" in dash, rel
+        assert "scorer_explained_rows" in dash, rel
+
+
 def test_mesh_rules_file_ships():
     """The switchyard contract (ISSUE 7): mesh-alerts.yml ships
     promlint-clean with the two promised alerts."""

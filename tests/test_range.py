@@ -442,6 +442,17 @@ def test_scenario_replica_burst():
 
 
 @pytest.mark.slow
+def test_scenario_explain_under_burst():
+    """Lantern chaos (ISSUE 9): Pareto burst with SCORER_EXPLAIN=topk fused
+    into every flush and a shard killed mid-burst — p99 holds, every scored
+    row carries its k reason codes, the kill sheds load without dropping
+    the explain output."""
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario("explain_under_burst").raise_if_failed()
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "kill_point",
     [

@@ -474,6 +474,8 @@ def test_propagation_noop_without_otel(served):
     ).fetchone()
     conn.close()
     args = jsonlib.loads(args_json)
+    # explain off → the 4-arg payload (no serve-time top-k rider), so a
+    # not-yet-upgraded worker stays compatible through a rolling deploy
     assert len(args) == 4
     assert args[0] == tx_id
     assert args[2] == "noop-1"
