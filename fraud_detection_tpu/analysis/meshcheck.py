@@ -602,6 +602,118 @@ def _build_mesh_lantern_flush(mesh: Mesh):
     )
 
 
+_LEDGER_SLOTS = 1024  # abstract table size (power of two, like production)
+_LEDGER_K = 4
+
+
+def _abstract_ledger(mesh: Mesh, lead: tuple[int, ...] = (), spec: P = P()):
+    from fraud_detection_tpu.ledger.state import LedgerState
+
+    return LedgerState(
+        acc=sds((*lead, _LEDGER_SLOTS, 3), jnp.float32, mesh, spec),
+        last_ts=sds((*lead, _LEDGER_SLOTS), jnp.float32, mesh, spec),
+        fingerprint=sds((*lead, _LEDGER_SLOTS), jnp.uint32, mesh, spec),
+        collisions=sds(lead, jnp.float32, mesh, spec if lead else P()),
+        evictions=sds(lead, jnp.float32, mesh, spec if lead else P()),
+    )
+
+
+def _widened_window(mesh: Mesh, lead: tuple[int, ...] = (), spec: P = P()):
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import N_CALIB_BINS, DriftWindow
+
+    d = _FEATURES + _LEDGER_K
+    return DriftWindow(
+        feature_counts=sds((*lead, d, N_FEATURE_BINS), jnp.float32, mesh, spec),
+        score_counts=sds((*lead, N_SCORE_BINS), jnp.float32, mesh, spec),
+        calib_count=sds((*lead, N_CALIB_BINS), jnp.float32, mesh, spec),
+        calib_conf=sds((*lead, N_CALIB_BINS), jnp.float32, mesh, spec),
+        calib_label=sds((*lead, N_CALIB_BINS), jnp.float32, mesh, spec),
+        n_rows=sds(lead, jnp.float32, mesh, spec if lead else P()),
+    )
+
+
+@register_entrypoint("ledger.flush")
+def _build_ledger_flush(mesh: Mesh):
+    """The stateful ledger flush (ledger/): per-entity velocity read+
+    update, feature widening, scoring AND the drift fold in ONE donated
+    dispatch — the serving hot path for a widened family, proven at every
+    mesh size like the other fused flush programs."""
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import _fused_flush_ledger
+    from fraud_detection_tpu.ops.scorer import _raw_score_linear
+
+    d = _FEATURES + _LEDGER_K
+    window = _widened_window(mesh)
+    ledger = _abstract_ledger(mesh)
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, P(DATA_AXIS))
+    valid = sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((d, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = (
+        sds((d,), jnp.float32, mesh, P()),
+        sds((), jnp.float32, mesh, P()),
+    )
+    slot_idx = sds((_ROWS,), jnp.int32, mesh, P(DATA_AXIS))
+    fp = sds((_ROWS,), jnp.uint32, mesh, P(DATA_AXIS))
+    ts = sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))
+    has = sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))
+    null = sds((_LEDGER_K,), jnp.float32, mesh, P())
+    hl = sds((), jnp.float32, mesh, P())
+    fn = lambda w, led, xx, vv, dd, fe, se, sa, sl, ff, tt, hh, nn, ll: (  # noqa: E731
+        _fused_flush_ledger(
+            w, led, xx, vv, dd, fe, se, sa, sl, ff, tt, hh, nn, ll,
+            score_fn=_raw_score_linear,
+        )
+    )
+    return fn, (
+        window, ledger, x, valid, decay, feature_edges, score_edges,
+        score_args, slot_idx, fp, ts, has, null, hl,
+    )
+
+
+@register_entrypoint("mesh.ledger_flush")
+def _build_mesh_ledger_flush(mesh: Mesh):
+    """The switchyard ledger flush: the widened stateful program as ONE
+    shard_map dispatch — rows placement-aligned (hash-mod-shard), per-shard
+    windows AND entity sub-tables donated through, no collectives."""
+    from fraud_detection_tpu.mesh.shardflush import _sharded_flush_ledger
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.ops.scorer import _raw_score_linear
+
+    n_shards = mesh.shape[DATA_AXIS]
+    shard = P(DATA_AXIS)
+    d = _FEATURES + _LEDGER_K
+    window = _widened_window(mesh, (n_shards,), shard)
+    ledger = _abstract_ledger(mesh, (n_shards,), shard)
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, shard)
+    valid = sds((_ROWS,), jnp.float32, mesh, shard)
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((d, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = (
+        sds((d,), jnp.float32, mesh, P()),
+        sds((), jnp.float32, mesh, P()),
+    )
+    slot_idx = sds((_ROWS,), jnp.int32, mesh, shard)
+    fp = sds((_ROWS,), jnp.uint32, mesh, shard)
+    ts = sds((_ROWS,), jnp.float32, mesh, shard)
+    has = sds((_ROWS,), jnp.float32, mesh, shard)
+    null = sds((_LEDGER_K,), jnp.float32, mesh, P())
+    hl = sds((), jnp.float32, mesh, P())
+    fn = lambda w, led, xx, vv, dd, fe, se, sa, sl, ff, tt, hh, nn, ll: (  # noqa: E731
+        _sharded_flush_ledger(
+            w, led, xx, vv, dd, fe, se, sa, sl, ff, tt, hh, nn, ll,
+            score_fn=_raw_score_linear, mesh=mesh,
+        )
+    )
+    return fn, (
+        window, ledger, x, valid, decay, feature_edges, score_edges,
+        score_args, slot_idx, fp, ts, has, null, hl,
+    )
+
+
 @register_entrypoint("mesh.sharded_update")
 def _build_mesh_sharded_update(mesh: Mesh):
     """The cross-replica-sharded weight update (2004.13336): params and

@@ -276,6 +276,49 @@ def scorer_adaptive_wait() -> bool:
 
 
 # --------------------------------------------------------------------------
+# Ledger: device-resident per-entity velocity aggregates (ledger/)
+# --------------------------------------------------------------------------
+
+def ledger_enabled() -> bool:
+    """``LEDGER_ENABLED=1`` — train-side opt-in: train.py / the conductor's
+    retrain replay base + feedback rows through the ledger body and fit the
+    WIDENED (base + K velocity features) model family, stamping
+    ``ledger_state.npz`` beside the weights. Serving needs no flag: it
+    widens whenever the loaded artifact carries a ledger sidecar (the
+    widened weights are unusable without it). Default off."""
+    return env_flag("LEDGER_ENABLED") is True
+
+
+def ledger_slots() -> int:
+    """``LEDGER_SLOTS`` — entity table size (power-of-two hash buckets).
+    Collisions degrade gracefully (colliding entities share a slot's
+    aggregates, counted on ``ledger_hash_collisions_total``); raise this
+    when ``ledger_slot_occupancy`` approaches the LedgerSaturated alert
+    threshold (docs/runbooks/LedgerSaturated.md). Default 8192."""
+    return _get_int("LEDGER_SLOTS", 8192)
+
+
+def ledger_halflife_s() -> float:
+    """``LEDGER_HALFLIFE_S`` — exponential decay half-life (seconds) of the
+    per-entity aggregates: how fast an entity's velocity evidence fades.
+    Default 3600 (one hour — the classic card-velocity window)."""
+    return _get_float("LEDGER_HALFLIFE_S", 3600.0)
+
+
+def ledger_amount_col() -> int:
+    """``LEDGER_AMOUNT_COL`` — index of the transaction-amount column in
+    the base feature row (the accumulator input). Default -1: the last
+    column, ``Amount`` in the Kaggle schema."""
+    return _get_int("LEDGER_AMOUNT_COL", -1)
+
+
+def ledger_synth_events_per_entity() -> int:
+    """``LEDGER_SYNTH_EVENTS`` — average events per synthesized pseudo-
+    entity when replaying an entity-less base dataset at train time."""
+    return _get_int("LEDGER_SYNTH_EVENTS", 50)
+
+
+# --------------------------------------------------------------------------
 # Watchtower: online drift & quality monitoring + shadow scoring (monitor/)
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,38 @@
+"""Ledger: the device-resident stateful feature engine.
+
+The model scored 30 stateless PCA features; real fraud systems score
+*velocity* — per-card transaction count/sum over sliding windows,
+time-since-last-event, amount z-scores. The ledger is a fixed-size hashed
+per-entity accumulator table living on device as a donated pytree exactly
+like the drift window: the fused serving flush reads each row's aggregates,
+derives K velocity features, writes the updated accumulators back, and
+scores the widened ``[rows, base + K]`` feature block — all in the SAME
+single donated dispatch the flush already pays (monitor/drift
+``_fused_flush_ledger``; the shard_map twin in mesh/shardflush).
+
+Train/serve skew is structurally impossible: training replays base +
+feedback rows *through the same traced body* (:mod:`.replay`) in timestamp
+order to materialize the widened training features, so the features the
+model fits on are, by construction, the features serving computes.
+"""
+
+from fraud_detection_tpu.ledger.state import (  # noqa: F401
+    LEDGER_FEATURE_NAMES,
+    LEDGER_K,
+    LedgerSpec,
+    LedgerState,
+    entity_fingerprint,
+    entity_slot,
+    init_state,
+    load_ledger,
+    save_ledger,
+)
+from fraud_detection_tpu.ledger.features import (  # noqa: F401
+    _ledger_read_update,
+    ledger_stats,
+)
+from fraud_detection_tpu.ledger.replay import (  # noqa: F401
+    materialize_features,
+    synthesize_entities,
+)
+from fraud_detection_tpu.ledger.placement import shard_placement  # noqa: F401

@@ -1,0 +1,180 @@
+"""The ledger's traced read-update body — the ONE place velocity features
+are computed.
+
+``_ledger_read_update`` is un-jitted (like ``ops/scorer._raw_score_linear``
+and ``ops/linear_shap._raw_linear_shap``): the fused serving flush
+(monitor/drift ``_fused_flush_ledger``), the shard_map mesh body
+(mesh/shardflush), AND the training replay (:mod:`.replay`) all trace this
+exact expression, so train/serve skew is structurally impossible — there is
+no second implementation to drift.
+
+Semantics (deterministic by construction — every write is a scatter-add or
+scatter-max, never a duplicate-index scatter-set):
+
+- **reads** see the pre-batch state decayed to each row's own timestamp:
+  ``decayed = acc · 2^(−Δt/halflife)``. First-seen entities (anchor 0) read
+  empty aggregates; entity-less rows read the spec's ``null_features``.
+- **writes** fold the whole batch against a per-slot anchor: the slot's new
+  ``last_ts`` is the scatter-max of its rows' timestamps, the old
+  accumulators decay to that anchor, and each row's contribution decays
+  from its own timestamp to the anchor before the scatter-add. Rows of one
+  flush therefore fold without *intra-batch* decay between them — windows
+  are hours, flushes are milliseconds, so the deviation from strictly
+  sequential processing is ``2^(−ms/hours)`` ≈ one ulp — and, crucially,
+  the result is identical for any row order within the batch, which is
+  what makes the replay bitwise-reproducible.
+- **padding and entity-less rows** carry weight 0: they scatter-add exact
+  zeros and scatter-max a 0 timestamp, leaving every slot *bitwise*
+  unchanged — the property the all-padding warmup test pins.
+- **poison guard**: the amount is ``nan_to_num``-ed and clipped to
+  ``±AMOUNT_CLIP`` before it touches an accumulator, and the z-score
+  output is clipped to ``±ZSCORE_CLIP`` — a NaN/Inf/absurd amount (the
+  ``poison_entity_state`` chaos campaign) degrades one entity's features
+  to a clamped value instead of NaN-ing the slot or the score.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fraud_detection_tpu.ledger.state import (
+    AMOUNT_CLIP,
+    ZSCORE_CLIP,
+    LedgerState,
+)
+
+
+def _ledger_read_update(
+    state: LedgerState,
+    slot_idx: jax.Array,   # (n,) int32 table slot per row
+    fp: jax.Array,         # (n,) uint32 entity fingerprint (0 = none)
+    ts: jax.Array,         # (n,) f32 event timestamp, strictly > 0 for
+    #                        real entity rows (host guarantees it)
+    amount: jax.Array,     # (n,) f32 transaction amount (pre-clamp)
+    has_entity: jax.Array,  # (n,) f32 1.0 when the row carries an entity
+    null_features: jax.Array,  # (K,) features for entity-less rows
+    halflife_s: jax.Array,  # () f32 decay half-life
+) -> tuple[jax.Array, LedgerState]:
+    """Read K velocity features per row and fold the batch back into the
+    donated table. Returns ``(features (n, K), new_state)``."""
+    inv_hl = 1.0 / jnp.maximum(halflife_s, 1e-6)
+    w = has_entity.astype(jnp.float32)
+    # clamp once, then promise in-bounds to every gather/scatter: XLA's
+    # per-update bounds checks are pure overhead on the scatter loop, and
+    # the clamp makes a corrupted index degrade to a shared slot instead
+    # of undefined behavior
+    slot_idx = jnp.clip(slot_idx, 0, state.acc.shape[0] - 1)
+    _IB = "promise_in_bounds"
+    # poison guard: non-finite → 0, then the symmetric clip
+    a = jnp.clip(
+        jnp.nan_to_num(amount, nan=0.0, posinf=AMOUNT_CLIP, neginf=-AMOUNT_CLIP),
+        -AMOUNT_CLIP,
+        AMOUNT_CLIP,
+    )
+    ts = jnp.maximum(jnp.nan_to_num(ts, nan=0.0, posinf=0.0, neginf=0.0), 0.0)
+
+    # ---- read: pre-batch state decayed to each row's timestamp ----------
+    prev_acc = state.acc[slot_idx]  # (n, 3) one gather for all three
+    prev_cnt = prev_acc[:, 0]
+    prev_sum = prev_acc[:, 1]
+    prev_ssq = prev_acc[:, 2]
+    prev_ts = state.last_ts[slot_idx]
+    prev_fp = state.fingerprint[slot_idx]
+    seen = (prev_ts > 0.0).astype(jnp.float32)
+    dt = jnp.maximum(ts - prev_ts, 0.0)
+    f_row = jnp.exp2(-dt * inv_hl) * seen
+    dcnt = prev_cnt * f_row
+    dsum = prev_sum * f_row
+    dssq = prev_ssq * f_row
+
+    mean = dsum / jnp.maximum(dcnt, 1.0)
+    var = jnp.maximum(dssq / jnp.maximum(dcnt, 1.0) - mean * mean, 0.0)
+    # +1 in the denominator: bounded z for near-degenerate histories (a
+    # two-event entity with identical amounts must not explode the score)
+    z = jnp.clip(
+        (a - mean) / jnp.sqrt(var + 1.0), -ZSCORE_CLIP, ZSCORE_CLIP
+    ) * (dcnt >= 2.0)
+    # time-since-last: log1p keeps seconds-to-days on one scale; never-seen
+    # entities read the horizon sentinel (8 half-lives ≈ "forever ago")
+    tsl_null = jnp.log1p(8.0 * halflife_s)
+    tsl = jnp.where(seen > 0.0, jnp.log1p(dt), tsl_null)
+    feats = jnp.stack([dcnt, dsum, tsl, z], axis=1)
+    feats = jnp.where(w[:, None] > 0.0, feats, null_features[None, :])
+
+    # ---- write: deterministic scatter fold ------------------------------
+    ts_eff = ts * w  # padding / entity-less rows push a 0 anchor (no-op)
+    new_last = state.last_ts.at[slot_idx].max(ts_eff, mode=_IB)
+    # Old accumulators decay from their previous anchor to the new one.
+    # Done as a scatter-SET of pre-decayed values rather than a full-table
+    # multiply: the decay factor is a per-SLOT quantity (both anchors are
+    # slot state), so every row of a slot computes the bitwise-identical
+    # update value and duplicate-index scatter order cannot matter — while
+    # the transcendentals stay (n,)-sized instead of (slots,)-sized.
+    # Untouched slots keep their bytes (nothing scatters there); a slot
+    # touched only by weight-0 rows has anchor == previous anchor, so the
+    # set re-writes its current value times exp2(-0) = 1 — bitwise
+    # unchanged, which is what keeps the all-padding warmup invariant.
+    anchor = new_last[slot_idx]
+    f_anchor = jnp.exp2(-(anchor - prev_ts) * inv_hl)
+    # each row's event decays from its own timestamp to the slot anchor
+    g = jnp.exp2(-jnp.maximum(anchor - ts, 0.0) * inv_hl) * w
+    ga = g * a
+    new_acc = (
+        state.acc.at[slot_idx].set(prev_acc * f_anchor[:, None], mode=_IB)
+        .at[slot_idx].add(jnp.stack([g, ga, ga * a], axis=1), mode=_IB)
+    )
+    # fingerprint: best-effort "latest writer" telemetry — scatter-max is
+    # the deterministic choice for duplicate slots; collision accounting
+    # below compares against the PRE-batch owner either way
+    fp_eff = jnp.where(w > 0.0, fp, jnp.uint32(0))
+    new_fp = state.fingerprint.at[slot_idx].max(fp_eff, mode=_IB)
+    mismatch = w * (prev_fp != fp).astype(jnp.float32) * (prev_fp != 0)
+    live = (dcnt > 0.5).astype(jnp.float32)
+    new_coll = state.collisions + jnp.sum(mismatch * live)
+    new_evic = state.evictions + jnp.sum(mismatch * (1.0 - live))
+    return feats, LedgerState(
+        acc=new_acc,
+        last_ts=new_last,
+        fingerprint=new_fp,
+        collisions=new_coll,
+        evictions=new_evic,
+    )
+
+
+@jax.jit
+def _ledger_stats(state: LedgerState, halflife_s: jax.Array):
+    """Scrape-time occupancy reduce: the fraction of slots whose evidence,
+    decayed to the table's own clock (the most recent anchor — slots only
+    decay lazily on writes, so the stored counts are stale by construction),
+    is still above noise. This is the LedgerSaturated alert input: without
+    the decay, occupancy would be a monotonically-growing ever-claimed
+    fraction and the alert would page on long-dead entities. Also returns
+    the raw claimed fraction and the collision/eviction totals."""
+    claimed = (state.last_ts > 0.0).astype(jnp.float32)
+    now = jnp.max(state.last_ts)
+    inv_hl = 1.0 / jnp.maximum(halflife_s, 1e-6)
+    decayed = state.count * jnp.exp2(-(now - state.last_ts) * inv_hl)
+    active = claimed * (decayed >= 0.5).astype(jnp.float32)
+    n = state.last_ts.shape[0]
+    return (
+        jnp.sum(active) / n,
+        jnp.sum(claimed) / n,
+        state.collisions,
+        state.evictions,
+    )
+
+
+def ledger_stats(state: LedgerState, halflife_s: float | None = None) -> dict:
+    """Host dict of the scrape-time ledger telemetry. ``halflife_s`` is the
+    spec's decay horizon; None (tests/offline inspection) reports the
+    undecayed view (occupancy = slots with count ≥ 0.5 at last write)."""
+    occ, claimed, coll, evic = _ledger_stats(
+        state, jnp.float32(halflife_s if halflife_s else float("inf"))
+    )
+    return {
+        "slot_occupancy": float(occ),
+        "slots_claimed_frac": float(claimed),
+        "hash_collisions": float(coll),
+        "evictions": float(evic),
+    }

@@ -1,0 +1,59 @@
+"""Hash-mod-shard row placement for the sharded (shard_map) ledger flush.
+
+Under ``MESH_FLUSH_DEVICES=N`` the fused flush splits the staged bucket's
+rows positionally: rows ``[s·b/N, (s+1)·b/N)`` execute on device shard
+``s``, and each shard folds its rows into ITS OWN ledger sub-table (leading
+shard axis, donated through — exactly the drift-window discipline). For a
+given entity's aggregates to live on exactly ONE shard, every row of that
+entity must always land in the same shard's row range; the batcher
+therefore *places* rows by ``slot mod N`` before staging — a host-side
+permutation, never a device collective.
+
+Entity-less rows carry no state and fill whichever segment has room.
+Because a skewed entity mix can overfill one segment (9 of 16 rows hashing
+to shard 0 of 2), the bucket is bumped to the next power of two that fits
+``N × max_segment`` — the warm ladder for a mesh ledger extends by the
+shard factor so the bump never compiles mid-traffic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def shard_placement(
+    slots: np.ndarray,       # (n,) int32 table slot per row
+    has_entity: np.ndarray,  # (n,) truthy when the row carries an entity
+    n_shards: int,
+    min_bucket: int = 8,
+) -> tuple[int, np.ndarray]:
+    """Positions for ``n`` rows in a segment-aligned bucket.
+
+    Returns ``(bucket, positions)`` where ``positions[i]`` is row ``i``'s
+    staged index: entity rows sit inside segment ``slots[i] % n_shards``,
+    entity-less rows pack into the emptiest segments. The bucket is the
+    smallest power of two ≥ ``max(n, n_shards · max_segment, min_bucket)``
+    divisible into equal segments."""
+    from fraud_detection_tpu.ops.scorer import _bucket
+
+    n = int(slots.shape[0])
+    shard_of = np.where(
+        np.asarray(has_entity, bool), np.asarray(slots) % n_shards, -1
+    )
+    counts = np.bincount(shard_of[shard_of >= 0], minlength=n_shards)
+    # entity-less rows fill the emptiest segments (balance, no state)
+    free = counts.copy()
+    for i in np.flatnonzero(shard_of < 0):
+        s = int(np.argmin(free))
+        shard_of[i] = s
+        free[s] += 1
+    max_seg = int(free.max()) if n else 0
+    bucket = _bucket(max(n, n_shards * max_seg, min_bucket), min_bucket)
+    seg = bucket // n_shards
+    positions = np.zeros(n, np.int64)
+    cursor = np.zeros(n_shards, np.int64)
+    for i in range(n):
+        s = int(shard_of[i])
+        positions[i] = s * seg + cursor[s]
+        cursor[s] += 1
+    return bucket, positions

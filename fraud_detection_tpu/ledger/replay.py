@@ -1,0 +1,129 @@
+"""Training-side replay: materialize the widened features through the SAME
+traced body serving uses.
+
+``materialize_features`` sorts rows by timestamp (stable, so same-ts rows
+keep their input order), replays them through
+:func:`fraud_detection_tpu.ledger.features._ledger_read_update` in
+fixed-size batches, and returns the ``(n, K)`` velocity features in the
+ORIGINAL row order plus the final table state. Because the body is the
+exact expression the fused serving flush traces, a model fitted on these
+columns is structurally incapable of train/serve skew — the parity test
+drives the serving flush and this replay over the same rows and asserts
+the scores match exactly.
+
+Base datasets (the Kaggle CSV) carry no entity ids, so
+``synthesize_entities`` assigns deterministic pseudo-entities and
+timestamps (the ``Time`` column when the schema has one, else row order):
+the fit still sees a realistic distribution over the velocity columns
+instead of a constant null vector, and the assignment is seed-stable so
+two trainings of the same data produce bitwise-identical features.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fraud_detection_tpu.ledger.state import (
+    LedgerSpec,
+    LedgerState,
+    device_state,
+    entity_fingerprint,
+    entity_slot,
+)
+
+#: replay batch size — also the serving parity test's flush size. Features
+#: of rows in ONE batch read the pre-batch state (see features.py), so the
+#: batch partition is part of the replay contract; keep it stable.
+REPLAY_BATCH = 256
+
+
+def synthesize_entities(
+    x: np.ndarray,
+    feature_names,
+    seed: int = 0,
+    events_per_entity: int = 50,
+) -> tuple[list[str], np.ndarray]:
+    """Deterministic pseudo-entities + timestamps for an entity-less base
+    dataset. Entities are assigned by a seeded shuffle of ``row → pool of
+    n/events_per_entity ids`` (so each pseudo-card sees ~events_per_entity
+    transactions spread across the timeline); timestamps come from the
+    ``Time`` column when present (offset to be strictly positive), else
+    one second per row."""
+    n = x.shape[0]
+    names = list(feature_names or [])
+    rng = np.random.default_rng(seed)
+    n_entities = max(n // max(events_per_entity, 1), 1)
+    assignment = rng.integers(0, n_entities, size=n)
+    entities = [f"sim-{int(e)}" for e in assignment]
+    if "Time" in names:
+        t = np.asarray(x[:, names.index("Time")], np.float64)
+        ts = (t - t.min() + 1.0).astype(np.float32)
+    else:
+        ts = (np.arange(n, dtype=np.float32) + 1.0)
+    return entities, ts
+
+
+def row_keys(
+    spec: LedgerSpec, entities, n: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized host half of the hash: (slots, fingerprints, has_entity)
+    for a row list whose entries may be None (no entity)."""
+    slots = np.zeros(n, np.int32)
+    fps = np.zeros(n, np.uint32)
+    has = np.zeros(n, np.float32)
+    for i, e in enumerate(entities):
+        if e is None:
+            continue
+        fp = entity_fingerprint(e)
+        slots[i] = entity_slot(fp, spec.log2_slots)
+        fps[i] = fp
+        has[i] = 1.0
+    return slots, fps, has
+
+
+def materialize_features(
+    spec: LedgerSpec,
+    x: np.ndarray,
+    entities,
+    ts: np.ndarray,
+    state: LedgerState | None = None,
+    batch: int = REPLAY_BATCH,
+) -> tuple[np.ndarray, LedgerState]:
+    """Replay ``x`` (n, n_base) in timestamp order through the serving
+    body; returns features aligned to the INPUT order + the final state."""
+    import jax
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.ledger.features import _ledger_read_update
+
+    x = np.asarray(x, np.float32)
+    n = x.shape[0]
+    ts = np.asarray(ts, np.float32).reshape(-1)
+    if len(entities) != n or ts.shape[0] != n:
+        raise ValueError("entities/ts must align with the rows")
+    order = np.argsort(ts, kind="stable")
+    slots, fps, has = row_keys(spec, [entities[i] for i in order], n)
+    amounts = x[order][:, spec.amount_col].astype(np.float32)
+    ts_o = ts[order]
+
+    step = jax.jit(_ledger_read_update)
+    dev = device_state(state, spec.slots)
+    null = jnp.asarray(spec.null_features)
+    hl = jnp.float32(spec.halflife_s)
+    feats = np.zeros((n, spec.null_features.shape[0]), np.float32)
+    for lo in range(0, n, batch):
+        hi = min(lo + batch, n)
+        pad = batch - (hi - lo)
+        sl = np.pad(slots[lo:hi], (0, pad))
+        fb = np.pad(fps[lo:hi], (0, pad))
+        tb = np.pad(ts_o[lo:hi], (0, pad))
+        ab = np.pad(amounts[lo:hi], (0, pad))
+        hb = np.pad(has[lo:hi], (0, pad))
+        fk, dev = step(
+            dev,
+            jnp.asarray(sl), jnp.asarray(fb), jnp.asarray(tb),
+            jnp.asarray(ab), jnp.asarray(hb), null, hl,
+        )
+        feats[order[lo:hi]] = np.asarray(fk)[: hi - lo]
+    host = LedgerState(*(np.asarray(leaf) for leaf in dev))
+    return feats, host

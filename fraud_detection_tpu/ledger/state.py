@@ -1,0 +1,255 @@
+"""Ledger state: the hashed per-entity accumulator table.
+
+A fixed-size, power-of-two table of time-decayed aggregates keyed by a
+multiply-shift hash of the request's ``entity_id`` (the card / account /
+device the transaction belongs to). One slot holds:
+
+- ``count`` — exponentially time-decayed event count,
+- ``amount_sum`` / ``amount_sumsq`` — decayed sum and sum-of-squares of the
+  (clipped) transaction amount, the z-score inputs (sumsq stays f32: the
+  poison clamp bounds a single term at ``AMOUNT_CLIP²`` and decay bounds
+  the series, so the accumulator cannot overflow f32 — see features.py),
+- ``last_ts`` — the slot's decay anchor (0 = never seen),
+- ``fingerprint`` — the 32-bit entity hash of the slot's latest writer,
+  telemetry-only: colliding entities SHARE the slot's aggregates
+  gracefully (the fingerprint mismatch only feeds the collision/eviction
+  counters, it never forks state).
+
+The table lives as a donated pytree threaded through every fused serving
+flush, exactly like the drift window — one live copy, zero host round
+trips on the hot path. Snapshots (``ledger_state.npz``) are stamped beside
+``model.npz`` so a deploy/hot-swap resumes entity history where training's
+replay left it, and carry the :class:`LedgerSpec` (hash geometry + the
+null-entity feature vector) the serving tier rebinds with the model.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LEDGER_FILE = "ledger_state.npz"
+
+#: the K velocity features the ledger widens the feature vector with, in
+#: column order (appended after the base schema; the model's feature_names
+#: carries them so reason codes / drift panels name them properly)
+LEDGER_FEATURE_NAMES = (
+    "LedgerCount",      # decayed event count for the entity (pre-event)
+    "LedgerAmountSum",  # decayed amount sum for the entity (pre-event)
+    "LedgerTimeSince",  # log1p(seconds since the entity's last event)
+    "LedgerAmountZ",    # this amount's z-score vs the entity's history
+)
+LEDGER_K = len(LEDGER_FEATURE_NAMES)
+
+#: poison clamp on the amount feeding the accumulators: a NaN/Inf or
+#: absurd amount (the poison_entity_state chaos campaign) folds in as a
+#: bounded value instead of NaN-ing the slot — clip² also bounds a single
+#: sumsq term at 1e12, keeping the f32 accumulator far from overflow
+AMOUNT_CLIP = 1e6
+
+#: z-score clamp — an extreme-but-finite amount yields a bounded feature
+ZSCORE_CLIP = 8.0
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+#: Knuth's multiplicative constant — the multiply-shift slot hash
+_MULT = 0x9E3779B1
+
+
+def entity_fingerprint(entity_id) -> int:
+    """Stable 32-bit fingerprint of an entity id (string or int): FNV-1a
+    over the utf-8 repr, folded to 32 bits. 0 is reserved (= "no entity"),
+    so a real entity hashing to 0 is nudged to 1."""
+    h = _FNV_OFFSET
+    for b in str(entity_id).encode("utf-8"):
+        h ^= b
+        h = (h * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    fp = (h ^ (h >> 32)) & 0xFFFFFFFF
+    return fp or 1
+
+
+def entity_slot(fingerprint: int, log2_slots: int) -> int:
+    """Multiply-shift: the top ``log2_slots`` bits of ``fp · 2654435761``
+    (mod 2³²) — the classic universal-ish hash for power-of-two tables."""
+    return ((fingerprint * _MULT) & 0xFFFFFFFF) >> (32 - log2_slots)
+
+
+class LedgerState(NamedTuple):
+    """The donated device pytree. Leading ``(slots, ...)`` axes per field;
+    the mesh tier adds a shard axis in front exactly like the drift window.
+
+    The three decayed accumulators live PACKED in one ``(S, 3)`` array
+    (count, amount_sum, amount_sumsq): the batch fold is then two scatters
+    over rank-2 updates instead of six rank-1 scatters — scatter dispatch
+    overhead, not arithmetic, dominates the update on every backend. The
+    ``count``/``amount_sum``/``amount_sumsq`` properties give named views.
+    """
+
+    acc: jax.Array          # (S, 3) f32 decayed [count, Σamount, Σamount²]
+    last_ts: jax.Array      # (S,) f32 decay anchor; 0 = never seen
+    fingerprint: jax.Array  # (S,) uint32 latest writer's entity hash
+    collisions: jax.Array   # () f32 writes into a live slot owned by
+    #                         another fingerprint (aggregates shared)
+    evictions: jax.Array    # () f32 takeovers of a faded slot (the prior
+    #                         entity's evidence had decayed below noise)
+
+    @property
+    def count(self):
+        return self.acc[..., 0]
+
+    @property
+    def amount_sum(self):
+        return self.acc[..., 1]
+
+    @property
+    def amount_sumsq(self):
+        return self.acc[..., 2]
+
+
+@dataclass(frozen=True)
+class LedgerSpec:
+    """Everything serving needs to widen the feature vector: stamped in
+    ``ledger_state.npz`` beside the model so the hash geometry, decay
+    horizon, and null-entity features can never drift from the weights
+    that were trained against them."""
+
+    n_base: int                 # features clients send (the wire schema)
+    slots: int                  # table size, power of two
+    halflife_s: float           # decay half-life of the aggregates
+    amount_col: int             # index of the Amount column in the base row
+    #: absolute offset subtracted from wall-clock event times before they
+    #: enter the f32 table: raw unix epochs (~1.7e9) are beyond f32's
+    #: integer resolution (~128 s there), so the table keeps an
+    #: origin-relative clock. Stamped at train time so a request arriving
+    #: right after a deploy continues the replay's clock seamlessly.
+    ts_origin: float = 0.0
+    null_features: np.ndarray = None  # (K,) raw-space features for entity-less
+    #                             rows — the baseline-profile means, so a
+    #                             legacy client's rows score at the training
+    #                             distribution's center, not at "brand-new
+    #                             entity" (see features.py null-slot note)
+
+    def __post_init__(self):
+        if self.slots & (self.slots - 1) or self.slots <= 0:
+            raise ValueError(f"LEDGER_SLOTS must be a power of two, got {self.slots}")
+        nf = np.asarray(
+            self.null_features
+            if self.null_features is not None
+            else np.zeros(LEDGER_K, np.float32),
+            np.float32,
+        ).reshape(-1)
+        if nf.shape[0] != LEDGER_K:
+            raise ValueError(
+                f"null_features must have {LEDGER_K} entries, got {nf.shape[0]}"
+            )
+        object.__setattr__(self, "null_features", nf)
+
+    @property
+    def log2_slots(self) -> int:
+        return int(self.slots).bit_length() - 1
+
+    @property
+    def n_features(self) -> int:
+        """The widened width the model scores."""
+        return self.n_base + LEDGER_K
+
+    @property
+    def feature_names(self) -> tuple[str, ...]:
+        return LEDGER_FEATURE_NAMES
+
+    def row_keys(self, entity_id) -> tuple[int, int]:
+        """(slot, fingerprint) for one request's entity — the host-side
+        half of the hash, computed once at submit time."""
+        fp = entity_fingerprint(entity_id)
+        return entity_slot(fp, self.log2_slots), fp
+
+    def rel_ts(self, epoch_ts: float) -> float:
+        """Origin-relative event time for the f32 table (strictly > 0 —
+        0 is the never-seen sentinel)."""
+        return max(float(epoch_ts) - self.ts_origin, 1e-3)
+
+    @classmethod
+    def from_config(cls, n_base: int, null_features=None) -> "LedgerSpec":
+        from fraud_detection_tpu import config
+
+        return cls(
+            n_base=n_base,
+            slots=config.ledger_slots(),
+            halflife_s=config.ledger_halflife_s(),
+            amount_col=config.ledger_amount_col(),
+            null_features=(
+                np.zeros(LEDGER_K, np.float32)
+                if null_features is None
+                else np.asarray(null_features, np.float32)
+            ),
+        )
+
+
+def init_state(slots: int) -> LedgerState:
+    """A fresh (host, numpy) table — callers device-put it where it lives
+    (single device, or sharded with a leading shard axis)."""
+    return LedgerState(
+        acc=np.zeros((slots, 3), np.float32),
+        last_ts=np.zeros((slots,), np.float32),
+        fingerprint=np.zeros((slots,), np.uint32),
+        collisions=np.zeros((), np.float32),
+        evictions=np.zeros((), np.float32),
+    )
+
+
+def device_state(state: LedgerState | None, slots: int) -> LedgerState:
+    """Host snapshot (or None = fresh) → device-resident pytree."""
+    st = state if state is not None else init_state(slots)
+    return LedgerState(*(jnp.asarray(np.asarray(leaf)) for leaf in st))
+
+
+def save_ledger(directory: str, spec: LedgerSpec, state: LedgerState) -> str:
+    """Stamp ``ledger_state.npz`` (spec + table snapshot) beside the model
+    artifacts — the thing ``ModelReloader`` rebinds on hot swap."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, LEDGER_FILE)
+    np.savez(
+        path,
+        n_base=np.int64(spec.n_base),
+        slots=np.int64(spec.slots),
+        halflife_s=np.float64(spec.halflife_s),
+        amount_col=np.int64(spec.amount_col),
+        ts_origin=np.float64(spec.ts_origin),
+        null_features=np.asarray(spec.null_features, np.float32),
+        acc=np.asarray(state.acc, np.float32),
+        last_ts=np.asarray(state.last_ts, np.float32),
+        fingerprint=np.asarray(state.fingerprint, np.uint32),
+        collisions=np.asarray(state.collisions, np.float32),
+        evictions=np.asarray(state.evictions, np.float32),
+    )
+    return path
+
+
+def load_ledger(directory: str) -> tuple[LedgerSpec, LedgerState] | None:
+    """Load the stamped spec + snapshot; None when the artifact carries no
+    ledger (a stateless model keeps serving the 30-feature path)."""
+    path = os.path.join(directory, LEDGER_FILE)
+    if not os.path.exists(path):
+        return None
+    with np.load(path, allow_pickle=False) as z:
+        spec = LedgerSpec(
+            n_base=int(z["n_base"]),
+            slots=int(z["slots"]),
+            halflife_s=float(z["halflife_s"]),
+            amount_col=int(z["amount_col"]),
+            ts_origin=float(z["ts_origin"]) if "ts_origin" in z else 0.0,
+            null_features=np.asarray(z["null_features"], np.float32),
+        )
+        state = LedgerState(
+            acc=np.asarray(z["acc"], np.float32),
+            last_ts=np.asarray(z["last_ts"], np.float32),
+            fingerprint=np.asarray(z["fingerprint"], np.uint32),
+            collisions=np.asarray(z["collisions"], np.float32),
+            evictions=np.asarray(z["evictions"], np.float32),
+        )
+    return spec, state

@@ -81,6 +81,69 @@ def warm_start_from(champion, new_scaler) -> LogisticParams | None:
     )
 
 
+def _replay_widened(
+    spec, x, feature_names, seed, fx_w, fe_w, ft_w, fx_r, fe_r, ft_r,
+):
+    """Materialize the widened feature blocks for a ledger retrain: ONE
+    causal replay (timestamp order) over base + feedback rows through the
+    serving body. Base rows get the same seeded pseudo-entities the
+    offline trainer assigns; feedback rows carry their recorded entity/
+    timestamp (rows persisted without them replay through the null slot,
+    ordered after the base clock). Returns the widened base matrix, the
+    widened feature names, the spec to stamp on the challenger (clock
+    origin advanced to serve time), the final table snapshot, and the
+    widened window/reservoir blocks."""
+    import dataclasses as _dc
+
+    from fraud_detection_tpu.ledger import (
+        LEDGER_FEATURE_NAMES,
+        materialize_features,
+        synthesize_entities,
+    )
+
+    n_b, n_w, n_r = x.shape[0], fx_w.shape[0], fx_r.shape[0]
+    ents_b, ts_b = synthesize_entities(
+        x, feature_names, seed,
+        config.ledger_synth_events_per_entity(),
+    )
+    base_max = float(ts_b.max()) if n_b else 0.0
+
+    def fb_meta(ents, ts, n, newest_first: bool, offset: float):
+        ents = list(ents) if ents else [None] * n
+        out_ts = np.zeros(n, np.float32)
+        for i in range(n):
+            t = float(ts[i]) if ts is not None and i < len(ts) else 0.0
+            if t > 0:
+                out_ts[i] = spec.rel_ts(t)
+            else:
+                # no recorded event time: order after the base clock,
+                # preserving the fetch order (window rows arrive newest
+                # first — reverse so older rows replay first)
+                rank = (n - i) if newest_first else (i + 1)
+                out_ts[i] = base_max + offset + rank
+        return ents, out_ts
+
+    ents_r, ts_r = fb_meta(fe_r, ft_r, n_r, False, 0.25)
+    ents_w, ts_w = fb_meta(fe_w, ft_w, n_w, True, 0.5)
+    all_x = np.concatenate([a for a in (x, fx_w, fx_r) if a.size]) if (
+        n_w or n_r
+    ) else x
+    all_ents = ents_b + (ents_w if n_w else []) + (ents_r if n_r else [])
+    all_ts = np.concatenate(
+        [a for a, k in ((ts_b, n_b), (ts_w, n_w), (ts_r, n_r)) if k]
+    )
+    feats, final_state = materialize_features(spec, all_x, all_ents, all_ts)
+    xw = np.concatenate([all_x, feats], axis=1).astype(np.float32)
+    new_spec = _dc.replace(
+        spec, ts_origin=time.time() - (float(all_ts.max()) + 1.0)
+    )
+    names = list(feature_names) + list(LEDGER_FEATURE_NAMES)
+    return (
+        xw[:n_b], names, new_spec, final_state,
+        xw[n_b : n_b + n_w], xw[n_b + n_w :],
+    )
+
+
 def run_retrain(
     store,
     champion,
@@ -105,8 +168,6 @@ def run_retrain(
     # ---- base data + frozen holdout (the split every champion was judged on)
     x, y, feature_names = load_creditcard_csv(data_csv or config.data_csv())
     train_idx, test_idx = stratified_split(y, HOLDOUT_FRACTION, seed)
-    x_train, y_train = x[train_idx], y[train_idx]
-    x_hold, y_hold = x[test_idx], y[test_idx]
 
     # ---- feedback replay: recent window + history reservoir (raw features).
     # The window is split disjointly: even rows replay into TRAINING, odd
@@ -114,10 +175,31 @@ def run_retrain(
     # on rows it trained on would inflate its recent AUC vs a champion that
     # never saw them (train-set evaluation) and let a worse model pass.
     # Interleaved (not chronological) so both halves span the same period.
-    fx_w, fs_w, fy_w = store.window_rows()
+    ledger_spec = getattr(champion, "ledger_spec", None)
+    ledger_state = None
+    if ledger_spec is None:
+        fx_w, fs_w, fy_w = store.window_rows()
+        fx_r, fs_r, fy_r = store.reservoir_rows()
+    else:
+        # ledger (stateful feature engine): a widened champion retrains on
+        # WIDENED features — base + feedback rows replay through the SAME
+        # traced body the serving flush runs (ledger/replay), in timestamp
+        # order, so the challenger's training features are, by
+        # construction, the features serving computes (skew is
+        # structurally impossible). The meta fetch rides the same store
+        # read as the rows, so entities/timestamps cannot misalign.
+        fx_w, fs_w, fy_w, fe_w, ft_w = store.window_rows_meta()
+        fx_r, fs_r, fy_r, fe_r, ft_r = store.reservoir_rows_meta()
+        (
+            x, feature_names, ledger_spec, ledger_state, fx_w, fx_r,
+        ) = _replay_widened(
+            ledger_spec, x, feature_names, seed,
+            fx_w, fe_w, ft_w, fx_r, fe_r, ft_r,
+        )
+    x_train, y_train = x[train_idx], y[train_idx]
+    x_hold, y_hold = x[test_idx], y[test_idx]
     fx_train, fy_train = fx_w[0::2], fy_w[0::2]
     fx_eval, fy_eval = fx_w[1::2], fy_w[1::2]
-    fx_r, fs_r, fy_r = store.reservoir_rows()
     replay_x = [a for a in (fx_train, fx_r) if a.size]
     replay_y = [a for a in (fy_train, fy_r) if a.size]
     n_replay = int(sum(a.shape[0] for a in replay_x))
@@ -206,7 +288,10 @@ def run_retrain(
                 x_final, y_final, max_iter=max_iter, sharded=True,
                 warm_start=ws,
             )
-        challenger = FraudLogisticModel(params, scaler, list(feature_names))
+        challenger = FraudLogisticModel(
+            params, scaler, list(feature_names),
+            ledger_spec=ledger_spec, ledger_state=ledger_state,
+        )
 
         # ---- the challenger gate: frozen holdout + recent labeled window
         gate = evaluate_gate(
@@ -228,6 +313,13 @@ def run_retrain(
         # path carries its own monitor profile, train.py contract)
         artifact_dir = run.artifact_path("model")
         save_artifacts(artifact_dir, params, scaler, list(feature_names))
+        if ledger_spec is not None:
+            # stamp the replayed entity table beside the challenger: a
+            # promotion hot-swaps the model AND its table snapshot, so
+            # serving resumes exactly where the training replay ended
+            from fraud_detection_tpu.ledger.state import save_ledger
+
+            save_ledger(artifact_dir, ledger_spec, ledger_state)
         if scaler is not None:
             # quickwire: stamp the int8 wire calibration beside the
             # challenger's weights — a promotion hot-swaps BOTH, so the

@@ -83,7 +83,9 @@ _SCHEMA = [
         features TEXT NOT NULL,
         score REAL NOT NULL,
         label INTEGER NOT NULL,
-        created_at REAL NOT NULL
+        created_at REAL NOT NULL,
+        entity TEXT,
+        ts REAL
     )
     """,
     "CREATE INDEX IF NOT EXISTS idx_feedback_pool_seq ON feedback_rows(pool, seq)",
@@ -155,6 +157,19 @@ class LifecycleStore:
                 # above ships it; only pre-owner stores need the ALTER)
                 log.debug("lifecycle owner column migration skipped",
                           exc_info=True)
+        # ledger: pre-ledger stores lack the entity/ts feedback columns
+        for col_ddl in ("entity TEXT", "ts REAL"):
+            with self._lock:
+                try:
+                    with self._conn:
+                        self._conn.execute(
+                            f"ALTER TABLE feedback_rows ADD COLUMN {col_ddl}"
+                        )
+                except Exception:
+                    log.debug(
+                        "feedback %s column migration skipped", col_ddl,
+                        exc_info=True,
+                    )
 
     def _connect(self) -> None:
         import os
@@ -185,11 +200,17 @@ class LifecycleStore:
             )
 
     def add_feedback(
-        self, features: Iterable, scores: Iterable, labels: Iterable
+        self, features: Iterable, scores: Iterable, labels: Iterable,
+        entity_ids=None, timestamps=None,
     ) -> int:
         """Append one labeled batch; returns rows ingested. One transaction
         per batch: a crash mid-batch loses the batch, never corrupts the
-        reservoir's uniformity invariants (``seen`` commits with the rows)."""
+        reservoir's uniformity invariants (``seen`` commits with the rows).
+
+        ``entity_ids``/``timestamps`` (ledger): per-row entity + event time
+        so the conductor's retrain can replay feedback through the velocity
+        aggregator in timestamp order. Optional — rows without them replay
+        through the null slot."""
         feats = np.asarray(features, np.float32)
         if feats.ndim == 1:
             feats = feats[None, :]
@@ -220,6 +241,15 @@ class LifecycleStore:
             raise ValueError("feedback scores must be probabilities in [0, 1]")
         if not np.all((labels == 0) | (labels == 1)):
             raise ValueError("feedback labels must be 0 or 1")
+        ents: list = list(entity_ids) if entity_ids is not None else [None] * n
+        tss: list = list(timestamps) if timestamps is not None else [None] * n
+        if len(ents) != n or len(tss) != n:
+            raise ValueError("entity_ids/timestamps must align with features")
+        ents = [None if e is None else str(e) for e in ents]
+        for t in tss:
+            if t is not None and not (float(t) > 0 and np.isfinite(float(t))):
+                raise ValueError("timestamps must be positive finite numbers")
+        tss = [None if t is None else float(t) for t in tss]
         now = time.time()
         with self._lock, self._conn:
             seq = self._meta_get("seq")
@@ -230,10 +260,12 @@ class LifecycleStore:
                 payload = json.dumps([float(v) for v in feats[i]])
                 self._conn.execute(
                     "INSERT INTO feedback_rows (id, seq, pool, slot, features,"
-                    " score, label, created_at) VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+                    " score, label, created_at, entity, ts)"
+                    " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                     (
                         uuid.uuid4().hex, seq, WINDOW, None, payload,
                         float(scores[i]), int(labels[i]), now,
+                        ents[i], tss[i],
                     ),
                 )
                 # reservoir sampling (Vitter's R): row i of history occupies
@@ -252,11 +284,12 @@ class LifecycleStore:
                     )
                     self._conn.execute(
                         "INSERT INTO feedback_rows (id, seq, pool, slot,"
-                        " features, score, label, created_at)"
-                        " VALUES (?, ?, ?, ?, ?, ?, ?, ?)",
+                        " features, score, label, created_at, entity, ts)"
+                        " VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                         (
                             uuid.uuid4().hex, seq, RESERVOIR, slot, payload,
                             float(scores[i]), int(labels[i]), now,
+                            ents[i], tss[i],
                         ),
                     )
             self._meta_set("seq", seq)
@@ -280,7 +313,7 @@ class LifecycleStore:
 
     def _rows(self, pool: str, limit: int | None = None):
         sql = (
-            "SELECT features, score, label FROM feedback_rows "
+            "SELECT features, score, label, entity, ts FROM feedback_rows "
             "WHERE pool = ? ORDER BY seq DESC"
         )
         params: list[Any] = [pool]
@@ -302,6 +335,20 @@ class LifecycleStore:
         y = np.asarray([r["label"] for r in rows], np.int32)
         return x, s, y
 
+    @staticmethod
+    def _unpack_meta(rows) -> tuple[list, np.ndarray]:
+        """Ledger columns for a fetched row set: (entities, timestamps) —
+        entity None / ts 0.0 for rows persisted before the columns existed
+        (they replay through the null slot)."""
+        if not rows:
+            return [], np.zeros((0,), np.float32)
+        ents = [r["entity"] for r in rows]
+        ts = np.asarray(
+            [r["ts"] if r["ts"] is not None else 0.0 for r in rows],
+            np.float32,
+        )
+        return ents, ts
+
     def window_rows(
         self, limit: int | None = None
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -309,10 +356,24 @@ class LifecycleStore:
         with self._lock:
             return self._unpack(self._rows(WINDOW, limit))
 
+    def window_rows_meta(self, limit: int | None = None):
+        """Window rows WITH the ledger columns →
+        ``(features, scores, labels, entities, timestamps)`` — one fetch,
+        so rows and their replay metadata can never misalign."""
+        with self._lock:
+            rows = self._rows(WINDOW, limit)
+            return (*self._unpack(rows), *self._unpack_meta(rows))
+
     def reservoir_rows(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """The uniform-over-history replay sample."""
         with self._lock:
             return self._unpack(self._rows(RESERVOIR))
+
+    def reservoir_rows_meta(self):
+        """Reservoir rows WITH the ledger columns (see window_rows_meta)."""
+        with self._lock:
+            rows = self._rows(RESERVOIR)
+            return (*self._unpack(rows), *self._unpack_meta(rows))
 
     def feedback_counts(self) -> dict:
         with self._lock:

@@ -163,7 +163,16 @@ class ModelReloader:
         if self.watchtower is not None:
             from fraud_detection_tpu.monitor.baseline import load_profile
 
-            self.watchtower.rebind_champion(load_profile(art))
+            # ledger: a widened champion's entity table rebinds WITH the
+            # model (the stamped snapshot its weights were replayed
+            # against) — same zero-recompile discipline as the weights,
+            # since the table shapes are fixed by LEDGER_SLOTS
+            ledger = (
+                (model.ledger_spec, model.ledger_state)
+                if getattr(model, "ledger_spec", None) is not None
+                else None
+            )
+            self.watchtower.rebind_champion(load_profile(art), ledger=ledger)
             # rebind_champion drops the shadow scorer (the old challenger is
             # usually the new champion); force the shadow sweep that runs
             # right after this to re-bind even if the @shadow alias version

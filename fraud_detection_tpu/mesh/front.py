@@ -180,11 +180,22 @@ class ShardFront:
     def _healthy(self) -> list[ShardHandle]:
         return [h for h in self.shards if h.state == HEALTHY]
 
-    def pick(self, exclude: set[int] | None = None) -> ShardHandle:
+    def pick(
+        self, exclude: set[int] | None = None, entity=None
+    ) -> ShardHandle:
         """Least-in-flight healthy shard (optionally excluding shards this
         request already failed on — a fast-failing shard has the LOWEST
         in-flight count, so without the exclusion a retry would re-pick
-        exactly the shard that just failed it)."""
+        exactly the shard that just failed it).
+
+        ``entity`` (the ledger's ``(slot, fingerprint, ts)`` triple) makes
+        routing sticky: an entity's rows prefer shard ``fingerprint mod
+        N`` — hash-mod-shard placement, so one replica's batcher sees an
+        entity's whole stream (its flushes then stage the entity into one
+        device shard's ledger sub-table, and batch locality improves).
+        A dead/draining/excluded preferred shard falls back to
+        least-in-flight: availability beats stickiness — the ledger
+        tolerates it (the tables are per-process state either way)."""
         healthy = [
             h for h in self._healthy()
             if not exclude or h.shard_id not in exclude
@@ -197,6 +208,10 @@ class ShardFront:
                 f"all {len(self.shards)} shards dead, draining, or already "
                 "tried by this request"
             )
+        if entity is not None:
+            preferred = self.shards[int(entity[1]) % len(self.shards)]
+            if preferred in healthy:
+                return preferred
         return min(healthy, key=lambda h: h.inflight)
 
     def _half_open_candidate(self, exclude: set[int] | None) -> (
@@ -234,25 +249,25 @@ class ShardFront:
     def _refresh_health_gauge(self) -> None:
         metrics.mesh_shards_healthy.set(len(self._healthy()))
 
-    async def score(self, row, timeline=None) -> float:
+    async def score(self, row, timeline=None, entity=None) -> float:
         """Route one row; a failing shard is retried elsewhere in the same
         call (at most once per shard), so callers see a score or one final
         error — never a dead shard's exception."""
-        return await self._route("score", row, timeline)
+        return await self._route("score", row, timeline, entity)
 
-    async def score_ex(self, row, timeline=None):
+    async def score_ex(self, row, timeline=None, entity=None):
         """Route one row through the explain surface: ``(score, reasons)``
         with the lantern reason codes from whichever shard scored it —
         same shed/retry semantics as :meth:`score`, so a shard dying
         mid-burst re-routes the row WITH its explain output intact."""
-        return await self._route("score_ex", row, timeline)
+        return await self._route("score_ex", row, timeline, entity)
 
-    async def _route(self, method: str, row, timeline=None):
+    async def _route(self, method: str, row, timeline=None, entity=None):
         last_exc: BaseException | None = None
         tried: set[int] = set()
         for _ in range(len(self.shards)):
             try:
-                h = self.pick(exclude=tried)
+                h = self.pick(exclude=tried, entity=entity)
             except NoHealthyShards:
                 if last_exc is not None:
                     raise last_exc
@@ -265,7 +280,9 @@ class ShardFront:
                 # shard's scoring here (the kill-a-shard drill). Disarmed
                 # this is one global load.
                 fire("mesh.shard_flush", shard=h.shard_id)
-                out = await getattr(h.batcher, method)(row, timeline)
+                out = await getattr(h.batcher, method)(
+                    row, timeline, entity
+                )
             except Exception as e:
                 last_exc = e
                 if h.note_error(e):

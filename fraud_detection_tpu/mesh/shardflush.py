@@ -361,6 +361,171 @@ def _sharded_flush_quant_explain(
     )
 
 
+def init_sharded_ledger(n_shards: int, state, slots: int, mesh=None):
+    """Per-shard ledger sub-tables: every :class:`LedgerState` leaf gains a
+    leading ``(n_shards,)`` axis over the data axis. Shard ``s`` only ever
+    touches slots with ``slot mod n_shards == s`` (the batcher's
+    hash-mod-shard row placement — ledger/placement), so the sub-tables
+    have disjoint slot support and the scrape-time merge is an exact sum.
+    A host snapshot seeds shard ``slot mod n_shards``'s sub-table with its
+    own slots and zeros elsewhere, so restore round-trips bitwise."""
+    from fraud_detection_tpu.ledger.state import LedgerState, init_state
+
+    base = state if state is not None else init_state(slots)
+    sharding = NamedSharding(mesh, P(DATA_AXIS)) if mesh is not None else None
+    slot_shard = np.arange(slots) % n_shards
+
+    def split(leaf, owner_split: bool):
+        leaf = np.asarray(leaf)
+        out = np.zeros((n_shards, *leaf.shape), leaf.dtype)
+        if owner_split and leaf.ndim >= 1:
+            for s in range(n_shards):
+                mask = slot_shard == s
+                out[s][mask] = leaf[mask]
+        else:
+            out[0] = leaf  # scalars (collision/eviction totals) on shard 0
+        if sharding is None:
+            return jnp.asarray(out)
+        return jax.device_put(out, sharding)
+
+    return LedgerState(
+        acc=split(base.acc, True),
+        last_ts=split(base.last_ts, True),
+        fingerprint=split(base.fingerprint, True),
+        collisions=split(base.collisions, False),
+        evictions=split(base.evictions, False),
+    )
+
+
+@jax.jit
+def _merge_ledger(shard_ledger):
+    """Scrape-time reduce of the per-shard sub-tables. Disjoint slot
+    support (hash-mod-shard placement) makes the sums exact; the
+    fingerprint merges by max (a uint32 sum could wrap)."""
+    from fraud_detection_tpu.ledger.state import LedgerState
+
+    return LedgerState(
+        acc=jnp.sum(shard_ledger.acc, axis=0),
+        last_ts=jnp.max(shard_ledger.last_ts, axis=0),
+        fingerprint=jnp.max(shard_ledger.fingerprint, axis=0),
+        collisions=jnp.sum(shard_ledger.collisions, axis=0),
+        evictions=jnp.sum(shard_ledger.evictions, axis=0),
+    )
+
+
+def _shard_body_ledger(
+    window, ledger, x, valid, decay, feature_edges, score_edges, score_args,
+    slot_idx, fp, ts, has_entity, null_features, halflife_s,
+    dequant_scale=None, explain_args=None,
+    *, score_fn, explain_k=0, amount_col=-1, out_dtype=jnp.float32,
+):
+    """Per-shard ledger flush body under shard_map: traces the SAME
+    ``drift._ledger_serving_body`` expression the single-device program
+    runs — identical math by construction (the ``_fold_serving_batch``
+    discipline) — over this shard's rows, ITS window slice AND its ledger
+    sub-table. The batcher places rows so a shard only sees entities whose
+    slot it owns (``slot mod n_shards == shard``) — the sub-tables stay
+    disjoint and no collective ever rides the flush."""
+    from fraud_detection_tpu.monitor.drift import _ledger_serving_body
+
+    w = jax.tree.map(lambda t: t[0], window)
+    led = jax.tree.map(lambda t: t[0], ledger)
+    out = _ledger_serving_body(
+        w, led, x, valid, decay, feature_edges, score_edges, score_args,
+        slot_idx, fp, ts, has_entity, null_features, halflife_s,
+        dequant_scale, explain_args,
+        score_fn=score_fn, explain_k=explain_k, amount_col=amount_col,
+        out_dtype=out_dtype,
+    )
+    lead = lambda tree: jax.tree.map(lambda t: t[None], tree)  # noqa: E731
+    if explain_k > 0:
+        scores, idx, val, new_w, new_led = out
+        return scores, idx, val, lead(new_w), lead(new_led)
+    scores, new_w, new_led = out
+    return scores, lead(new_w), lead(new_led)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("score_fn", "mesh", "explain_k", "amount_col",
+                     "out_dtype", "has_dequant", "has_explain"),
+    donate_argnums=(0, 1),
+)
+def _sharded_flush_ledger(
+    window: DriftWindow,  # per-shard windows, leading axis = shard
+    ledger,  # per-shard ledger sub-tables, leading axis = shard
+    x: jax.Array,  # (b, d_base) staged bucket, b % n_shards == 0
+    valid: jax.Array,  # (b,)
+    decay: jax.Array,  # () global drift forgetting factor
+    feature_edges: jax.Array,  # (d_base + K, bins - 1) widened edges
+    score_edges: jax.Array,
+    score_args,  # pytree, replicated (raw-space widened params)
+    slot_idx: jax.Array,  # (b,) int32, placement-aligned (slot%N == shard)
+    fp: jax.Array,  # (b,) uint32
+    ts: jax.Array,  # (b,) f32
+    has_entity: jax.Array,  # (b,) f32
+    null_features: jax.Array,  # (K,) replicated
+    halflife_s: jax.Array,  # () replicated
+    dequant_scale=None,  # (d_base,) replicated, int8 wire only
+    explain_args=None,  # replicated lantern params, explain_k > 0 only
+    *,
+    score_fn,
+    mesh,
+    explain_k: int = 0,
+    amount_col: int = -1,
+    out_dtype=jnp.float32,
+    has_dequant: bool = False,
+    has_explain: bool = False,
+):
+    """The switchyard ledger flush: the widened stateful program as ONE
+    shard_map dispatch over the data axis — rows, reason codes, per-shard
+    windows AND per-shard ledger sub-tables all row/shard-local, no
+    collectives. Registered in meshcheck (``mesh.ledger_flush``) and the
+    compile sentinel. ``has_dequant``/``has_explain`` are static so the
+    in_specs tuple matches the (pytree-None) optional params."""
+    in_specs = [
+        P(DATA_AXIS),  # window: shard axis
+        P(DATA_AXIS),  # ledger: shard axis
+        P(DATA_AXIS),  # x: rows
+        P(DATA_AXIS),  # valid: rows
+        P(),           # decay
+        P(),           # feature_edges
+        P(),           # score_edges
+        P(),           # score_args (replicated pytree prefix)
+        P(DATA_AXIS),  # slot_idx: rows
+        P(DATA_AXIS),  # fp: rows
+        P(DATA_AXIS),  # ts: rows
+        P(DATA_AXIS),  # has_entity: rows
+        P(),           # null_features
+        P(),           # halflife_s
+        P(),           # dequant_scale (replicated; pytree-None when f32)
+        P(),           # explain_args (replicated; pytree-None when off)
+    ]
+    out_specs = (
+        (P(DATA_AXIS),) * 4 + (P(DATA_AXIS),)
+        if explain_k > 0
+        else (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+    )
+    mapped = shard_map(
+        partial(
+            _shard_body_ledger,
+            score_fn=score_fn,
+            explain_k=explain_k,
+            amount_col=amount_col,
+            out_dtype=out_dtype,
+        ),
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    return mapped(
+        window, ledger, x, valid, decay, feature_edges, score_edges,
+        score_args, slot_idx, fp, ts, has_entity, null_features, halflife_s,
+        dequant_scale, explain_args,
+    )
+
+
 class MeshDriftMonitor(DriftMonitor):
     """Drift monitoring for the sharded serving mesh.
 
@@ -423,17 +588,27 @@ class MeshDriftMonitor(DriftMonitor):
         out_dtype=jnp.float32,
         explain_args=None,
         explain_k: int = 0,
+        ledger_rows=None,
     ):
         """Score one staged bucket across every shard AND fold each shard's
         rows into its own window — one dispatch, no collectives (the
         quickwire ``_sharded_flush_quant`` program when ``dequant_scale``
         rides along for a quantized wire; the lantern
         ``_sharded_flush_explain``/``_quant_explain`` when ``explain_k >
-        0`` adds the row-sharded reason-code leg). Same locking contract
+        0`` adds the row-sharded reason-code leg; the stateful
+        ``_sharded_flush_ledger`` when the ledger is bound and
+        ``ledger_rows`` rides along — per-shard entity sub-tables donated
+        through beside the per-shard windows). Same locking contract
         as the base class: the critical section is the async dispatch plus
         the donated-state store."""
         # graftcheck: hot-path
         decay = self._decay_for(n_live)
+        if ledger_rows is not None and self.ledger is not None:
+            return self._ledger_flush(
+                x, valid, decay, n_live, score_args, score_fn,
+                dequant_scale, out_dtype, explain_args, explain_k,
+                ledger_rows,
+            )
         explain_k = min(int(explain_k), int(x.shape[1]))  # k ≥ d clamps to d
         with self._lock:
             if explain_k > 0 and explain_args is not None:
@@ -508,3 +683,65 @@ class MeshDriftMonitor(DriftMonitor):
 
     def _window_for_stats(self) -> DriftWindow:
         return _merge_total(self.shard_window, self.window)
+
+    # -- ledger: per-shard sub-tables --------------------------------------
+    def bind_ledger(self, spec, state=None) -> None:
+        """Shard the entity table over the data axis: shard ``s`` owns the
+        slots with ``slot mod n_shards == s`` (the batcher's placement
+        contract — ledger/placement.shard_placement), donated through every
+        sharded flush and merged only at scrape/snapshot time."""
+        with self._lock:
+            self.ledger_spec = spec
+            self.ledger = init_sharded_ledger(
+                self.n_shards, state, spec.slots, mesh=self.mesh
+            )
+            self._ledger_null = jnp.asarray(spec.null_features)
+            self._ledger_halflife = jnp.float32(spec.halflife_s)
+
+    def _ledger_for_stats(self):
+        return _merge_ledger(self.ledger)
+
+    def _ledger_flush(
+        self, x, valid, decay, n_live, score_args, score_fn,
+        dequant_scale, out_dtype, explain_args, explain_k, ledger_rows,
+    ):
+        # graftcheck: hot-path
+        slot_idx, fp, ts, has_entity = ledger_rows
+        spec = self.ledger_spec
+        explain_k = min(
+            int(explain_k), int(x.shape[1]) + len(spec.null_features)
+        )
+        explain_k = explain_k if explain_args is not None else 0
+        with self._lock:
+            out = _sharded_flush_ledger(
+                self.shard_window,
+                self.ledger,
+                x,
+                valid,
+                decay,
+                self._feature_edges,
+                self._score_edges,
+                score_args,
+                slot_idx,
+                fp,
+                ts,
+                has_entity,
+                self._ledger_null,
+                self._ledger_halflife,
+                dequant_scale,
+                explain_args if explain_k > 0 else None,
+                score_fn=score_fn,
+                mesh=self.mesh,
+                explain_k=explain_k,
+                amount_col=spec.amount_col,
+                out_dtype=out_dtype,
+                has_dequant=dequant_scale is not None,
+                has_explain=explain_k > 0,
+            )
+            if explain_k > 0:
+                scores, eidx, eval_, self.shard_window, self.ledger = out
+                self.rows_seen += n_live
+                return scores, eidx, eval_
+            scores, self.shard_window, self.ledger = out
+            self.rows_seen += n_live
+        return scores

@@ -45,10 +45,26 @@ class FraudLogisticModel(FraudModelBase):
         feature_names: list[str],
         calibration: QuantCalibration | None = None,
         io_dtype: str | None = None,
+        ledger_spec=None,
+        ledger_state=None,
     ):
         self.params = params
         self.scaler = scaler
         self.feature_names = list(feature_names)
+        # ledger (stateful feature engine): a widened family's
+        # feature_names span base + K velocity columns; clients send the
+        # BASE schema and the fused flush computes the rest on device. The
+        # stamped table snapshot rides the model so a deploy/hot swap
+        # resumes entity history where training's replay left it.
+        self.ledger_spec = ledger_spec
+        self.ledger_state = ledger_state
+        if ledger_spec is not None and len(self.feature_names) != (
+            ledger_spec.n_features
+        ):
+            raise ValueError(
+                f"widened model carries {len(self.feature_names)} names but "
+                f"the ledger spec says {ledger_spec.n_features}"
+            )
         # quickwire: the serving wire format comes from SCORER_WIRE unless
         # the caller pins one. int8 needs calibration — the artifact-stamped
         # one when present (load() passes it through, so a hot-swapped
@@ -68,9 +84,37 @@ class FraudLogisticModel(FraudModelBase):
             io_dtype = "float32"
         self.calibration = calibration
         self._scorer = BatchScorer(
-            params, scaler, io_dtype=io_dtype, calibration=calibration
+            params, scaler, io_dtype=io_dtype, calibration=calibration,
+            ledger_spec=ledger_spec,
         )
         self._raw_explainer = None
+
+    @property
+    def base_feature_names(self) -> list[str]:
+        """The wire schema clients send (= feature_names for a stateless
+        family; the base prefix for a ledger-widened one)."""
+        if self.ledger_spec is None:
+            return self.feature_names
+        return self.feature_names[: self.ledger_spec.n_base]
+
+    def prepare_row(self, features) -> "np.ndarray":
+        """Clients of a widened model still send the BASE schema — the K
+        velocity features are device-computed, never client-supplied."""
+        if self.ledger_spec is None:
+            return super().prepare_row(features)
+        names = self.base_feature_names
+        if isinstance(features, dict):
+            missing = [n for n in names if n not in features]
+            if missing:
+                raise ValueError(f"missing features: {missing[:5]}")
+            vals = [float(features[n]) for n in names]
+        else:
+            vals = [float(v) for v in features]
+            if len(vals) != len(names):
+                raise ValueError(
+                    f"expected {len(names)} features, got {len(vals)}"
+                )
+        return np.asarray(vals, dtype=np.float32)
 
     # -- explainability ----------------------------------------------------
     def explainer(self, background_mean=None) -> LinearShapExplainer:
@@ -100,8 +144,28 @@ class FraudLogisticModel(FraudModelBase):
         return self._raw_explainer
 
     def explain_batch(self, x: np.ndarray) -> tuple[np.ndarray, float]:
+        x = np.asarray(x, np.float32)
+        if (
+            self.ledger_spec is not None
+            and x.shape[1] == self.ledger_spec.n_base
+        ):
+            # base-width input to a widened family (the async worker's
+            # backfill: the entity table lives in the serving process, not
+            # here) — explain through the null slot. The velocity columns'
+            # φ is then w′·(null − μ): the worker's consistency check skips
+            # ledger indices for exactly this reason.
+            x = np.concatenate(
+                [
+                    x,
+                    np.broadcast_to(
+                        self.ledger_spec.null_features,
+                        (x.shape[0], self.ledger_spec.null_features.shape[0]),
+                    ),
+                ],
+                axis=1,
+            )
         explainer = self.raw_explainer()
-        phi = np.asarray(linear_shap(explainer, np.asarray(x, np.float32)))
+        phi = np.asarray(linear_shap(explainer, x))
         return phi, float(explainer.expected_value)
 
     # -- persistence -------------------------------------------------------
@@ -117,6 +181,16 @@ class FraudLogisticModel(FraudModelBase):
             cal = derive_calibration(self.scaler)
         if cal is not None:
             save_calibration(directory, cal)
+        if self.ledger_spec is not None:
+            # stamp the entity-table snapshot + hash geometry beside the
+            # weights: the widened coef is meaningless without the spec
+            # (and the serving reloader rebinds BOTH on hot swap)
+            from fraud_detection_tpu.ledger.state import init_state, save_ledger
+
+            state = self.ledger_state
+            if state is None:
+                state = init_state(self.ledger_spec.slots)
+            save_ledger(directory, self.ledger_spec, state)
         if joblib_too:
             try:
                 export_joblib_artifacts(
@@ -129,9 +203,14 @@ class FraudLogisticModel(FraudModelBase):
     @classmethod
     def load(cls, directory: str) -> "FraudLogisticModel":
         params, scaler, feature_names = load_artifacts(directory)
+        from fraud_detection_tpu.ledger.state import load_ledger
+
+        ledger = load_ledger(directory)
+        spec, state = ledger if ledger is not None else (None, None)
         return cls(
             params, scaler, feature_names,
             calibration=load_calibration(directory),
+            ledger_spec=spec, ledger_state=state,
         )
 
     @classmethod

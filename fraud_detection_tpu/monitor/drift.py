@@ -345,6 +345,103 @@ def _fused_flush_quant_explain(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("score_fn", "explain_k", "amount_col", "out_dtype"),
+    donate_argnums=(0, 1),
+)
+def _fused_flush_ledger(
+    window: DriftWindow,
+    ledger,  # ledger.LedgerState — donated, like the window
+    x: jax.Array,  # (b, d_base) staged batch (wire codes on a quant wire)
+    valid: jax.Array,  # (b,) 1.0 for real rows, 0.0 for bucket padding
+    decay: jax.Array,  # () drift forgetting factor (live rows this batch)
+    feature_edges: jax.Array,  # (d_base + K, bins - 1) WIDENED edges
+    score_edges: jax.Array,
+    score_args,  # pytree: raw-space params over the WIDENED feature block
+    slot_idx: jax.Array,  # (b,) int32 ledger slot per row
+    fp: jax.Array,  # (b,) uint32 entity fingerprint (0 = none)
+    ts: jax.Array,  # (b,) f32 event timestamp
+    has_entity: jax.Array,  # (b,) f32 1.0 when the row carries an entity
+    null_features: jax.Array,  # (K,) features for entity-less rows
+    halflife_s: jax.Array,  # () f32 ledger decay half-life
+    dequant_scale=None,  # (d_base,) per-feature dequant scale (int8 wire)
+    explain_args=None,  # (coef (d_base+K,), background_mean) — lantern leg
+    *,
+    score_fn,  # static: module-level raw score body (ops/scorer)
+    explain_k: int = 0,  # static: reason codes per row (0 = no explain leg)
+    amount_col: int = -1,  # static: Amount column in the base row
+    out_dtype=jnp.float32,  # static: d2h return wire
+):
+    """The ledger flush program: per-entity velocity state read+update,
+    feature widening, scoring, (optional) top-k reason codes, AND the
+    drift-window fold — ONE donated device dispatch per shape bucket.
+
+    The stateful extension of the fastlane/quickwire/lantern family: the
+    hashed entity table (``ledger``) is donated through every flush exactly
+    like the drift window, the K velocity features come from the SAME
+    traced body training's replay materializes with
+    (``ledger/features._ledger_read_update`` — train/serve skew is
+    structurally impossible), and the widened ``[b, d_base + K]`` block
+    feeds scoring, the drift histograms (widened baseline edges — drift
+    monitoring covers the velocity features for free), and the explain leg
+    when ``explain_k > 0``. One program covers all four wire/explain
+    combos: a quant wire passes ``dequant_scale`` (codes dequantize
+    in-program before the ledger/concat — explicit-dequant scoring over
+    raw-space weights, the multiply shared with the histogram bin), and
+    lantern passes ``explain_args`` + ``explain_k``. Entity-less rows
+    (legacy clients) read the stamped null-profile features through the
+    reserved null path and leave the table bitwise untouched — so do
+    all-padding warmups. The body lives in :func:`_ledger_serving_body` —
+    ONE expression shared with the mesh shard twin, so the widening
+    sequence can never desync between single-device and N-shard (the
+    ``_fold_serving_batch`` lesson)."""
+    return _ledger_serving_body(
+        window, ledger, x, valid, decay, feature_edges, score_edges,
+        score_args, slot_idx, fp, ts, has_entity, null_features,
+        halflife_s, dequant_scale, explain_args,
+        score_fn=score_fn, explain_k=explain_k, amount_col=amount_col,
+        out_dtype=out_dtype,
+    )
+
+
+def _ledger_serving_body(
+    window, ledger, x, valid, decay, feature_edges, score_edges,
+    score_args, slot_idx, fp, ts, has_entity, null_features, halflife_s,
+    dequant_scale=None, explain_args=None,
+    *, score_fn, explain_k=0, amount_col=-1, out_dtype=jnp.float32,
+):
+    """The ONE stateful widening sequence: dequant → amount slice → ledger
+    read-update → concat → score → (explain) → drift fold. Traced by
+    ``_fused_flush_ledger`` AND the shard_map body in mesh/shardflush — a
+    change edited here reaches both at once, so the N-shard-bitwise-
+    matches-single-device contract holds by construction, not by keeping
+    two copies in sync."""
+    from fraud_detection_tpu.ledger.features import _ledger_read_update
+
+    xb = x.astype(jnp.float32)
+    if dequant_scale is not None:
+        xb = xb * dequant_scale
+    amount = xb[:, amount_col]
+    feats, new_ledger = _ledger_read_update(
+        ledger, slot_idx, fp, ts, amount, has_entity, null_features,
+        halflife_s,
+    )
+    xf = jnp.concatenate([xb, feats], axis=1)
+    scores = score_fn(score_args, xf).astype(jnp.float32)
+    new_window = _fold_serving_batch(
+        window, xf, scores, valid, decay, feature_edges, score_edges
+    )
+    if explain_k > 0:
+        idx, val = _topk_attributions(xf, explain_args, explain_k)
+        idx, val = _narrow_reasons(idx, val, xf.shape[1], out_dtype)
+        return (
+            _narrow_scores(scores, out_dtype), idx, val,
+            new_window, new_ledger,
+        )
+    return _narrow_scores(scores, out_dtype), new_window, new_ledger
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def _window_update(
     window: DriftWindow,
@@ -477,6 +574,13 @@ class DriftMonitor:
             profile.score_counts.shape[0],
         )
         self.rows_seen = 0  # monotonic (not decayed), host-side
+        # ledger: the per-entity velocity table (ledger/), bound when the
+        # served model is widened — donated through the same fused dispatch
+        # as the window, under the same lock
+        self.ledger = None
+        self.ledger_spec = None
+        self._ledger_null = None
+        self._ledger_halflife = None
         # decay is a function of the true row count; caching the device
         # scalar saves one host→device put per update on the ingest path
         self._decay_cache: dict[int, jax.Array] = {}
@@ -499,6 +603,50 @@ class DriftMonitor:
             self._decay_cache[n] = decay
         return decay
 
+    # -- ledger: the per-entity velocity table -----------------------------
+    def bind_ledger(self, spec, state=None) -> None:
+        """Attach (or rebind, on hot swap) the ledger table: the serving
+        flushes thereafter run the widened ``_fused_flush_ledger`` program.
+        ``state`` is a host snapshot (ledger_state.npz) or None for a
+        fresh table."""
+        from fraud_detection_tpu.ledger.state import device_state
+
+        with self._lock:
+            self.ledger_spec = spec
+            self.ledger = device_state(state, spec.slots)
+            self._ledger_null = jnp.asarray(spec.null_features)
+            self._ledger_halflife = jnp.float32(spec.halflife_s)
+
+    def ledger_snapshot(self):
+        """Host copy of the live table (materialized under the lock — the
+        next flush donates these buffers). The thing hot-swap stamping and
+        the chaos invariants read."""
+        from fraud_detection_tpu.ledger.state import LedgerState
+
+        with self._lock:
+            if self.ledger is None:
+                return None
+            return LedgerState(
+                *(np.asarray(leaf) for leaf in self._ledger_for_stats())
+            )
+
+    def ledger_stats(self) -> dict | None:
+        """Scrape-time ledger telemetry (occupancy, collisions, evictions);
+        None when no ledger is bound."""
+        from fraud_detection_tpu.ledger.features import ledger_stats
+
+        with self._lock:
+            if self.ledger is None:
+                return None
+            return ledger_stats(
+                self._ledger_for_stats(), self.ledger_spec.halflife_s
+            )
+
+    def _ledger_for_stats(self):
+        """The table ``ledger_stats``/snapshot reads — the mesh subclass
+        merges its per-shard sub-tables here. Called under the lock."""
+        return self.ledger
+
     def fused_flush(
         self,
         x: jax.Array,
@@ -511,17 +659,21 @@ class DriftMonitor:
         out_dtype=jnp.float32,
         explain_args=None,
         explain_k: int = 0,
+        ledger_rows=None,
     ):
         """Score one staged batch AND fold it into the drift window in ONE
         device dispatch (the fastlane hot path — ``_fused_flush``; the
         quickwire ``_fused_flush_quant`` when ``dequant_scale`` rides along
         for a quantized wire; the lantern ``_fused_flush_explain`` /
         ``_fused_flush_quant_explain`` when ``explain_k > 0`` adds the
-        top-k reason-code leg). ``x`` and ``valid`` are already
-        device-resident and bucket-padded; returns the device score vector
-        (padded, in the ``out_dtype`` return wire; caller slices to the
-        live rows and decodes) — or, with the explain leg, the
-        ``(scores, reason_idx, reason_val)`` device triple.
+        top-k reason-code leg; the ledger ``_fused_flush_ledger`` when a
+        ledger is bound and ``ledger_rows`` — the ``(slot_idx, fp, ts,
+        has_entity)`` device quadruple — rides along, widening the feature
+        block with the per-entity velocity aggregates). ``x`` and ``valid``
+        are already device-resident and bucket-padded; returns the device
+        score vector (padded, in the ``out_dtype`` return wire; caller
+        slices to the live rows and decodes) — or, with the explain leg,
+        the ``(scores, reason_idx, reason_val)`` device triple.
 
         The lock covers only {read window → dispatch → store new window}:
         dispatch is asynchronous, so the critical section is microseconds
@@ -531,6 +683,12 @@ class DriftMonitor:
         output future."""
         # graftcheck: hot-path
         decay = self._decay_for(n_live)
+        if ledger_rows is not None and self.ledger is not None:
+            return self._ledger_flush(
+                x, valid, decay, n_live, score_args, score_fn,
+                dequant_scale, out_dtype, explain_args, explain_k,
+                ledger_rows,
+            )
         explain_k = min(int(explain_k), int(x.shape[1]))  # k ≥ d clamps to d
         with self._lock:
             if explain_k > 0 and explain_args is not None:
@@ -597,6 +755,49 @@ class DriftMonitor:
             self.rows_seen += n_live
         return scores
 
+    def _ledger_flush(
+        self, x, valid, decay, n_live, score_args, score_fn,
+        dequant_scale, out_dtype, explain_args, explain_k, ledger_rows,
+    ):
+        """Dispatch the widened stateful flush — window AND ledger donated
+        through one program (``_fused_flush_ledger``). Same critical-
+        section discipline as the stateless path."""
+        # graftcheck: hot-path
+        slot_idx, fp, ts, has_entity = ledger_rows
+        spec = self.ledger_spec
+        # k clamps against the WIDENED width the explain leg attributes
+        explain_k = min(int(explain_k), int(x.shape[1]) + len(spec.null_features))
+        with self._lock:
+            out = _fused_flush_ledger(
+                self.window,
+                self.ledger,
+                x,
+                valid,
+                decay,
+                self._feature_edges,
+                self._score_edges,
+                score_args,
+                slot_idx,
+                fp,
+                ts,
+                has_entity,
+                self._ledger_null,
+                self._ledger_halflife,
+                dequant_scale,
+                explain_args if explain_k > 0 else None,
+                score_fn=score_fn,
+                explain_k=explain_k if explain_args is not None else 0,
+                amount_col=spec.amount_col,
+                out_dtype=out_dtype,
+            )
+            if explain_k > 0 and explain_args is not None:
+                scores, eidx, eval_, self.window, self.ledger = out
+                self.rows_seen += n_live
+                return scores, eidx, eval_
+            scores, self.window, self.ledger = out
+            self.rows_seen += n_live
+        return scores
+
     def warm_fused(
         self, scorer, bucket: int, out_dtype=jnp.float32, explain_k: int = 0
     ) -> None:
@@ -616,6 +817,21 @@ class DriftMonitor:
             slot.f32[:] = 0.0
             hx = scorer._encode_slot(slot)
             slot.valid[:] = 0.0
+            ledger_rows = None
+            if self.ledger is not None and getattr(spec, "ledger", None):
+                # the ledger program warms through the same all-padding
+                # discipline: has_entity = 0 everywhere scatter-adds exact
+                # zeros and scatter-maxes a 0 anchor, so the entity table
+                # is bitwise unchanged while the executable compiles
+                slot.ensure_ledger()
+                slot.ls[:] = 0
+                slot.lf[:] = 0
+                slot.lt[:] = 0.0
+                slot.lh[:] = 0.0
+                ledger_rows = (
+                    jnp.asarray(slot.ls), jnp.asarray(slot.lf),
+                    jnp.asarray(slot.lt), jnp.asarray(slot.lh),
+                )
             out = self.fused_flush(
                 jnp.asarray(hx), jnp.asarray(slot.valid), 0,
                 spec.score_args, spec.score_fn,
@@ -624,6 +840,7 @@ class DriftMonitor:
                 out_dtype=out_dtype,
                 explain_args=spec.explain_args if explain_k else None,
                 explain_k=explain_k,
+                ledger_rows=ledger_rows,
             )
             jax.block_until_ready(out)
         finally:
@@ -638,6 +855,28 @@ class DriftMonitor:
         x = np.asarray(x, np.float32)
         if x.ndim == 1:
             x = x[None, :]
+        if (
+            self.ledger_spec is not None
+            and x.shape[1] == self.ledger_spec.n_base
+        ):
+            # base-width rows into a WIDENED window (feedback replays, the
+            # split path): pad with the stamped null-profile features so
+            # the histogram shapes line up — for calibration_only batches
+            # the feature weights are zero anyway, and for live batches
+            # this is exactly the null-slot semantics serving applies
+            x = np.concatenate(
+                [
+                    x,
+                    np.broadcast_to(
+                        self.ledger_spec.null_features,
+                        (
+                            x.shape[0],
+                            self.ledger_spec.null_features.shape[0],
+                        ),
+                    ),
+                ],
+                axis=1,
+            ).astype(np.float32)
         scores = np.asarray(scores, np.float32).reshape(-1)
         n = x.shape[0]
         b = _bucket(n, self.min_bucket)

@@ -40,8 +40,16 @@ class ShadowScorer:
         threshold: float = 0.5,
         halflife_rows: float | None = None,
         seed: int = 0,
+        explainer=None,
     ):
         self._scorer = scorer
+        # lantern × shadow: the challenger's raw-space linear-SHAP params
+        # ``(coef, background_mean)`` — when present AND the champion's
+        # serve-time top-k indices ride along with a sampled batch, the
+        # window tracks reason-code divergence (mean 1 − Jaccard over the
+        # index sets): how differently the challenger would EXPLAIN the
+        # same traffic. Cheap host-side set math on already-fetched codes.
+        self._explainer = explainer
         self.sample_rate = float(
             sample_rate
             if sample_rate is not None
@@ -60,23 +68,35 @@ class ShadowScorer:
         self._rows = 0.0  # decayed
         self._disagree = 0.0  # decayed
         self._delta = 0.0  # decayed
+        self._reason_rows = 0.0  # decayed rows with reason comparisons
+        self._reason_div = 0.0  # decayed Σ (1 − Jaccard)
         self.batches_seen = 0
         self.batches_sampled = 0
 
-    def swap_scorer(self, scorer) -> None:
+    def swap_scorer(self, scorer, explainer=None) -> None:
         """Atomically replace the challenger params (the conductor's hot
         swap): one reference store between batches, then a window reset —
         disagreement/PSI accumulated against the OLD challenger would
         misjudge the new one."""
         self._scorer = scorer
+        self._explainer = explainer
         self._score_counts = np.zeros_like(self._base_counts)
         self._rows = 0.0
         self._disagree = 0.0
         self._delta = 0.0
+        self._reason_rows = 0.0
+        self._reason_div = 0.0
 
-    def maybe_observe(self, rows: np.ndarray, champion_scores: np.ndarray) -> bool:
+    def maybe_observe(
+        self,
+        rows: np.ndarray,
+        champion_scores: np.ndarray,
+        champion_reasons=None,
+    ) -> bool:
         """Sample-and-score one batch; returns True when the challenger ran.
-        Called from the watchtower ingest thread, never the request path."""
+        Called from the watchtower ingest thread, never the request path.
+        ``champion_reasons`` is the (n, k) matrix of serve-time top-k
+        reason-code indices when the fused explain leg rode the flush."""
         self.batches_seen += 1
         if self._rng.random() >= self.sample_rate:
             return False
@@ -102,8 +122,55 @@ class ShadowScorer:
             minlength=self._base_counts.shape[0],
         ).astype(np.float64)
         self._score_counts = self._score_counts * decay + hist
+        if champion_reasons is not None and self._explainer is not None:
+            champ_idx = np.asarray(champion_reasons)
+            k = champ_idx.shape[1]
+            if k > 0 and champ_idx.shape[0] == n:
+                coef, mu = self._explainer[0], self._explainer[1]
+                nulls = (
+                    self._explainer[2] if len(self._explainer) > 2 else None
+                )
+                r = np.asarray(rows, np.float64)
+                if r.shape[1] < coef.shape[0]:
+                    # WIDENED challenger, base-width monitor rows: explain
+                    # through the challenger's null slot (its worker-
+                    # backfill view of the same row); widths that can't
+                    # reconcile skip the comparison, never the sample
+                    if (
+                        nulls is not None
+                        and r.shape[1] + nulls.shape[0] == coef.shape[0]
+                    ):
+                        r = np.concatenate(
+                            [r, np.broadcast_to(nulls, (n, nulls.shape[0]))],
+                            axis=1,
+                        )
+                    else:
+                        r = None
+                if r is not None:
+                    self._fold_reasons(r, coef, mu, champ_idx, k, n, decay)
         self.batches_sampled += 1
         return True
+
+    def _fold_reasons(self, r, coef, mu, champ_idx, k, n, decay) -> None:
+        """Fold one sampled batch's reason-code comparison into the decayed
+        divergence window (mean 1 − Jaccard over the top-k index sets)."""
+        phi = coef[None, :] * (r - mu[None, :])
+        # the challenger's top-k by signed attribution, matching
+        # ops/linear_shap.topk_reasons' ranking; argsort is stable
+        # so ties resolve toward the lower index like lax.top_k
+        ch_idx = np.argsort(-phi, axis=1, kind="stable")[:, :k]
+        inter = np.asarray(
+            [
+                len(set(a.tolist()) & set(b.tolist()))
+                for a, b in zip(champ_idx, ch_idx)
+            ],
+            np.float64,
+        )
+        jaccard = inter / (2 * k - inter)
+        self._reason_rows = self._reason_rows * decay + n
+        self._reason_div = self._reason_div * decay + float(
+            np.sum(1.0 - jaccard)
+        )
 
     def stats(self) -> dict:
         rows = max(self._rows, 1e-9)
@@ -115,4 +182,9 @@ class ShadowScorer:
             "disagreement": self._disagree / rows,
             "mean_abs_delta": self._delta / rows,
             "score_psi": psi_np(self._score_counts, self._base_counts),
+            "reason_divergence": (
+                self._reason_div / self._reason_rows
+                if self._reason_rows > 0
+                else None
+            ),
         }

@@ -49,6 +49,37 @@ RECOMMENDATIONS = (
 )
 
 
+def _challenger_explainer(challenger):
+    """The challenger's raw-space linear-SHAP ``(coef, background_mean,
+    null_features)`` numpy triple for the shadow reason-code comparison —
+    ``null_features`` is the ledger null vector for a WIDENED challenger
+    (the shadow rows are base-width; the comparison explains them through
+    the challenger's null slot, exactly like its worker backfill would) or
+    None for a stateless family. Returns None entirely for families
+    without a cheap host-side explainer (the divergence gauge then just
+    stays unset)."""
+    import numpy as np
+
+    try:
+        ex = challenger.raw_explainer()
+        spec = getattr(challenger, "ledger_spec", None)
+        return (
+            np.asarray(ex.coef, np.float64),
+            np.asarray(ex.background_mean, np.float64),
+            (
+                np.asarray(spec.null_features, np.float64)
+                if spec is not None
+                else None
+            ),
+        )
+    except Exception:
+        log.debug(
+            "challenger has no linear raw explainer — shadow reason "
+            "divergence disabled", exc_info=True,
+        )
+        return None
+
+
 @dataclass(frozen=True)
 class Thresholds:
     psi: float
@@ -120,6 +151,7 @@ class Watchtower:
                 profile,
                 sample_rate=sample_rate,
                 halflife_rows=halflife_rows,
+                explainer=_challenger_explainer(challenger),
             )
             if challenger is not None
             else None
@@ -133,6 +165,9 @@ class Watchtower:
         self._action_sender = action_sender
         self._retrain_latched = False
         self._action_latched: str | None = None
+        # ledger counter deltas: the device accumulates cumulative totals;
+        # scrape time increments the prometheus Counters by the delta
+        self._ledger_counts = {"hash_collisions": 0.0, "evictions": 0.0}
         # a /metrics scrape and a /monitor/status call can evaluate status()
         # concurrently (separate to_thread workers) — the latch check/set
         # must be atomic or one episode enqueues duplicate retrain tasks
@@ -166,7 +201,7 @@ class Watchtower:
 
     def observe(
         self, rows, scores, labels=None, calibration_only=False,
-        drift_done=False,
+        drift_done=False, reasons=None,
     ) -> bool:
         """Queue one scored batch for monitoring. Non-blocking; returns
         False when the backlog bound forced a drop (counted).
@@ -180,10 +215,16 @@ class Watchtower:
         was already folded inside the scoring dispatch itself
         (drift.fused_flush), so the ingest thread only runs the sampled
         shadow comparison — ``rows`` may be None when no challenger is
-        bound (see :meth:`wants_rows`)."""
+        bound (see :meth:`wants_rows`).
+
+        ``reasons`` (lantern × shadow): the champion's serve-time top-k
+        reason-code INDICES for this batch, when the fused explain leg
+        produced them — the shadow scorer compares them against the
+        challenger's top-k (Jaccard) into
+        ``watchtower_shadow_reason_divergence``."""
         try:
             self._queue.put_nowait(
-                (rows, scores, labels, calibration_only, drift_done)
+                (rows, scores, labels, calibration_only, drift_done, reasons)
             )
         except queue.Full:
             metrics.watchtower_batches_dropped.inc()
@@ -196,7 +237,8 @@ class Watchtower:
             try:
                 if item is None or self._stop:
                     return
-                rows, scores, labels, calibration_only, drift_done = item
+                (rows, scores, labels, calibration_only, drift_done,
+                 reasons) = item
                 if not drift_done:
                     self.drift.update(
                         rows, scores, labels, calibration_only=calibration_only
@@ -206,7 +248,7 @@ class Watchtower:
                     self.shadow is not None
                     and rows is not None
                     and not calibration_only
-                    and self.shadow.maybe_observe(rows, scores)
+                    and self.shadow.maybe_observe(rows, scores, reasons)
                 ):
                     metrics.watchtower_shadow_batches.inc()
             except Exception:
@@ -284,6 +326,12 @@ class Watchtower:
             metrics.watchtower_shadow_score_psi.set(
                 sh["score_psi"] if shadow_warm else 0.0
             )
+            rd = sh.get("reason_divergence")
+            metrics.watchtower_shadow_reason_divergence.set(
+                rd if (rd is not None and shadow_warm) else 0.0
+            )
+
+        ledger = self._refresh_ledger_metrics()
 
         return {
             "enabled": True,
@@ -292,6 +340,7 @@ class Watchtower:
             "flags": flags,
             "drift": d,
             "shadow": sh,
+            "ledger": ledger,
             "challenger_source": self.challenger_source,
             "thresholds": {
                 "psi": thr.psi,
@@ -301,6 +350,28 @@ class Watchtower:
                 "min_rows": thr.min_rows,
             },
         }
+
+    def _refresh_ledger_metrics(self) -> dict | None:
+        """Export the entity-table telemetry (ledger/): the occupancy gauge
+        plus collision/eviction Counters advanced by the device totals'
+        delta since the last scrape. None when no ledger is bound."""
+        stats = getattr(self.drift, "ledger_stats", lambda: None)()
+        if stats is None:
+            metrics.ledger_active.set(0)
+            return None
+        metrics.ledger_active.set(1)
+        metrics.ledger_slot_occupancy.set(stats["slot_occupancy"])
+        for key, counter in (
+            ("hash_collisions", metrics.ledger_hash_collisions),
+            ("evictions", metrics.ledger_evictions),
+        ):
+            delta = stats[key] - self._ledger_counts[key]
+            if delta > 0:
+                counter.inc(delta)
+                self._ledger_counts[key] = stats[key]
+            elif delta < 0:  # table rebind/reset — restart the baseline
+                self._ledger_counts[key] = stats[key]
+        return stats
 
     def _maybe_trigger_retrain(self, recommendation: str, d: dict) -> None:
         with self._retrain_lock:
@@ -367,19 +438,35 @@ class Watchtower:
             log.error("conductor action enqueue failed: %s", e)
 
     # -- hot swap (driven by lifecycle.ModelReloader) -----------------------
-    def rebind_champion(self, profile) -> None:
+    def rebind_champion(self, profile, ledger=None) -> None:
         """A promotion went live: point drift monitoring at the NEW
         champion's baseline profile with a fresh window (the old window's
         evidence was accumulated against the old baseline). When the new
         artifacts carry no profile the old baseline keeps serving — stale
-        monitoring beats none."""
+        monitoring beats none.
+
+        ``ledger`` is the promoted artifact's ``(LedgerSpec, state)`` pair
+        when the new champion is widened: the entity table rebinds WITH the
+        model (the widened weights were trained against the replayed
+        history that snapshot ends on), resetting the collision/eviction
+        counter baselines."""
         if profile is None:
             log.warning(
                 "promoted model has no baseline profile — drift window "
                 "keeps the previous baseline"
             )
+            if ledger is not None:
+                # the TABLE must still follow the model: serving the new
+                # widened weights against the old table/spec would mismatch
+                # the history the challenger was replayed on (the reloader
+                # already refuses cross-width swaps, so the existing
+                # window's widened edges still fit)
+                self._bind_ledger(*ledger)
             return
         self.drift = self._make_drift(profile)
+        self._ledger_counts = {"hash_collisions": 0.0, "evictions": 0.0}
+        if ledger is not None:
+            self._bind_ledger(*ledger)
         if self.shadow is not None:
             # the old challenger IS usually the new champion — comparing a
             # model to itself reads as perfect agreement and would mask a
@@ -388,6 +475,14 @@ class Watchtower:
             self.shadow = None
             self.challenger_source = None
         log.warning("watchtower rebound to the promoted champion's baseline")
+
+    def _bind_ledger(self, spec, state) -> None:
+        self._ledger_counts = {"hash_collisions": 0.0, "evictions": 0.0}
+        self.drift.bind_ledger(spec, state)
+        log.warning(
+            "ledger rebound with the promoted champion "
+            "(%d slots, halflife %.0fs)", spec.slots, spec.halflife_s,
+        )
 
     def rebind_challenger(self, challenger, source: str | None) -> None:
         """@shadow alias changed: swap the challenger scorer (fresh shadow
@@ -398,15 +493,17 @@ class Watchtower:
             log.info("shadow challenger unbound")
             return
         profile = self.drift.profile
+        explainer = _challenger_explainer(challenger)
         if self.shadow is None:
             self.shadow = ShadowScorer(
                 challenger.scorer,
                 profile,
                 sample_rate=self._sample_rate,
                 halflife_rows=self._halflife_rows,
+                explainer=explainer,
             )
         else:
-            self.shadow.swap_scorer(challenger.scorer)
+            self.shadow.swap_scorer(challenger.scorer, explainer=explainer)
         self.challenger_source = source
         log.warning("shadow challenger rebound to %s", source)
 
@@ -499,6 +596,20 @@ def build_watchtower(
         action_sender=action_sender,
         mesh=mesh,
     )
+    if getattr(model, "ledger_spec", None) is not None:
+        # a widened family: bind the stamped entity table so the fused
+        # flush runs the stateful ledger program from the first batch
+        wt.drift.bind_ledger(
+            model.ledger_spec, getattr(model, "ledger_state", None)
+        )
+        metrics.ledger_active.set(1)
+        log.info(
+            "ledger bound: %d slots, halflife %.0fs, %d base + %d velocity "
+            "features",
+            model.ledger_spec.slots, model.ledger_spec.halflife_s,
+            model.ledger_spec.n_base,
+            model.ledger_spec.n_features - model.ledger_spec.n_base,
+        )
     log.info(
         "watchtower active: baseline over %d rows, challenger=%s",
         profile.n_rows,

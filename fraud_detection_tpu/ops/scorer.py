@@ -105,6 +105,17 @@ class FusedSpec(NamedTuple):
     without a fused explain program (the micro-batcher then serves scores
     fused but demotes explanations to the async worker path, loudly:
     ``scorer_explain_fused 0`` + the ExplainUnfused alert).
+
+    ``ledger`` (the stateful feature engine) is the scorer's
+    :class:`~fraud_detection_tpu.ledger.state.LedgerSpec` when the model
+    family is WIDENED — its weights cover base + K velocity features and
+    the fused flush must run the ledger program
+    (``monitor/drift._fused_flush_ledger``), reading/updating the donated
+    entity table and concatenating the velocity block before scoring. A
+    widened spec always carries RAW-space ``score_args`` (the ledger
+    features are computed raw; on the int8 wire the program
+    explicit-dequants the codes — the multiply is shared with the
+    histogram bin, quickwire's pallas discipline).
     """
 
     score_fn: Callable
@@ -113,6 +124,7 @@ class FusedSpec(NamedTuple):
     score_codes: bool = True
     wire: str = "float32"
     explain_args: Any = None
+    ledger: Any = None
 
 
 #: d2h score wire formats: name → (numpy dtype, jax dtype, bytes/row).
@@ -190,7 +202,7 @@ class _StagingSlot:
 
     __slots__ = (
         "bucket", "f32", "io", "scratch", "valid", "scores", "ei", "ev",
-        "pool",
+        "ls", "lf", "lt", "lh", "pool",
     )
 
     def __init__(self, bucket: int, n_features: int, io_dtype, pool=None):
@@ -216,6 +228,14 @@ class _StagingSlot:
         # flush (ensure_explain) and recycled with the slot thereafter
         self.ei: np.ndarray | None = None  # (bucket, k) int32 reason indices
         self.ev: np.ndarray | None = None  # (bucket, k) f32 reason values
+        # ledger staging (stateful feature engine): per-row slot index,
+        # entity fingerprint, timestamp, has-entity mask — created on the
+        # first ledger-widened flush (ensure_ledger) and recycled with the
+        # slot thereafter, same discipline as the explain buffers
+        self.ls: np.ndarray | None = None  # (bucket,) int32 table slot
+        self.lf: np.ndarray | None = None  # (bucket,) uint32 fingerprint
+        self.lt: np.ndarray | None = None  # (bucket,) f32 event timestamp
+        self.lh: np.ndarray | None = None  # (bucket,) f32 has-entity mask
         self.pool = pool  # owning StagingPool — explain allocations count there
 
     def ensure_explain(self, k: int) -> None:
@@ -232,6 +252,20 @@ class _StagingSlot:
                     self.pool.allocations += 1
             self.ei = np.zeros((self.bucket, k), np.int32)
             self.ev = np.zeros((self.bucket, k), np.float32)
+
+    def ensure_ledger(self) -> None:
+        """Materialize the per-row ledger staging buffers (slot index /
+        fingerprint / timestamp / has-entity). First-flush-only, counted in
+        the pool's ``allocations`` like the explain buffers — a regression
+        reallocating them per flush trips the zero-alloc bench gate."""
+        if self.ls is None:
+            if self.pool is not None:
+                with self.pool._lock:
+                    self.pool.allocations += 1
+            self.ls = np.zeros((self.bucket,), np.int32)
+            self.lf = np.zeros((self.bucket,), np.uint32)
+            self.lt = np.zeros((self.bucket,), np.float32)
+            self.lh = np.zeros((self.bucket,), np.float32)
 
 
 class StagingPool:
@@ -302,12 +336,19 @@ class _BucketedScorer:
         return None
 
     @property
+    def staging_features(self) -> int:
+        """Width of the staged (client-sent) rows: the BASE schema for a
+        ledger-widened scorer — the K velocity columns are computed on
+        device, they never ride the wire."""
+        return getattr(self, "n_base_features", self.n_features)
+
+    @property
     def staging(self) -> StagingPool:
         """Lazy per-scorer staging pool (per-bucket reusable host buffers)."""
         pool = getattr(self, "_staging", None)
         if pool is None:
             pool = self._staging = StagingPool(
-                self.n_features, self._io_np_dtype
+                self.staging_features, self._io_np_dtype
             )
         return pool
 
@@ -329,12 +370,31 @@ class _BucketedScorer:
         slot.valid[n:] = 0.0
         return self._encode_slot(slot)
 
+    def stage_rows_placed(self, slot: _StagingSlot, rows: list, positions) -> np.ndarray:
+        """Placement staging for the sharded ledger flush: each row lands at
+        its hash-mod-shard position (ledger/placement.shard_placement) so a
+        device shard only sees entities whose table slots it owns. Per-row
+        copies into the preallocated slot buffers — no fresh batch arrays,
+        same zero-alloc contract as :meth:`stage_rows`."""
+        # graftcheck: hot-path
+        slot.f32[:] = 0.0
+        slot.valid[:] = 0.0
+        for r, p in zip(rows, positions):
+            slot.f32[p] = r
+            slot.valid[p] = 1.0
+        return self._encode_slot(slot)
+
     def warmup(self, max_bucket: int = 4096) -> None:
         """Pre-compile the bucket ladder so first requests don't pay XLA
-        compile latency."""
+        compile latency. A ledger-widened scorer warms BOTH widths: the
+        base schema (what a split/solo serving path scores through the
+        null-slot fold) and the widened block (what the gate/holdout
+        evaluation scores)."""
+        widths = {self.n_features, self.staging_features}
         b = self.min_bucket
         while b <= max_bucket:
-            self.predict_proba(np.zeros((b, self.n_features), np.float32))
+            for d in widths:
+                self.predict_proba(np.zeros((b, d), np.float32))
             b *= 2
 
     def _pad(self, x: np.ndarray) -> np.ndarray:
@@ -429,6 +489,7 @@ class BatchScorer(_BucketedScorer):
         io_dtype: str = "float32",
         int8_sigma_range: float | None = None,
         calibration: QuantCalibration | None = None,
+        ledger_spec=None,
     ):
         folded = fold_scaler_into_linear(params, scaler)
         self.coef = jnp.asarray(folded.coef, dtype=jnp.float32)
@@ -437,6 +498,19 @@ class BatchScorer(_BucketedScorer):
         self._raw_coef = self.coef
         self.intercept = jnp.asarray(folded.intercept, dtype=jnp.float32)
         self.n_features = int(self.coef.shape[0])
+        # ledger (stateful feature engine): a widened family's weights span
+        # base + K velocity features; clients still send base rows, the
+        # fused flush computes the velocity block on device
+        self.ledger_spec = ledger_spec
+        self.n_base_features = (
+            ledger_spec.n_base if ledger_spec is not None else self.n_features
+        )
+        if ledger_spec is not None and ledger_spec.n_features != self.n_features:
+            raise ValueError(
+                f"ledger spec widens {ledger_spec.n_base} → "
+                f"{ledger_spec.n_features} features but the params cover "
+                f"{self.n_features}"
+            )
         # lantern: the fused explain leg's raw-space linear-SHAP params —
         # the scaler-folded coef over raw inputs with the scaler mean as
         # background (φⱼ = w′ⱼ·(xⱼ − μⱼ)), exactly what
@@ -481,9 +555,17 @@ class BatchScorer(_BucketedScorer):
                 calibration = derive_calibration(scaler, int8_sigma_range)
             self.calibration = calibration
             self._quant_scale = np.asarray(calibration.scale, np.float32)
+            if ledger_spec is not None:
+                # the wire carries BASE columns only — a widened scaler's
+                # calibration slices to the base schema, and the scale is
+                # NOT folded into the weights (the ledger program scores
+                # the explicit-dequant widened block with raw-space coef —
+                # the dequant multiply is shared with the histogram bin)
+                self._quant_scale = self._quant_scale[: self.n_base_features]
             self._inv_quant_scale = (1.0 / self._quant_scale).astype(np.float32)
             self._dequant_scale = jnp.asarray(self._quant_scale)
-            self.coef = self.coef * self._dequant_scale
+            if ledger_spec is None:
+                self.coef = self.coef * self._dequant_scale
             self._io_np_dtype = np.int8
         elif io_dtype == "bfloat16":
             self._io_np_dtype = _np_bfloat16()
@@ -492,8 +574,32 @@ class BatchScorer(_BucketedScorer):
         from fraud_detection_tpu.ops.pallas_kernels import pallas_enabled
 
         self._use_pallas = pallas_enabled()
+        # null-slot fold (ledger): entity-less rows score with the stamped
+        # baseline-mean velocity features, which for a linear family fold
+        # EXACTLY into the intercept — the reserved null slot costs zero
+        # device compute and zero extra executables
+        self._null_coef = None
+        self._null_intercept = None
+        if ledger_spec is not None:
+            base_raw = self._raw_coef[: self.n_base_features]
+            ledger_raw = self._raw_coef[self.n_base_features:]
+            nf = jnp.asarray(ledger_spec.null_features, jnp.float32)
+            self._null_intercept = self.intercept + jnp.dot(nf, ledger_raw)
+            self._null_coef = (
+                base_raw * self._dequant_scale
+                if self._quant_scale is not None
+                else base_raw
+            )
 
     def _prepare_host(self, x: np.ndarray) -> np.ndarray:
+        if (
+            self.ledger_spec is not None
+            and x.shape[1] == self.n_features
+        ):
+            # an already-widened block (training replay / gate slices)
+            # bypasses the wire encode: the velocity columns never ship on
+            # a narrow wire, they are raw f32 by construction
+            return x.astype(np.float32, copy=False)
         if self._quant_scale is None:
             return x.astype(self._io_np_dtype, copy=False)
         # single temporary + in-place rint/clip: this runs per chunk on the
@@ -515,6 +621,29 @@ class BatchScorer(_BucketedScorer):
         return slot.io
 
     def fused_spec(self) -> FusedSpec:
+        if self.ledger_spec is not None:
+            # ledger: the widened stateful flush. Always raw-space params
+            # (the velocity block is computed raw in-program); a quant wire
+            # rides the explicit-dequant leg — dequant_scale covers the
+            # BASE columns the codes encode.
+            fn = (
+                _raw_score_linear_pallas
+                if self._use_pallas
+                else _raw_score_linear
+            )
+            return FusedSpec(
+                fn,
+                (self._raw_coef, self.intercept),
+                dequant_scale=(
+                    self._dequant_scale
+                    if self._quant_scale is not None
+                    else None
+                ),
+                score_codes=False,
+                wire=self.io_dtype,
+                explain_args=(self._raw_coef, self._explain_mean),
+                ledger=self.ledger_spec,
+            )
         if self._quant_scale is not None:
             # quickwire: the int8 wire ships quantization CODES, and the
             # fused dequant·score·drift program handles them in-program —
@@ -553,6 +682,19 @@ class BatchScorer(_BucketedScorer):
     def _score_padded(self, x: jax.Array, out_dtype=jnp.float32) -> jax.Array:
         # bf16/int8-IO inputs ship narrow; the f32 upcast happens inside the
         # jitted kernels so it compiles into the same executable.
+        if self.ledger_spec is not None:
+            if int(x.shape[1]) == self.n_base_features:
+                # split/solo serving of a widened family: entity-less
+                # scoring through the null-slot intercept fold (documented,
+                # counted by the micro-batcher — ledger features require
+                # the fused flush)
+                return _score(
+                    self._null_coef, self._null_intercept, x,
+                    out_dtype=out_dtype,
+                )
+            return _score(
+                self._raw_coef, self.intercept, x, out_dtype=out_dtype
+            )
         if self._use_pallas:
             from fraud_detection_tpu.ops.pallas_kernels import fused_score
 

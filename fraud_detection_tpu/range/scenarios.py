@@ -42,6 +42,11 @@ The suite (``run_scenario(name)``):
                           holds, EVERY scored row carries its k reason
                           codes, the kill sheds load without dropping the
                           explain output
+``poison_entity_state``   one entity hammered with NaN/extreme amounts via
+                          the ``ledger.update`` injection point; the poison
+                          clamp bounds the victim slot, every other
+                          entity's aggregates stay bitwise-unaffected,
+                          scores stay finite, p99 holds
 ========================  ==================================================
 """
 
@@ -1144,6 +1149,220 @@ def scenario_explain_under_burst(
     return result
 
 
+def build_ledger_model(seed: int = 7, n_base: int = 2400):
+    """A trained-for-real WIDENED champion (ledger velocity features
+    replayed through the serving body) + its widened profile — the stack
+    the stateful-feature scenarios serve."""
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.ledger import (
+        LEDGER_FEATURE_NAMES,
+        LedgerSpec,
+        materialize_features,
+    )
+    from fraud_detection_tpu.models.logistic import FraudLogisticModel
+    from fraud_detection_tpu.monitor.baseline import build_baseline_profile
+    from fraud_detection_tpu.ops.logistic import logistic_fit_lbfgs
+    from fraud_detection_tpu.ops.scaler import scaler_fit, scaler_transform
+
+    rng = np.random.default_rng(seed)
+    w_true = rng.standard_normal(D).astype(np.float32)
+    x, y = _make_rows(n_base, rng, w_true)
+    x[:, -1] = np.abs(x[:, -1]) * 40.0  # a plausible Amount column
+    spec0 = LedgerSpec(
+        n_base=D, slots=1024, halflife_s=900.0, amount_col=-1,
+        null_features=np.zeros(4, np.float32),
+    )
+    ents = [f"card-{i % 60}" for i in range(n_base)]
+    ts = np.arange(1.0, n_base + 1.0, dtype=np.float32)
+    feats, state = materialize_features(spec0, x, ents, ts)
+    import dataclasses as _dc
+
+    spec = _dc.replace(spec0, null_features=feats.mean(axis=0))
+    xw = np.concatenate([x, feats], axis=1).astype(np.float32)
+    scaler = scaler_fit(xw)
+    params = logistic_fit_lbfgs(scaler_transform(scaler, xw), y, max_iter=100)
+    names = KAGGLE + list(LEDGER_FEATURE_NAMES)
+    model = FraudLogisticModel(
+        params, scaler, names, ledger_spec=spec, ledger_state=state
+    )
+    scores = np.asarray(model.scorer.predict_proba(xw[:1024]))
+    profile = build_baseline_profile(xw, scores, feature_names=names)
+    del jnp
+    return RangeModel(model, profile, w_true, x, y), spec, state, float(ts.max())
+
+
+def scenario_poison_entity_state(
+    seed: int = 2026, n_batches: int = 24, batch: int = 64,
+) -> ScenarioResult:
+    """One entity hammered with NaN/extreme amounts through the
+    ``ledger.update`` injection point (a FraudRing-style mule account gone
+    adversarial): the traced body's poison clamp must bound the victim
+    slot's aggregates, every OTHER entity's aggregates must stay BITWISE
+    untouched relative to a clean run, scores stay finite, and the flush
+    latency holds.
+
+    Determinism by construction: both runs drive the REAL micro-batcher
+    flush body (``MicroBatcher._flush_device`` — staging, the injection
+    point, the fused stateful dispatch) synchronously over identical fixed
+    batches, so the only difference between them is the poison itself."""
+    from fraud_detection_tpu.ledger.state import entity_fingerprint
+    from fraud_detection_tpu.service.microbatch import MicroBatcher
+
+    rmodel, spec, state0, t0 = build_ledger_model(seed=seed)
+    target_fp = entity_fingerprint("mule-1")
+    rng = np.random.default_rng(seed)
+    # the campaign: background entities + the hammered mule account
+    batches = []
+    t = t0 + 10.0
+    for b in range(n_batches):
+        rows = rng.standard_normal((batch, D)).astype(np.float32)
+        rows[:, -1] = np.abs(rows[:, -1]) * 40.0
+        ents = []
+        for i in range(batch):
+            if i % 4 == 0:
+                ents.append("mule-1")  # 25% of traffic is the mule
+            elif i % 11 == 0:
+                ents.append(None)  # legacy rows ride the null slot
+            else:
+                ents.append(f"card-{int(rng.integers(0, 60))}")
+        ts = np.asarray([t + i * 0.25 for i in range(batch)], np.float32)
+        t += batch * 0.25
+        batches.append((rows, ents, ts))
+
+    poisoned: set[tuple[int, int]] = set()  # (batch_idx, row_idx)
+    fire_count = {"b": 0}
+
+    def poison(slot=None, batch=None, placement=None, **_):
+        # alternate genuine NaN and absurd-magnitude amounts on a third of
+        # the mule's staged rows (k-indexed over MULE rows, so both
+        # branches are actually reachable — the mule sits at fixed batch
+        # positions). The untouched mule rows then score off the CLAMPED
+        # slot state, which is the containment the invariants pin.
+        b = fire_count["b"]
+        fire_count["b"] += 1
+        k = 0
+        for j in range(len(batch)):
+            if slot.lf[j] == target_fp:
+                if k % 3 == 0:
+                    use_nan = (k // 3) % 2 == 1
+                    slot.f32[j, -1] = np.nan if use_nan else 1e30
+                    poisoned.add((b, j, "nan" if use_nan else "big"))
+                k += 1
+
+    def drive(armed_plan):
+        rm, spec_, state_, _ = build_ledger_model(seed=seed)
+        wt = _watchtower(rm.profile, halflife=50_000.0)
+        wt.drift.bind_ledger(spec_, state_)
+        mb = MicroBatcher(
+            scorer=rm.model.scorer, watchtower=wt, telemetry=False,
+            max_batch=batch,
+        )
+        scorer = rm.model.scorer
+        tgt = mb._fused_target(scorer)
+        lat: list[float] = []
+        all_scores: list[float] = []
+        try:
+            for rows, ents, ts in batches:
+                items = []
+                for i in range(batch):
+                    ent = None
+                    if ents[i] is not None:
+                        s, fp = spec_.row_keys(ents[i])
+                        ent = (s, fp, float(ts[i]))
+                    items.append((rows[i], None, None, ent))
+                t_start = time.perf_counter()
+                out = mb._flush_device(scorer, tgt, items, False)
+                lat.append(time.perf_counter() - t_start)
+                all_scores.extend(np.asarray(out[0], np.float64).tolist())
+            snap = wt.drift.ledger_snapshot()
+        finally:
+            wt.close()
+        return snap, lat, all_scores
+
+    clean_snap, clean_lat, _ = drive(None)
+    plan = faults.FaultPlan().call("ledger.update", poison)
+    with plan.armed():
+        poison_snap, poison_lat, poison_scores = drive(plan)
+
+    result = ScenarioResult("poison_entity_state")
+    from fraud_detection_tpu.ledger.state import entity_slot
+
+    mule_slot = entity_slot(target_fp, spec.log2_slots)
+    result.metrics = {
+        "batches": n_batches,
+        "poison_fired": plan.fired("ledger.update"),
+        "mule_slot": mule_slot,
+        "mule_count": float(poison_snap.count[mule_slot]),
+        "mule_amount_sum": float(poison_snap.amount_sum[mule_slot]),
+    }
+    kinds = {kind for _, _, kind in poisoned}
+    result.add(
+        InvariantOutcome(
+            "poison-injected",
+            plan.fired("ledger.update") > 0 and kinds == {"nan", "big"},
+            f"{plan.fired('ledger.update')} ledger.update firings, "
+            f"{len(poisoned)} rows poisoned ({sorted(kinds)}) — both the "
+            "NaN and extreme-amount branches must actually land",
+        )
+    )
+    finite = all(
+        bool(np.all(np.isfinite(np.asarray(leaf))))
+        for leaf in (
+            poison_snap.count, poison_snap.amount_sum,
+            poison_snap.amount_sumsq, poison_snap.last_ts,
+        )
+    )
+    from fraud_detection_tpu.ledger.state import AMOUNT_CLIP
+
+    bounded = abs(float(poison_snap.amount_sum[mule_slot])) <= (
+        AMOUNT_CLIP * max(float(poison_snap.count[mule_slot]), 1.0) + 1.0
+    )
+    result.add(
+        InvariantOutcome(
+            "poison-guard-clamps",
+            finite and bounded,
+            "victim slot stayed finite and clamp-bounded"
+            if finite and bounded
+            else f"finite={finite} bounded={bounded} "
+            f"sum={float(poison_snap.amount_sum[mule_slot])}",
+        )
+    )
+    # every slot EXCEPT the mule's must be bitwise the clean run's
+    others_ok = True
+    detail = "all non-victim slots bitwise identical to the clean run"
+    for name in ("count", "amount_sum", "amount_sumsq", "last_ts"):
+        a = np.asarray(getattr(clean_snap, name)).copy()
+        b = np.asarray(getattr(poison_snap, name)).copy()
+        a[mule_slot] = 0
+        b[mule_slot] = 0
+        if a.tobytes() != b.tobytes():
+            others_ok = False
+            n_diff = int(np.sum(a != b))
+            detail = f"{name}: {n_diff} non-victim slots differ"
+            break
+    result.add(InvariantOutcome("other-entities-unaffected", others_ok, detail))
+    # a poisoned row's OWN score may be NaN (its staged feature is NaN —
+    # request-input garbage, the service edge's concern); the containment
+    # claim is that every NON-poisoned row — including the mule's clean
+    # rows, which score off the clamped slot state — stays finite
+    flat_poisoned = {b * batch + j for b, j, _ in poisoned}
+    clean = [
+        s for i, s in enumerate(poison_scores) if i not in flat_poisoned
+    ]
+    result.add(
+        InvariantOutcome(
+            "scores-finite",
+            bool(np.all(np.isfinite(np.asarray(clean)))),
+            f"all {len(clean)} non-poisoned rows' scores finite (incl. the "
+            "mule's clean rows scoring off the clamped slot)",
+        )
+    )
+    base_p99 = float(np.percentile(np.asarray(clean_lat), 99))
+    result.add(p99_within(poison_lat, base_p99, factor=5.0))
+    return result
+
+
 # -- registry ----------------------------------------------------------------
 
 SCENARIOS = {
@@ -1156,6 +1375,7 @@ SCENARIOS = {
     "shard_kill_mid_swap": scenario_shard_kill_mid_swap,
     "replica_burst": scenario_replica_burst,
     "explain_under_burst": scenario_explain_under_burst,
+    "poison_entity_state": scenario_poison_entity_state,
 }
 
 #: scenarios that need a scratch directory as their first argument

@@ -62,6 +62,7 @@ from fraud_detection_tpu.service.schemas import (
     ExplanationOut,
     HealthOut,
     PredictionOut,
+    parse_entity,
     parse_transaction,
 )
 from fraud_detection_tpu.service.taskq import Broker
@@ -404,10 +405,26 @@ def create_app(
             # raised (e.g. device compile failure) — degraded, not a 500.
             raise HTTPError(503, "model not loaded")
         try:
-            features = parse_transaction(req.json())
+            payload = req.json()
+            features = parse_transaction(payload)
             row = model.prepare_row(features)
+            entity_id, event_ts = parse_entity(payload)
         except ValueError as e:
             raise HTTPError(422, str(e)) from e
+
+        # ledger: hash the entity once at the edge (host-side multiply-
+        # shift — ledger/state); the (slot, fingerprint, timestamp) triple
+        # rides the queue item into the fused stateful flush. Entity-less
+        # requests (or a stateless model) pass None and score through the
+        # null path.
+        entity = None
+        ledger_spec = getattr(model, "ledger_spec", None)
+        if ledger_spec is not None and entity_id is not None:
+            slot_idx, fp = ledger_spec.row_keys(entity_id)
+            entity = (
+                slot_idx, fp,
+                ledger_spec.rel_ts(event_ts or time.time()),
+            )
 
         timeline = (
             RequestTimeline(correlation_id=corr_id)
@@ -424,11 +441,11 @@ def create_app(
                 try:
                     if explain_on:
                         score, reasons = await state["batcher"].score_ex(
-                            row, timeline=timeline
+                            row, timeline=timeline, entity=entity
                         )
                     else:
                         score = await state["batcher"].score(
-                            row, timeline=timeline
+                            row, timeline=timeline, entity=entity
                         )
                 except NoHealthyShards as e:
                     # every switchyard shard dead/draining: a known,
@@ -662,6 +679,31 @@ def create_app(
                 raise ValueError("'scores' must be probabilities in [0, 1]")
             if not np.all((labels_arr == 0) | (labels_arr == 1)):
                 raise ValueError("'labels' must be 0 or 1")
+            # ledger replay metadata (optional): per-row entity + event
+            # time so the retrain replay can rebuild velocity features
+            entity_ids = payload.get("entity_ids")
+            timestamps = payload.get("timestamps")
+            if entity_ids is not None and (
+                not isinstance(entity_ids, list)
+                or len(entity_ids) != len(feats)
+            ):
+                raise ValueError(
+                    "'entity_ids' must be a list aligned with 'features'"
+                )
+            if timestamps is not None:
+                if not isinstance(timestamps, list) or len(timestamps) != len(
+                    feats
+                ):
+                    raise ValueError(
+                        "'timestamps' must be a list aligned with 'features'"
+                    )
+                ts_arr = np.asarray(timestamps, np.float64)
+                if ts_arr.ndim != 1 or not np.all(
+                    np.isfinite(ts_arr) & (ts_arr > 0)
+                ):
+                    raise ValueError(
+                        "'timestamps' must be positive finite numbers"
+                    )
         except (TypeError, ValueError) as e:
             # TypeError too: prepare_row over a non-iterable "row" or
             # np.asarray over nulls are client input errors, not 500s
@@ -682,6 +724,7 @@ def create_app(
                 await asyncio.to_thread(
                     state["lifecycle_store"].add_feedback,
                     rows, scores_arr, labels_arr,
+                    entity_ids, timestamps,
                 )
                 persisted = True
             except _STORE_OUTAGE_ERRORS as e:

@@ -161,6 +161,46 @@ scorer_effective_wait = Gauge(
     registry=registry,
 )
 
+# Ledger: the device-resident stateful feature engine (ledger/). These
+# names are the alerting contract for
+# monitoring/prometheus/rules/ledger-alerts.yml (LedgerSaturated) and the
+# ledger dashboard panels.
+ledger_slot_occupancy = Gauge(
+    "ledger_slot_occupancy",
+    "Fraction of entity-table slots holding live (undecayed) evidence — "
+    "the LedgerSaturated alert input; raise LEDGER_SLOTS before this "
+    "saturates (docs/runbooks/LedgerSaturated.md)",
+    registry=registry,
+)
+ledger_active = Gauge(
+    "ledger_active",
+    "1 while the served model is ledger-widened and the entity table is "
+    "bound to the fused flush; 0 for a stateless family",
+    registry=registry,
+)
+ledger_hash_collisions = Counter(
+    "ledger_hash_collisions",
+    "Rows that wrote into a live slot owned by a different entity "
+    "fingerprint (graceful aggregate sharing — accuracy degrades, nothing "
+    "breaks; sustained growth means LEDGER_SLOTS is undersized)",
+    registry=registry,
+)
+ledger_evictions = Counter(
+    "ledger_evictions",
+    "Slot takeovers: a new entity claimed a slot whose previous owner's "
+    "evidence had decayed below noise (normal turnover, not data loss)",
+    registry=registry,
+)
+ledger_null_entity_rows = Counter(
+    "ledger_null_entity_rows",
+    "Scored rows that carried no entity_id (legacy clients): they score "
+    "through the reserved null slot (baseline-profile mean velocity "
+    "features folded into the intercept) — a high rate during a rollout "
+    "means clients aren't sending entity_id yet and velocity features are "
+    "not differentiating traffic",
+    registry=registry,
+)
+
 # Watchtower: online drift / quality / shadow monitoring (monitor/).
 # These names are part of the alerting contract —
 # monitoring/prometheus/rules/watchtower-alerts.yml and the Grafana drift
@@ -214,6 +254,14 @@ watchtower_shadow_disagreement = Gauge(
 watchtower_shadow_score_psi = Gauge(
     "watchtower_shadow_score_psi",
     "PSI of the challenger score distribution vs the training baseline",
+    registry=registry,
+)
+watchtower_shadow_reason_divergence = Gauge(
+    "watchtower_shadow_reason_divergence",
+    "Mean (1 − Jaccard) between the champion's serve-time top-k reason-"
+    "code indices and the challenger's top-k on sampled batches — how "
+    "differently the challenger would EXPLAIN the same traffic, the "
+    "lantern-aware promotion signal (0 = identical reasoning)",
     registry=registry,
 )
 watchtower_batches_observed = Counter(

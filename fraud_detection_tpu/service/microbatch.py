@@ -234,6 +234,15 @@ class MicroBatcher:
                         # resolves (and logs, once, at startup) whether the
                         # family carries a fused explain leg
                         k = self._explain_k_for(spec, scorer)
+                        if (
+                            getattr(spec, "ledger", None) is not None
+                            and getattr(drift, "n_shards", 1) > 1
+                        ):
+                            # sharded ledger flush: hash-mod-shard placement
+                            # can bump a skewed batch's bucket by up to the
+                            # shard factor (ledger/placement) — extend the
+                            # warm ladder so a bump never compiles mid-load
+                            top *= drift.n_shards
                         b = scorer.min_bucket
                         while b <= top:
                             # warm with the serving return wire + explain
@@ -264,30 +273,34 @@ class MicroBatcher:
             await asyncio.gather(*self._flushes, return_exceptions=True)
         # Fail anything still enqueued so no request awaits forever.
         while not self._queue.empty():
-            _, fut, _ = self._queue.get_nowait()
+            _, fut, _, _ = self._queue.get_nowait()
             if not fut.done():
                 fut.set_exception(RuntimeError("scorer shutting down"))
 
-    async def _submit(self, row: np.ndarray, timeline=None):
+    async def _submit(self, row: np.ndarray, timeline=None, entity=None):
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((row, fut, timeline))
+        await self._queue.put((row, fut, timeline, entity))
         return await fut
 
-    async def score(self, row: np.ndarray, timeline=None) -> float:
+    async def score(self, row: np.ndarray, timeline=None, entity=None) -> float:
         """Submit one feature row; returns P(fraud). ``timeline`` (a
         RequestTimeline) rides along and is stamped at every stage
         boundary — pass one to get the request into the stage histograms,
-        child spans, and the flight recorder."""
-        res = await self._submit(row, timeline)
+        child spans, and the flight recorder. ``entity`` is the ledger's
+        ``(slot, fingerprint, timestamp)`` triple (host-hashed once at the
+        API edge) or None for a legacy/entity-less request — the row then
+        scores through the reserved null slot, counted on
+        ``ledger_null_entity_rows_total``."""
+        res = await self._submit(row, timeline, entity)
         return res[0] if isinstance(res, tuple) else res
 
-    async def score_ex(self, row: np.ndarray, timeline=None):
+    async def score_ex(self, row: np.ndarray, timeline=None, entity=None):
         """Submit one feature row; returns ``(P(fraud), reasons)`` where
         ``reasons`` is ``(indices, values)`` — the lantern top-k reason
         codes computed in the SAME device dispatch as the score — or None
         when this flush carried no fused explain leg (SCORER_EXPLAIN off,
         or the family demoted)."""
-        res = await self._submit(row, timeline)
+        res = await self._submit(row, timeline, entity)
         if isinstance(res, tuple):
             return res[0], (res[1], res[2])
         return res, None
@@ -376,7 +389,7 @@ class MicroBatcher:
         except asyncio.CancelledError:
             # Cancellation mid-collection: fail the partial batch so its
             # waiters don't hang, then propagate.
-            for _, f, _ in batch:
+            for _, f, _, _ in batch:
                 if not f.done():
                     f.set_exception(RuntimeError("scorer shutting down"))
             raise
@@ -510,14 +523,54 @@ class MicroBatcher:
         fire("microbatch.flush")
         n = len(batch)
         staging = scorer.staging
-        slot = staging.acquire(_bucket(n, scorer.min_bucket))
+        # ledger (stateful feature engine): active when the fused spec is a
+        # widened family AND the drift monitor carries the entity table
+        ledger_on = (
+            target is not None
+            and getattr(target[1], "ledger", None) is not None
+            and getattr(target[0], "ledger", None) is not None
+        )
+        placement = None
+        if ledger_on and getattr(target[0], "n_shards", 1) > 1:
+            # sharded ledger flush: rows must land in the row range of the
+            # device shard owning their entity's table slot (slot mod N) —
+            # a host-side permutation, never a device collective
+            from fraud_detection_tpu.ledger.placement import shard_placement
+
+            slots_arr = np.asarray(
+                [e[0] if (e := item[3]) is not None else 0 for item in batch],
+                np.int64,
+            )
+            has_arr = np.asarray(
+                [item[3] is not None for item in batch], bool
+            )
+            bucket, placement = shard_placement(
+                slots_arr, has_arr, target[0].n_shards, scorer.min_bucket
+            )
+        else:
+            bucket = _bucket(n, scorer.min_bucket)
+        slot = staging.acquire(bucket)
         holdover = None
         handed_over = False
         explain_out = None
+        monitor_reasons = None
         try:
             with annotate("microbatch-score"):
                 t_flush_start = time.perf_counter()
-                hx = scorer.stage_rows(slot, [r for r, _, _ in batch])
+                if placement is None:
+                    hx = scorer.stage_rows(
+                        slot, [item[0] for item in batch]
+                    )
+                else:
+                    hx = scorer.stage_rows_placed(
+                        slot, [item[0] for item in batch], placement
+                    )
+                ledger_rows = None
+                n_null = 0
+                if ledger_on:
+                    hx, ledger_rows, n_null = self._stage_ledger(
+                        scorer, slot, batch, placement
+                    )
                 t_padded = time.perf_counter()
                 explain_k = 0
                 if target is not None:
@@ -531,8 +584,11 @@ class MicroBatcher:
                         out_dtype=self._out_jdtype,
                         explain_args=spec.explain_args if explain_k else None,
                         explain_k=explain_k,
+                        ledger_rows=ledger_rows,
                     )
                     device_calls = 1
+                    if ledger_on and n_null:
+                        metrics.ledger_null_entity_rows.inc(n_null)
                     need_rows = getattr(
                         self.watchtower, "wants_rows", lambda: True
                     )()
@@ -557,11 +613,19 @@ class MicroBatcher:
                 if target is not None and raw.dtype != np.float32:
                     # decode the return wire in place: the slot's scores
                     # buffer is the only f32 materialization, so the slot
-                    # must outlive the waiters (holdover)
-                    probs = decode_scores_into(raw, slot.scores)[:n]
-                    holdover = slot
+                    # must outlive the waiters (holdover). With placement
+                    # the fancy-index gather below already copies, so the
+                    # slot recycles immediately instead.
+                    dec = decode_scores_into(raw, slot.scores)
+                    if placement is None:
+                        probs = dec[:n]
+                        holdover = slot
+                    else:
+                        probs = dec[placement]
                 else:
-                    probs = raw[:n]
+                    probs = (
+                        raw[:n] if placement is None else raw[placement]
+                    )
                 if explain_k:
                     # reason codes decode into the slot's preallocated
                     # explain buffers — same holdover discipline as the
@@ -569,10 +633,23 @@ class MicroBatcher:
                     ei, ev = decode_explain_into(
                         np.asarray(eidx_dev), np.asarray(eval_dev), slot
                     )
-                    explain_out = (ei[:n], ev[:n])
-                    holdover = slot
+                    if placement is None:
+                        explain_out = (ei[:n], ev[:n])
+                        holdover = slot
+                    else:
+                        explain_out = (ei[placement], ev[placement])
                 t_fetched = time.perf_counter()
-                monitor_rows = slot.f32[:n].copy() if need_rows else None
+                if not need_rows:
+                    monitor_rows = None
+                elif placement is None:
+                    monitor_rows = slot.f32[:n].copy()
+                else:
+                    monitor_rows = slot.f32[placement]  # gather = fresh copy
+                if need_rows and explain_out is not None:
+                    # champion serve-time top-k indices, waiter order — the
+                    # shadow reason-divergence comparison reads them off the
+                    # ingest thread after the slot recycles, so copy now
+                    monitor_reasons = np.array(explain_out[0], np.int64)
                 if not need_rows:
                     monitor_scores = None
                 elif holdover is None:
@@ -592,6 +669,81 @@ class MicroBatcher:
         return (
             probs, explain_out, t_flush_start, t_padded, t_synced, t_fetched,
             device_calls, monitor_rows, monitor_scores, holdover,
+            monitor_reasons,
+        )
+
+    def _stage_ledger(self, scorer, slot, batch: list[tuple], placement):
+        """Fill the slot's ledger staging buffers from the queue items'
+        ``(slot_idx, fingerprint, timestamp)`` entity triples (None =
+        entity-less → the reserved null path: has_entity 0, counted).
+        Returns ``(hx, ledger_rows, n_null)``; ``hx`` is re-encoded when a
+        chaos plan poisoned the staged rows through the ``ledger.update``
+        injection point."""
+        # graftcheck: hot-path — the ledger buffers are preallocated pool
+        # state (ensure_ledger counts first-time materialization)
+        import jax.numpy as jnp
+
+        from fraud_detection_tpu.range.faults import active_plan
+
+        slot.ensure_ledger()
+        slot.ls[:] = 0
+        slot.lf[:] = 0
+        slot.lt[:] = 0.0
+        slot.lh[:] = 0.0
+        n_null = 0
+        # fallback event time for a triple arriving with ts<=0: must be on
+        # the table's ORIGIN-RELATIVE clock (app.py converts via
+        # spec.rel_ts) — a raw epoch here would anchor the slot ~1.7e9
+        # relative seconds ahead and freeze its decay forever
+        spec = getattr(scorer, "ledger_spec", None)
+        now = (
+            spec.rel_ts(time.time()) if spec is not None else time.time()
+        )
+        n = len(batch)
+        # one pass building python columns, then bulk numpy assignment:
+        # per-element ndarray setitem costs ~100ns — a 1024-row flush paid
+        # ~0.4ms to the loop, a third of the whole stateless flush
+        svals = [0] * n
+        fvals = [0] * n
+        tvals = [0.0] * n
+        hvals = [0.0] * n
+        for j, item in enumerate(batch):
+            ent = item[3]
+            if ent is None:
+                n_null += 1
+                continue
+            s, fp, ts = ent
+            svals[j] = s
+            fvals[j] = fp
+            tvals[j] = ts if ts and ts > 0 else now
+            hvals[j] = 1.0
+        if placement is None:
+            slot.ls[:n] = svals
+            slot.lf[:n] = fvals
+            slot.lt[:n] = tvals
+            slot.lh[:n] = hvals
+        else:
+            slot.ls[placement] = svals
+            slot.lf[placement] = fvals
+            slot.lt[placement] = tvals
+            slot.lh[placement] = hvals
+        # fraud-range injection point: the poison_entity_state campaign
+        # corrupts one entity's staged amounts/timestamps here; the traced
+        # body's clamp (ledger/features) is the blast door under test
+        fire("ledger.update", slot=slot, batch=batch, placement=placement)
+        if active_plan() is not None:
+            # a plan may have mutated the staged f32 rows — re-encode so
+            # the poison actually rides the wire (disarmed: zero cost)
+            hx = scorer._encode_slot(slot)
+        else:
+            hx = slot.io
+        return (
+            hx,
+            (
+                jnp.asarray(slot.ls), jnp.asarray(slot.lf),
+                jnp.asarray(slot.lt), jnp.asarray(slot.lh),
+            ),
+            n_null,
         )
 
     async def _flush(self, batch: list[tuple]) -> None:
@@ -620,7 +772,7 @@ class MicroBatcher:
                 (
                     probs, explain_out, t_flush, t_padded, t_synced,
                     t_fetched, device_calls, monitor_rows, monitor_scores,
-                    holdover,
+                    holdover, monitor_reasons,
                 ) = await loop.run_in_executor(
                     None, self._flush_device, scorer, target, batch, telemetry
                 )
@@ -632,7 +784,7 @@ class MicroBatcher:
                     # the demotion must latch here too (the quickwire
                     # silent-demotion lesson)
                     self._note_explain_fused(False, scorer)
-                rows = np.stack([r for r, _, _ in batch])
+                rows = np.stack([item[0] for item in batch])
 
                 def _score() -> np.ndarray:
                     with annotate("microbatch-score"):
@@ -643,6 +795,7 @@ class MicroBatcher:
                 device_calls = 2 if self.watchtower is not None else 1
                 monitor_rows = rows
                 monitor_scores = probs
+                monitor_reasons = None
             if explain_out is not None:
                 metrics.scorer_explained_rows.inc(len(batch))
             metrics.scorer_device_calls_per_flush.set(device_calls)
@@ -651,7 +804,7 @@ class MicroBatcher:
                 else ("split" if self.watchtower is not None else "solo")
             ).inc()
         except Exception as e:  # resolve all waiters with the failure
-            for _, f, _ in batch:
+            for _, f, _, _ in batch:
                 if not f.done():
                     f.set_exception(e)
             return
@@ -684,7 +837,7 @@ class MicroBatcher:
             # timelines back (emit_stage_spans): one ref per row is ~60ns
             # and the telemetry budget lives and dies on this loop — the
             # flight recorder gets the FlushInfo through its entry instead.
-            for j, ((_, f, tl), p) in enumerate(zip(batch, probs)):
+            for j, ((_, f, tl, _), p) in enumerate(zip(batch, probs)):
                 if not f.done():
                     f.set_result(
                         results[j] if results is not None else float(p)
@@ -692,7 +845,7 @@ class MicroBatcher:
                 if tl is not None:
                     tl.flush = fi
         else:
-            for j, ((_, f, _), p) in enumerate(zip(batch, probs)):
+            for j, ((_, f, _, _), p) in enumerate(zip(batch, probs)):
                 if not f.done():
                     f.set_result(
                         results[j] if results is not None else float(p)
@@ -713,7 +866,8 @@ class MicroBatcher:
             # request latency.
             try:
                 self.watchtower.observe(
-                    monitor_rows, monitor_scores, drift_done=fused
+                    monitor_rows, monitor_scores, drift_done=fused,
+                    reasons=monitor_reasons,
                 )
             except Exception:
                 log.debug("watchtower observe failed", exc_info=True)

@@ -17,6 +17,14 @@ class TransactionIn(BaseModel):
     features: list[float] | dict[str, float] = Field(
         description="Feature vector in training order, or name→value map"
     )
+    #: ledger (stateful feature engine): the card/account/device this
+    #: transaction belongs to. Optional — requests without one (legacy
+    #: clients) score through the reserved null slot (baseline-profile
+    #: mean velocity features), counted on ledger_null_entity_rows_total.
+    entity_id: str | int | None = None
+    #: event time (unix seconds) for the velocity decay; server arrival
+    #: time when omitted.
+    timestamp: float | None = None
 
 
 class ReasonCodeOut(BaseModel):
@@ -86,3 +94,26 @@ def parse_transaction(payload) -> list[float] | dict[str, float]:
         except (TypeError, ValueError) as e:
             raise ValueError(f"non-numeric feature value: {e}") from e
     raise ValueError("'features' must be a list or an object")
+
+
+def parse_entity(payload) -> tuple[str | None, float | None]:
+    """Validate the optional ledger fields of a /predict body →
+    ``(entity_id, timestamp)``; both None for a legacy request.
+
+    Raises ValueError with a client-facing message (→ 422)."""
+    entity_id = payload.get("entity_id")
+    if entity_id is not None:
+        if not isinstance(entity_id, (str, int)) or isinstance(entity_id, bool):
+            raise ValueError("'entity_id' must be a string or integer")
+        entity_id = str(entity_id)
+        if not entity_id or len(entity_id) > 256:
+            raise ValueError("'entity_id' must be 1-256 characters")
+    ts = payload.get("timestamp")
+    if ts is not None:
+        try:
+            ts = float(ts)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"'timestamp' must be a number: {e}") from e
+        if not (ts > 0) or ts != ts or ts == float("inf"):
+            raise ValueError("'timestamp' must be a positive finite number")
+    return entity_id, ts

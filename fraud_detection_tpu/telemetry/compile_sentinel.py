@@ -73,8 +73,14 @@ WRAP_TARGETS: dict[str, list[tuple[str, str]]] = {
         ("fraud_detection_tpu.monitor.drift", "_fused_flush_explain"),
         ("fraud_detection_tpu.monitor.drift", "_fused_flush_quant_explain"),
     ],
+    "ledger.flush": [
+        ("fraud_detection_tpu.monitor.drift", "_fused_flush_ledger")
+    ],
     "mesh.sharded_flush": [
         ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush")
+    ],
+    "mesh.ledger_flush": [
+        ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush_ledger")
     ],
     "mesh.quickwire_flush": [
         ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush_quant")

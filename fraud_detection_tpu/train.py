@@ -99,6 +99,7 @@ def train(
     model_family: str = "logistic",
     gbt_config: GBTConfig | None = None,
     checkpoint_dir: str | None = None,
+    ledger: bool | None = None,
 ) -> dict:
     """Run the full pipeline; returns a metrics dict."""
     t0 = time.time()
@@ -107,6 +108,48 @@ def train(
     log.info("loaded %s: %d rows, %d positives", data_csv, len(y), int(y.sum()))
 
     train_idx, test_idx = stratified_split(y, 0.2, seed)
+
+    # ---- ledger (stateful feature engine): widen the feature block ----
+    # LEDGER_ENABLED=1 / --ledger replays the base rows through the SAME
+    # traced velocity aggregator serving runs (ledger/replay — seeded
+    # pseudo-entities for the entity-less base CSV, timestamps from the
+    # Time column), fits on base + K velocity features, and stamps the
+    # final table snapshot + hash geometry beside the weights. The serving
+    # tier widens automatically when it loads the sidecar.
+    ledger_spec = ledger_state = None
+    use_ledger = ledger if ledger is not None else config.ledger_enabled()
+    if use_ledger and model_family != "logistic":
+        log.warning("ledger widening supports the logistic family only; off")
+        use_ledger = False
+    if use_ledger:
+        from fraud_detection_tpu.ledger import (
+            LEDGER_FEATURE_NAMES,
+            LedgerSpec,
+            materialize_features,
+            synthesize_entities,
+        )
+
+        spec0 = LedgerSpec.from_config(x.shape[1])
+        ents, ts = synthesize_entities(
+            x, feature_names, seed, config.ledger_synth_events_per_entity()
+        )
+        feats, ledger_state = materialize_features(spec0, x, ents, ts)
+        x = np.concatenate([x, feats], axis=1).astype(np.float32)
+        feature_names = list(feature_names) + list(LEDGER_FEATURE_NAMES)
+        ledger_spec = dataclasses.replace(
+            spec0,
+            # entity-less serving rows read the TRAINING distribution's
+            # mean velocity features (the reserved null slot)
+            null_features=feats[train_idx].mean(axis=0).astype(np.float32),
+            # serve-time wall clocks continue the replay clock seamlessly
+            ts_origin=time.time() - (float(ts.max()) + 1.0),
+        )
+        log.info(
+            "ledger widening on: %d slots, halflife %.0fs, +%d velocity "
+            "features", spec0.slots, spec0.halflife_s,
+            len(LEDGER_FEATURE_NAMES),
+        )
+
     x_train, y_train = x[train_idx], y[train_idx]
     x_test, y_test = x[test_idx], y[test_idx]
 
@@ -251,9 +294,16 @@ def train(
             model.save(out_dir)
             model.save(model_artifact)
         else:
-            model = FraudLogisticModel(params, scaler, feature_names)
+            model = FraudLogisticModel(
+                params, scaler, feature_names,
+                ledger_spec=ledger_spec, ledger_state=ledger_state,
+            )
             model.save(out_dir)
             save_artifacts(model_artifact, params, scaler, feature_names)
+            if ledger_spec is not None:
+                from fraud_detection_tpu.ledger.state import save_ledger
+
+                save_ledger(model_artifact, ledger_spec, ledger_state)
             if scaler is not None:
                 # quickwire int8 wire calibration: stamped beside the
                 # weights so the serving quantizer is pinned to THIS
@@ -322,6 +372,12 @@ def main(argv=None):
     )
     ap.add_argument("--no-smote", action="store_true")
     ap.add_argument("--no-register", action="store_true")
+    ap.add_argument(
+        "--ledger", action="store_true",
+        help="widen the feature block with the ledger's per-entity "
+        "velocity aggregates (replayed through the serving body — see "
+        "fraud_detection_tpu/ledger); also LEDGER_ENABLED=1",
+    )
     ap.add_argument("--out-dir", default="models")
     ap.add_argument(
         "--profile-dir", default=None,
@@ -347,6 +403,7 @@ def main(argv=None):
             out_dir=args.out_dir,
             model_family=args.model,
             checkpoint_dir=args.checkpoint_dir,
+            ledger=True if args.ledger else None,
         )
 
     if args.profile_dir:

@@ -1,0 +1,842 @@
+"""Ledger (ISSUE 10): the device-resident stateful feature engine.
+
+Covers the tentpole contracts — hash behavior under adversarial entity
+sets, poison clamping, the all-padding warmup bitwise invariant, same-seed
+bitwise reproducibility, train/serve parity through a feedback round-trip
+(skew structurally impossible), N-shard bitwise parity under hash-mod-shard
+placement, hot-swap rebinding with 0 recompiles, the reserved null slot for
+entity-less clients, and the compile-sentinel exact counts across the
+warmed ladder.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.ledger import (
+    LEDGER_FEATURE_NAMES,
+    LEDGER_K,
+    LedgerSpec,
+    entity_fingerprint,
+    entity_slot,
+    materialize_features,
+    shard_placement,
+    synthesize_entities,
+)
+from fraud_detection_tpu.ledger.features import _ledger_read_update, ledger_stats
+from fraud_detection_tpu.ledger.state import (
+    AMOUNT_CLIP,
+    device_state,
+    init_state,
+    load_ledger,
+    save_ledger,
+)
+from fraud_detection_tpu.models.logistic import FraudLogisticModel
+from fraud_detection_tpu.monitor.baseline import build_baseline_profile
+from fraud_detection_tpu.monitor.drift import DriftMonitor
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import scaler_fit
+from fraud_detection_tpu.service.microbatch import MicroBatcher
+
+D = 30
+KAGGLE = ["Time"] + [f"V{i}" for i in range(1, 29)] + ["Amount"]
+WIDE = KAGGLE + list(LEDGER_FEATURE_NAMES)
+
+
+def _spec(slots=512, halflife=600.0, nulls=None):
+    return LedgerSpec(
+        n_base=D, slots=slots, halflife_s=halflife, amount_col=-1,
+        null_features=(
+            np.zeros(LEDGER_K, np.float32) if nulls is None else nulls
+        ),
+    )
+
+
+def _step():
+    return jax.jit(_ledger_read_update)
+
+
+def _widened_model(seed=3, n=1200, spec=None):
+    """A real widened model: synthetic entities replayed through the body,
+    scaler over the widened block, random-ish weights."""
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, D)).astype(np.float32)
+    x[:, -1] = np.abs(x[:, -1]) * 50.0
+    ents = [f"card-{i % 37}" for i in range(n)]
+    ts = np.arange(1.0, n + 1.0, dtype=np.float32)
+    spec0 = spec or _spec()
+    feats, state = materialize_features(spec0, x, ents, ts)
+    spec_f = dataclasses.replace(
+        spec0, null_features=feats.mean(axis=0).astype(np.float32)
+    )
+    xw = np.concatenate([x, feats], axis=1).astype(np.float32)
+    scaler = scaler_fit(xw)
+    w = rng.standard_normal(D + LEDGER_K).astype(np.float32) * 0.2
+    params = LogisticParams(coef=jnp.asarray(w), intercept=jnp.float32(-0.3))
+    model = FraudLogisticModel(
+        params, scaler, WIDE, ledger_spec=spec_f, ledger_state=state
+    )
+    scores = np.asarray(model.scorer.predict_proba(xw[:512]))
+    profile = build_baseline_profile(xw, scores, feature_names=WIDE)
+    return model, profile, spec_f, state, x, float(ts.max())
+
+
+# -- hash behavior -----------------------------------------------------------
+
+def test_fingerprint_stable_and_slot_in_range():
+    fp = entity_fingerprint("card-4242")
+    assert fp == entity_fingerprint("card-4242")  # process-stable
+    assert 1 <= fp <= 0xFFFFFFFF
+    spec = _spec(slots=256)
+    for e in ("a", 17, "card-4242", "x" * 200):
+        s, f = spec.row_keys(e)
+        assert 0 <= s < 256 and f != 0
+
+
+def test_adversarial_collision_set_shares_slot_and_counts():
+    """Entity ids engineered to collide into ONE slot: the aggregates are
+    shared gracefully (blended, finite) and the collision counter
+    advances — never a crash or a fork."""
+    spec = _spec(slots=64, halflife=1e6)
+    target = entity_slot(entity_fingerprint("victim"), spec.log2_slots)
+    colliders = ["victim"]
+    i = 0
+    while len(colliders) < 6:
+        cand = f"attacker-{i}"
+        i += 1
+        if entity_slot(entity_fingerprint(cand), spec.log2_slots) == target:
+            colliders.append(cand)
+    n = 64
+    ents = [colliders[j % len(colliders)] for j in range(n)]
+    x = np.ones((n, D), np.float32)
+    ts = np.arange(1.0, n + 1.0, dtype=np.float32)
+    feats, state = materialize_features(spec, x, ents, ts, batch=16)
+    stats = ledger_stats(state)
+    # all six entities blended into one slot's window
+    assert float(state.count[target]) > 10.0
+    assert stats["hash_collisions"] > 0
+    assert np.all(np.isfinite(feats))
+
+
+def test_million_events_sumsq_stays_finite():
+    """1e6 synthetic events at the clip boundary: the f32 sumsq
+    accumulator must not overflow (clip bounds one term at 1e12; decay
+    bounds the series)."""
+    spec = _spec(slots=8, halflife=1e9)  # effectively no decay: worst case
+    step = _step()
+    dev = device_state(None, spec.slots)
+    batch = 4096
+    slots = jnp.zeros(batch, jnp.int32)
+    fps = jnp.full((batch,), 7, jnp.uint32)
+    amounts = jnp.full((batch,), AMOUNT_CLIP, jnp.float32)
+    has = jnp.ones(batch, jnp.float32)
+    null = jnp.zeros(LEDGER_K, jnp.float32)
+    hl = jnp.float32(spec.halflife_s)
+    t = 1.0
+    for _ in range(1_000_000 // batch):
+        ts = jnp.full((batch,), t, jnp.float32)
+        feats, dev = step(dev, slots, fps, ts, amounts, has, null, hl)
+        t += 1.0
+    acc = np.asarray(dev.acc)
+    assert np.all(np.isfinite(acc))
+    assert float(dev.count[0]) == pytest.approx(1_000_000, rel=1e-3)
+    assert np.all(np.isfinite(np.asarray(feats)))
+
+
+def test_poison_amounts_clamp_not_nan():
+    spec = _spec(slots=32)
+    step = _step()
+    dev = device_state(None, spec.slots)
+    bad = jnp.asarray(
+        [np.nan, np.inf, -np.inf, 1e30, -1e30, 5.0], jnp.float32
+    )
+    n = 6
+    feats, dev = step(
+        dev, jnp.full((n,), 3, jnp.int32), jnp.full((n,), 9, jnp.uint32),
+        jnp.arange(1.0, n + 1.0, dtype=jnp.float32), bad,
+        jnp.ones(n, jnp.float32), jnp.zeros(LEDGER_K, jnp.float32),
+        jnp.float32(100.0),
+    )
+    for leaf in dev[:2]:
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    assert abs(float(dev.amount_sum[3])) <= AMOUNT_CLIP * float(dev.count[3])
+    assert np.all(np.isfinite(np.asarray(feats)))
+
+
+# -- determinism contracts ---------------------------------------------------
+
+def test_same_seed_replay_bitwise_reproducible():
+    """Two same-seed replays leave BITWISE identical feature matrices and
+    table state — asserted through range.invariants (the chaos tier's
+    determinism primitive)."""
+    from fraud_detection_tpu.range.invariants import windows_bitwise_equal
+
+    spec = _spec()
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((700, D)).astype(np.float32)
+    ents, ts = synthesize_entities(x, KAGGLE, seed=9)
+    f1, s1 = materialize_features(spec, x, ents, ts)
+    f2, s2 = materialize_features(spec, x, ents, ts)
+    assert f1.tobytes() == f2.tobytes()
+    out = windows_bitwise_equal(s1, s2)
+    assert out.ok, out.detail
+
+
+def test_all_padding_batch_leaves_table_bitwise_unchanged():
+    spec = _spec()
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((300, D)).astype(np.float32)
+    ents = [f"e{i % 11}" for i in range(300)]
+    _, state = materialize_features(
+        spec, x, ents, np.arange(1.0, 301.0, dtype=np.float32)
+    )
+    dev = device_state(state, spec.slots)
+    step = _step()
+    n = 128
+    _, dev2 = step(
+        dev, jnp.zeros(n, jnp.int32), jnp.zeros(n, jnp.uint32),
+        jnp.zeros(n, jnp.float32), jnp.zeros(n, jnp.float32),
+        jnp.zeros(n, jnp.float32), jnp.asarray(spec.null_features),
+        jnp.float32(spec.halflife_s),
+    )
+    for name, a, b in zip(state._fields, state, dev2):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), name
+
+
+def test_warm_fused_is_bitwise_invariant_on_the_ledger():
+    """The micro-batcher's warmup path itself (drift.warm_fused with the
+    ledger bound): compiles the executable, leaves the table untouched."""
+    model, profile, spec, state, _, _ = _widened_model()
+    mon = DriftMonitor(profile)
+    mon.bind_ledger(spec, state)
+    before = mon.ledger_snapshot()
+    mon.warm_fused(model.scorer, 64)
+    after = mon.ledger_snapshot()
+    for name, a, b in zip(before._fields, before, after):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), name
+
+
+# -- snapshot / artifact -----------------------------------------------------
+
+def test_snapshot_roundtrip_and_model_sidecar(tmp_path):
+    model, _, spec, state, _, _ = _widened_model()
+    p = save_ledger(str(tmp_path), spec, state)
+    assert p.endswith("ledger_state.npz")
+    spec2, state2 = load_ledger(str(tmp_path))
+    assert (spec2.n_base, spec2.slots, spec2.halflife_s, spec2.amount_col,
+            spec2.ts_origin) == (spec.n_base, spec.slots, spec.halflife_s,
+                                 spec.amount_col, spec.ts_origin)
+    assert np.allclose(spec2.null_features, spec.null_features)
+    for a, b in zip(state, state2):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+    # the model save/load path carries the sidecar
+    d = str(tmp_path / "model")
+    model.save(d, joblib_too=False)
+    loaded = FraudLogisticModel.load(d)
+    assert loaded.ledger_spec is not None
+    assert loaded.ledger_spec.slots == spec.slots
+    assert list(loaded.feature_names) == WIDE
+    assert loaded.scorer.n_base_features == D
+    assert loaded.scorer.n_features == D + LEDGER_K
+
+
+# -- serving: the widened fused flush ---------------------------------------
+
+def _serve_batches(model, profile, spec, state, batches):
+    """Drive fixed batches through the real MicroBatcher flush body
+    (deterministic — same driver the poison scenario uses)."""
+    from fraud_detection_tpu.monitor.watchtower import Thresholds, Watchtower
+
+    wt = Watchtower(
+        profile,
+        thresholds=Thresholds(5.0, 5.0, 5.0, 1.0, 10 ** 9),
+        halflife_rows=1e6,
+    )
+    wt.drift.bind_ledger(spec, state)
+    mb = MicroBatcher(scorer=model.scorer, watchtower=wt, telemetry=False)
+    tgt = mb._fused_target(model.scorer)
+    assert tgt is not None and tgt[1].ledger is not None
+    scores = []
+    try:
+        for rows, ents, ts in batches:
+            items = []
+            for i in range(rows.shape[0]):
+                ent = None
+                if ents[i] is not None:
+                    s, fp = spec.row_keys(ents[i])
+                    ent = (s, fp, float(ts[i]))
+                items.append((rows[i], None, None, ent))
+            out = mb._flush_device(model.scorer, tgt, items, False)
+            scores.append(np.asarray(out[0], np.float32))
+        snap = wt.drift.ledger_snapshot()
+        stats = wt.drift.ledger_stats()
+    finally:
+        wt.close()
+    return np.concatenate(scores), snap, stats
+
+
+def test_train_serve_parity_through_feedback_roundtrip(tmp_path):
+    """The acceptance bar: features materialized by the retrain-style
+    replay bitwise-match what the serving flush computed for the same rows
+    in the same order — proven end to end through a feedback round-trip
+    (serve → store with entity/ts → replay from the stamped snapshot)."""
+    from fraud_detection_tpu.lifecycle.store import LifecycleStore
+
+    model, profile, spec, state, _, t_max = _widened_model()
+    rng = np.random.default_rng(8)
+    bs, nb = 64, 5
+    batches = []
+    t = t_max + 5.0
+    for _ in range(nb):
+        rows = rng.standard_normal((bs, D)).astype(np.float32)
+        rows[:, -1] = np.abs(rows[:, -1]) * 50.0
+        ents = [f"card-{i % 9}" if i % 7 else None for i in range(bs)]
+        ts = np.asarray([t + i for i in range(bs)], np.float32)
+        t += bs
+        batches.append((rows, ents, ts))
+    served, snap, _ = _serve_batches(model, profile, spec, state, batches)
+
+    # feedback round-trip: the scored rows land durably WITH entity/ts
+    store = LifecycleStore(f"sqlite:///{tmp_path}/lc.db", seed=1)
+    for rows, ents, ts in batches:
+        store.add_feedback(
+            rows, np.full(bs, 0.5, np.float32), np.zeros(bs, np.int64),
+            entity_ids=ents, timestamps=[float(v) for v in ts],
+        )
+    fx, _, _, fe, ft = store.window_rows_meta()
+    store.close()
+    assert fx.shape[0] == bs * nb and len(fe) == bs * nb
+    # rebuild the replay exactly as the retrain does: same rows, recorded
+    # entity/ts, timestamp order, from the champion's stamped snapshot
+    order = np.argsort(ft, kind="stable")
+    feats, replay_state = materialize_features(
+        spec, fx[order], [fe[i] for i in order], ft[order],
+        state=state, batch=bs,
+    )
+    xw = np.concatenate([fx[order], feats], axis=1).astype(np.float32)
+    replay_scores = np.asarray(
+        model.scorer.predict_proba(xw), np.float32
+    )[np.argsort(order, kind="stable")]
+    # the ledger tables must agree bit for bit; scores to float ulps (the
+    # fused program's GEMV fuses the concat differently)
+    for name, a, b in zip(snap._fields, snap, replay_state):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), name
+    # window_rows_meta fetches newest-first (seq DESC); the replay was
+    # un-sorted back to FETCH order, so reverse it into serve order
+    np.testing.assert_allclose(served, replay_scores[::-1], atol=2e-6, rtol=0)
+
+
+def test_null_entity_rows_use_reserved_null_slot_and_count():
+    """Entity-less rows: score == widened scoring with the stamped null
+    features (the intercept fold is exact), the table stays untouched by
+    them, and the counter advances."""
+    from fraud_detection_tpu.service import metrics
+
+    model, profile, spec, state, _, t_max = _widened_model()
+    rng = np.random.default_rng(4)
+    rows = rng.standard_normal((16, D)).astype(np.float32)
+    before = metrics.ledger_null_entity_rows._value.get()
+    batches = [(rows, [None] * 16, np.zeros(16, np.float32))]
+    served, snap, _ = _serve_batches(model, profile, spec, state, batches)
+    assert metrics.ledger_null_entity_rows._value.get() == before + 16
+    xw = np.concatenate(
+        [rows, np.broadcast_to(spec.null_features, (16, LEDGER_K))], axis=1
+    ).astype(np.float32)
+    ref = np.asarray(model.scorer.predict_proba(xw), np.float32)
+    np.testing.assert_allclose(served, ref, atol=2e-6, rtol=0)
+    base_ref = np.asarray(model.scorer.predict_proba(rows), np.float32)
+    # the intercept fold is mathematically exact; the summation order
+    # differs (b + nf·w_L folded vs the widened GEMV), so float ulps
+    np.testing.assert_allclose(base_ref, ref, atol=1e-6, rtol=0)
+    for name, a, b in zip(state._fields, state, snap):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), name
+
+
+def test_widened_explain_leg_names_ledger_features():
+    """SCORER_EXPLAIN=topk over a widened family: reason codes can rank a
+    velocity feature, and indices stay within the widened width."""
+    model, profile, spec, state, _, t_max = _widened_model()
+    from fraud_detection_tpu.monitor.watchtower import Thresholds, Watchtower
+
+    wt = Watchtower(
+        profile, thresholds=Thresholds(5.0, 5.0, 5.0, 1.0, 10 ** 9),
+        halflife_rows=1e6,
+    )
+    wt.drift.bind_ledger(spec, state)
+    mb = MicroBatcher(
+        scorer=model.scorer, watchtower=wt, telemetry=False,
+        explain=True, explain_k=D + LEDGER_K,  # clamped to widened width
+    )
+    try:
+        tgt = mb._fused_target(model.scorer)
+        rng = np.random.default_rng(1)
+        rows = rng.standard_normal((8, D)).astype(np.float32)
+        items = []
+        for i in range(8):
+            s, fp = spec.row_keys(f"card-{i}")
+            items.append((rows[i], None, None, (s, fp, t_max + 1.0 + i)))
+        out = mb._flush_device(model.scorer, tgt, items, False)
+        explain_out = out[1]
+        assert explain_out is not None
+        ei, ev = explain_out
+        assert ei.shape == (8, D + LEDGER_K)
+        assert int(ei.max()) < D + LEDGER_K
+        # every widened feature appears exactly once per row (full ranking)
+        assert all(len(set(r.tolist())) == D + LEDGER_K for r in ei)
+    finally:
+        wt.close()
+
+
+# -- mesh: hash-mod-shard placement ------------------------------------------
+
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_mesh_ledger_bitwise_matches_single_device(n_shards):
+    from fraud_detection_tpu.mesh.shardflush import MeshDriftMonitor
+    from fraud_detection_tpu.ops.scorer import _raw_score_linear
+    from fraud_detection_tpu.parallel.mesh import MeshSpec, create_mesh
+
+    spec = _spec(slots=256, halflife=500.0)
+    rng = np.random.default_rng(1)
+    xw = rng.standard_normal((2000, D + LEDGER_K)).astype(np.float32)
+    profile = build_baseline_profile(
+        xw, rng.random(800).astype(np.float32),
+        feature_names=[f"f{i}" for i in range(D + LEDGER_K)],
+    )
+    coef = rng.standard_normal(D + LEDGER_K).astype(np.float32)
+    score_args = (jnp.asarray(coef), jnp.float32(0.1))
+    batches = []
+    t = 1.0
+    for _ in range(5):
+        bs = 64
+        x = rng.standard_normal((bs, D)).astype(np.float32)
+        ents = [
+            f"card-{rng.integers(0, 40)}" if rng.random() < 0.85 else None
+            for _ in range(bs)
+        ]
+        slots = np.zeros(bs, np.int32)
+        fps = np.zeros(bs, np.uint32)
+        has = np.zeros(bs, np.float32)
+        ts = np.zeros(bs, np.float32)
+        for i, e in enumerate(ents):
+            if e is None:
+                continue
+            slots[i], fps[i] = spec.row_keys(e)
+            has[i] = 1.0
+            ts[i] = t
+            t += 0.5
+        batches.append((x, slots, fps, ts, has))
+
+    mon = DriftMonitor(profile, halflife_rows=1000.0)
+    mon.bind_ledger(spec)
+    single = []
+    for (x, slots, fps, ts, has) in batches:
+        s = mon.fused_flush(
+            jnp.asarray(x), jnp.ones(x.shape[0], jnp.float32), x.shape[0],
+            score_args, _raw_score_linear,
+            ledger_rows=(
+                jnp.asarray(slots), jnp.asarray(fps),
+                jnp.asarray(ts), jnp.asarray(has),
+            ),
+        )
+        single.append(np.asarray(s))
+    snap = mon.ledger_snapshot()
+
+    mesh = create_mesh(
+        MeshSpec(data=n_shards), devices=jax.devices()[:n_shards]
+    )
+    mmon = MeshDriftMonitor(profile, mesh, halflife_rows=1000.0)
+    mmon.bind_ledger(spec)
+    for bi, (x, slots, fps, ts, has) in enumerate(batches):
+        bucket, pos = shard_placement(slots, has, n_shards, min_bucket=8)
+        xb = np.zeros((bucket, D), np.float32)
+        sl = np.zeros(bucket, np.int32)
+        fb = np.zeros(bucket, np.uint32)
+        tb = np.zeros(bucket, np.float32)
+        hb = np.zeros(bucket, np.float32)
+        vb = np.zeros(bucket, np.float32)
+        xb[pos] = x
+        sl[pos] = slots
+        fb[pos] = fps
+        tb[pos] = ts
+        hb[pos] = has
+        vb[pos] = 1.0
+        s = mmon.fused_flush(
+            jnp.asarray(xb), jnp.asarray(vb), x.shape[0],
+            score_args, _raw_score_linear,
+            ledger_rows=(
+                jnp.asarray(sl), jnp.asarray(fb),
+                jnp.asarray(tb), jnp.asarray(hb),
+            ),
+        )
+        np.testing.assert_array_equal(np.asarray(s)[pos], single[bi])
+    snap_m = mmon.ledger_snapshot()
+    for name, a, b in zip(snap._fields, snap, snap_m):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), name
+
+
+def test_shard_placement_respects_hash_mod_shard():
+    rng = np.random.default_rng(0)
+    slots = rng.integers(0, 512, 50).astype(np.int64)
+    has = np.ones(50, bool)
+    has[::7] = False
+    bucket, pos = shard_placement(slots, has, 4, min_bucket=8)
+    assert bucket % 4 == 0 and bucket >= 50
+    seg = bucket // 4
+    assert len(set(pos.tolist())) == 50  # injective
+    for i in range(50):
+        if has[i]:
+            assert pos[i] // seg == slots[i] % 4
+
+
+# -- lifecycle: hot swap + retrain -------------------------------------------
+
+def test_hot_swap_rebinds_ledger_with_zero_recompiles(tmp_path, monkeypatch):
+    """A promoted widened champion rebinds model + table snapshot through
+    the reloader; the next flush compiles nothing (same shapes)."""
+    from fraud_detection_tpu.lifecycle.swap import ModelReloader, ModelSlot
+    from fraud_detection_tpu.monitor.watchtower import Thresholds, Watchtower
+    from fraud_detection_tpu.tracking import TrackingClient
+
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    model, profile, spec, state, _, t_max = _widened_model(seed=3)
+    model2, profile2, spec2, state2, _, _ = _widened_model(seed=12)
+    art = str(tmp_path / "v2")
+    model2.save(art, joblib_too=False)
+    from fraud_detection_tpu.monitor.baseline import save_profile
+
+    save_profile(art, profile2)
+    client = TrackingClient()
+    v2 = client.registry.register("fraud", art)
+    client.registry.set_alias("fraud", "prod", v2)
+
+    wt = Watchtower(
+        profile, thresholds=Thresholds(5.0, 5.0, 5.0, 1.0, 10 ** 9),
+        halflife_rows=1e6,
+    )
+    wt.drift.bind_ledger(spec, state)
+    slot = ModelSlot(model, "test:v0", 0)  # any version ≠ the registered one
+    mb = MicroBatcher(slot=slot, watchtower=wt, telemetry=False)
+
+    async def drive(n=8, t0=1e6):
+        outs = []
+        for i in range(n):
+            s, fp = spec.row_keys(f"card-{i}")
+            outs.append(
+                await mb.score(
+                    np.zeros(D, np.float32), entity=(s, fp, t0 + i)
+                )
+            )
+        return outs
+
+    async def run():
+        await mb.start()
+        try:
+            await drive()
+            reloader = ModelReloader(slot, watchtower=wt, interval=0)
+            from fraud_detection_tpu.monitor.drift import _fused_flush_ledger
+            from fraud_detection_tpu.telemetry import compile_sentinel
+
+            # the sentinel may not be installed in this test process — use
+            # the jit cache directly for the exact-count assertion
+            cache_before = _fused_flush_ledger._cache_size()
+            out = reloader.check_once()
+            assert "swapped to v" in out["champion"]
+            assert slot.version == v2
+            # the watchtower's drift monitor now carries v2's snapshot
+            snap = wt.drift.ledger_snapshot()
+            for name, a, b in zip(snap._fields, snap, state2):
+                assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), (
+                    name
+                )
+            await drive(t0=2e6)
+            assert _fused_flush_ledger._cache_size() == cache_before, (
+                "hot swap must not recompile the ledger flush"
+            )
+            del compile_sentinel
+        finally:
+            await mb.stop()
+
+    try:
+        asyncio.run(run())
+    finally:
+        wt.close()
+
+
+def test_retrain_replays_feedback_into_widened_challenger(
+    tmp_path, monkeypatch
+):
+    """A widened champion retrains: base + feedback replay through the
+    body, the challenger comes out widened with a stamped ledger sidecar,
+    and the gate evaluates on widened slices."""
+    from fraud_detection_tpu.lifecycle.gate import GateThresholds
+    from fraud_detection_tpu.lifecycle.retrain import run_retrain
+    from fraud_detection_tpu.lifecycle.store import LifecycleStore
+
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    rng = np.random.default_rng(11)
+    n = 900
+    x = rng.standard_normal((n, D)).astype(np.float32)
+    x[:, -1] = np.abs(x[:, -1]) * 50.0
+    w_true = rng.standard_normal(D).astype(np.float32)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x @ w_true - 2.0)))).astype(
+        np.int32
+    )
+    csv = str(tmp_path / "base.csv")
+    with open(csv, "w") as f:
+        f.write(",".join(KAGGLE + ["Class"]) + "\n")
+        for row, label in zip(x, y):
+            f.write(",".join(f"{v:.6f}" for v in row) + f",{int(label)}\n")
+
+    model, _, spec, state, _, _ = _widened_model(seed=3)
+    store = LifecycleStore(
+        f"sqlite:///{tmp_path}/lc.db", window_size=600, reservoir_size=100,
+        seed=2,
+    )
+    fx = rng.standard_normal((300, D)).astype(np.float32)
+    fy = (rng.random(300) < 0.3).astype(np.int64)
+    store.add_feedback(
+        fx, np.full(300, 0.4, np.float32), fy,
+        entity_ids=[f"card-{i % 20}" for i in range(300)],
+        timestamps=[1e9 + i for i in range(300)],
+    )
+    loose = GateThresholds(
+        auc_margin=0.5, ece_bound=1.0, psi_bound=10.0, min_eval_rows=32
+    )
+    res = run_retrain(
+        store, model, champion_version=1, data_csv=csv, use_smote=False,
+        max_iter=60, thresholds=loose,
+    )
+    store.close()
+    ch = res.challenger
+    assert ch is not None
+    assert ch.ledger_spec is not None
+    assert ch.scorer.n_features == D + LEDGER_K
+    assert list(ch.feature_names) == WIDE
+    # the sidecar is stamped beside the weights in the artifact dir
+    loaded = load_ledger(res.artifact_dir)
+    assert loaded is not None
+    assert loaded[0].slots == spec.slots
+    assert "challenger_auc_holdout" in res.gate.metrics or res.gate.metrics
+
+
+# -- sentinel / meshcheck -----------------------------------------------------
+
+def test_ledger_flush_sentinel_exact_counts_across_ladder():
+    """The warmed bucket ladder compiles exactly one ledger.flush
+    executable per bucket; steady-state traffic compiles nothing."""
+    from fraud_detection_tpu.monitor import drift as drift_mod
+    from fraud_detection_tpu.telemetry import compile_sentinel
+
+    # a distinct table size: the jit cache is process-global, so earlier
+    # tests' executables (slots=512) must not mask this ladder's compiles
+    model, profile, spec, state, _, t_max = _widened_model(
+        seed=6, spec=_spec(slots=1024)
+    )
+    installed = compile_sentinel.install()
+    try:
+        from fraud_detection_tpu.service import metrics
+
+        c = metrics.xla_compiles.labels("ledger.flush")
+        before = c._value.get()
+        mon = DriftMonitor(profile)
+        mon.bind_ledger(spec, state)
+        for b in (8, 16, 32):
+            mon.warm_fused(model.scorer, b)
+        after_warm = c._value.get()
+        assert after_warm - before == 3, (
+            f"expected exactly 3 ladder compiles, got {after_warm - before}"
+        )
+        # steady state: a live batch on a warmed bucket compiles nothing
+        rng = np.random.default_rng(0)
+        rows = [rng.standard_normal(D).astype(np.float32) for _ in range(8)]
+        slot = model.scorer.staging.acquire(8)
+        hx = model.scorer.stage_rows(slot, rows)
+        slot.ensure_ledger()
+        for j in range(8):
+            s, fp = spec.row_keys(f"card-{j}")
+            slot.ls[j] = s
+            slot.lf[j] = fp
+            slot.lt[j] = t_max + 1.0 + j
+            slot.lh[j] = 1.0
+        sp = model.scorer.fused_spec()
+        out = mon.fused_flush(
+            jnp.asarray(hx), jnp.asarray(slot.valid), 8,
+            sp.score_args, sp.score_fn,
+            ledger_rows=(
+                jnp.asarray(slot.ls), jnp.asarray(slot.lf),
+                jnp.asarray(slot.lt), jnp.asarray(slot.lh),
+            ),
+        )
+        np.asarray(out)
+        model.scorer.staging.release(slot)
+        assert c._value.get() == after_warm, "steady state must not compile"
+        assert drift_mod._fused_flush_ledger._spyglass_entrypoint == (
+            "ledger.flush"
+        )
+    finally:
+        if installed:
+            compile_sentinel.uninstall()
+
+
+def test_meshcheck_includes_ledger_entrypoints():
+    from fraud_detection_tpu.analysis.meshcheck import (
+        iter_entrypoints,
+        verify_entrypoint,
+    )
+
+    eps = {e.name: e for e in iter_entrypoints()}
+    assert "ledger.flush" in eps and "mesh.ledger_flush" in eps
+    for name in ("ledger.flush", "mesh.ledger_flush"):
+        for res in verify_entrypoint(eps[name]):
+            assert res["ok"], res
+
+
+# -- API schema ---------------------------------------------------------------
+
+def test_parse_entity_validation():
+    from fraud_detection_tpu.service.schemas import parse_entity
+
+    assert parse_entity({}) == (None, None)
+    assert parse_entity({"entity_id": "card-1"}) == ("card-1", None)
+    assert parse_entity({"entity_id": 42, "timestamp": 1.5}) == ("42", 1.5)
+    for bad in (
+        {"entity_id": ["x"]},
+        {"entity_id": True},
+        {"entity_id": ""},
+        {"entity_id": "x" * 300},
+        {"timestamp": "soon"},
+        {"timestamp": -1.0},
+        {"timestamp": float("nan")},
+        {"timestamp": float("inf")},
+    ):
+        with pytest.raises(ValueError):
+            parse_entity(bad)
+
+
+def test_store_rejects_misaligned_or_bad_entity_meta(tmp_path):
+    from fraud_detection_tpu.lifecycle.store import LifecycleStore
+
+    store = LifecycleStore(f"sqlite:///{tmp_path}/lc.db")
+    x = np.zeros((3, D), np.float32)
+    s = np.full(3, 0.5, np.float32)
+    y = np.zeros(3, np.int64)
+    with pytest.raises(ValueError):
+        store.add_feedback(x, s, y, entity_ids=["a"])  # misaligned
+    with pytest.raises(ValueError):
+        store.add_feedback(x, s, y, timestamps=[1.0, 2.0, -3.0])
+    # None entries are fine (entity-less rows replay through the null slot)
+    store.add_feedback(x, s, y, entity_ids=["a", None, "c"],
+                       timestamps=[1.0, None, 3.0])
+    fx, _, _, fe, ft = store.window_rows_meta()
+    assert fe[1] is None and ft[1] == 0.0  # newest-first: row index 1 = "b"
+    store.close()
+
+
+def test_feedback_calibration_on_widened_window_does_not_crash():
+    """/monitor/feedback path regression: base-width labeled rows folding
+    into a WIDENED drift window (feature_edges span base+K) must update
+    the calibration state, not die on a broadcast error swallowed by the
+    ingest loop."""
+    model, profile, spec, state, _, _ = _widened_model()
+    mon = DriftMonitor(profile, halflife_rows=1e6)
+    mon.bind_ledger(spec, state)
+    rng = np.random.default_rng(0)
+    rows = rng.standard_normal((600, D)).astype(np.float32)  # BASE width
+    scores = rng.random(600).astype(np.float32)
+    labels = (rng.random(600) < 0.3).astype(np.float32)
+    mon.update(rows, scores, labels, calibration_only=True)
+    s = mon.stats()
+    assert s["n_labeled"] == pytest.approx(600, rel=1e-3)
+    assert np.isfinite(s["ece"])
+
+
+def test_shadow_comparison_handles_widened_challenger():
+    """A widened challenger shadowing base-width monitor rows: scoring
+    rides the null path and the reason comparison explains through the
+    challenger's null slot — no crash, divergence accumulates."""
+    from fraud_detection_tpu.monitor.shadow import ShadowScorer
+    from fraud_detection_tpu.monitor.watchtower import _challenger_explainer
+
+    model, profile, spec, state, _, _ = _widened_model()
+    ex = _challenger_explainer(model)
+    assert ex is not None and ex[2] is not None  # widened → null triple
+    sh = ShadowScorer(model.scorer, profile, sample_rate=1.0, explainer=ex)
+    rng = np.random.default_rng(0)
+    rows = rng.standard_normal((32, D)).astype(np.float32)  # BASE width
+    champ_idx = np.tile(np.arange(3), (32, 1))
+    assert sh.maybe_observe(rows, np.full(32, 0.5, np.float32), champ_idx)
+    st = sh.stats()
+    assert st["reason_divergence"] is not None
+    assert np.isfinite(st["score_psi"]) and st["window_rows"] > 0
+
+
+def test_ledger_occupancy_decays_with_the_table_clock():
+    """A slot whose entity stopped transacting must fall OUT of the
+    occupancy once its evidence decays past the table's own clock — the
+    LedgerSaturated input cannot be an ever-claimed ratchet."""
+    spec = _spec(slots=64, halflife=10.0)
+    step = _step()
+    dev = device_state(None, spec.slots)
+    one = jnp.ones(8, jnp.float32)
+    # entity A: 8 events at t≈1; entity B: 8 events at t≈1000 (100 halflives on)
+    _, dev = step(
+        dev, jnp.full((8,), 3, jnp.int32), jnp.full((8,), 9, jnp.uint32),
+        jnp.arange(1.0, 9.0, dtype=jnp.float32), one, one,
+        jnp.zeros(LEDGER_K, jnp.float32), jnp.float32(spec.halflife_s),
+    )
+    from fraud_detection_tpu.ledger.features import ledger_stats as lstats
+
+    assert lstats(dev, spec.halflife_s)["slot_occupancy"] > 0
+    _, dev = step(
+        dev, jnp.full((8,), 17, jnp.int32), jnp.full((8,), 11, jnp.uint32),
+        jnp.full((8,), 1000.0, jnp.float32), one, one,
+        jnp.zeros(LEDGER_K, jnp.float32), jnp.float32(spec.halflife_s),
+    )
+    s = lstats(dev, spec.halflife_s)
+    assert s["slot_occupancy"] == pytest.approx(1 / 64)  # only B still live
+    assert s["slots_claimed_frac"] == pytest.approx(2 / 64)  # A still claimed
+
+
+# -- shadow reason divergence (lantern × ledger satellite) -------------------
+
+def test_shadow_reason_divergence_tracks_jaccard():
+    from fraud_detection_tpu.monitor.shadow import ShadowScorer
+    from fraud_detection_tpu.ops.scorer import BatchScorer
+
+    rng = np.random.default_rng(0)
+    xw = rng.standard_normal((800, D)).astype(np.float32)
+    coef = rng.standard_normal(D).astype(np.float32)
+    champ = BatchScorer(LogisticParams(coef=jnp.asarray(coef),
+                                       intercept=jnp.float32(0.0)), None)
+    profile = build_baseline_profile(
+        xw, np.asarray(champ.predict_proba(xw)),
+        feature_names=[f"f{i}" for i in range(D)],
+    )
+    # identical challenger → divergence exactly 0
+    same = ShadowScorer(
+        champ, profile, sample_rate=1.0,
+        explainer=(np.asarray(coef, np.float64), np.zeros(D)),
+    )
+    rows = xw[:32]
+    k = 3
+    phi = coef[None, :] * rows
+    champ_idx = np.argsort(-phi, axis=1, kind="stable")[:, :k]
+    assert same.maybe_observe(rows, np.full(32, 0.5), champ_idx)
+    assert same.stats()["reason_divergence"] == pytest.approx(0.0)
+    # a reversed-coef challenger explains differently → divergence > 0
+    flipped = ShadowScorer(
+        champ, profile, sample_rate=1.0,
+        explainer=(-np.asarray(coef, np.float64), np.zeros(D)),
+    )
+    assert flipped.maybe_observe(rows, np.full(32, 0.5), champ_idx)
+    assert flipped.stats()["reason_divergence"] > 0.1
+    # no explainer / no reasons → None, never a crash
+    bare = ShadowScorer(champ, profile, sample_rate=1.0)
+    assert bare.maybe_observe(rows, np.full(32, 0.5), champ_idx)
+    assert bare.stats()["reason_divergence"] is None

@@ -254,6 +254,50 @@ def test_mesh_alert_metrics_exist_in_registry():
     assert not missing, f"alert rules reference unexported metrics: {missing}"
 
 
+def test_ledger_rules_file_ships():
+    """The ledger contract (ISSUE 10): ledger-alerts.yml ships
+    promlint-clean with the saturation + collision-storm alerts."""
+    path = os.path.join(RULES_DIR, "ledger-alerts.yml")
+    assert os.path.exists(path)
+    assert promlint.lint_rules_file(path) == []
+    with open(path) as f:
+        text = f.read()
+    assert "LedgerSaturated" in text
+    assert "LedgerCollisionStorm" in text
+    assert "LedgerSaturated.md" in text  # runbook link
+
+
+def test_ledger_alert_metrics_exist_in_registry():
+    exported = _exported_metric_names()
+    with open(os.path.join(RULES_DIR, "ledger-alerts.yml")) as f:
+        text = f.read()
+    referenced = set(re.findall(r"\b(ledger_[a-z_]+)\b", text))
+    referenced -= {"ledger_alerts"}
+    assert referenced, "ledger rules reference no ledger metrics?"
+    missing = {
+        name for name in referenced
+        if name not in exported
+        and name.removesuffix("_total") not in exported
+        and f"{name}_total" not in exported
+    }
+    assert not missing, f"alert rules reference unexported metrics: {missing}"
+
+
+def test_grafana_ledger_panels_present():
+    """Both dashboards carry the ledger row (occupancy + collision/null
+    rates) and the lantern-aware shadow reason-divergence panel."""
+    for rel in (
+        "grafana_dashboard.json",
+        os.path.join("grafana_provisioning", "dashboards", "fraud-tpu.json"),
+    ):
+        with open(os.path.join(MONITORING, rel)) as f:
+            text = f.read()
+        assert "ledger_slot_occupancy" in text, rel
+        assert "ledger_hash_collisions_total" in text, rel
+        assert "ledger_null_entity_rows_total" in text, rel
+        assert "watchtower_shadow_reason_divergence" in text, rel
+
+
 def test_grafana_switchyard_row_present():
     """Both dashboards carry the switchyard panels (shard health, per-shard
     rates, in-flight)."""

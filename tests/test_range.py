@@ -442,6 +442,17 @@ def test_scenario_replica_burst():
 
 
 @pytest.mark.slow
+def test_scenario_poison_entity_state():
+    """Ledger satellite (ISSUE 10): one entity hammered with NaN/extreme
+    amounts through the ``ledger.update`` injection point — the poison
+    clamp bounds the victim slot, every other entity's aggregates stay
+    bitwise-unaffected vs a clean run, scores stay finite, p99 holds."""
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario("poison_entity_state").raise_if_failed()
+
+
+@pytest.mark.slow
 def test_scenario_explain_under_burst():
     """Lantern chaos (ISSUE 9): Pareto burst with SCORER_EXPLAIN=topk fused
     into every flush and a shard killed mid-burst — p99 holds, every scored
