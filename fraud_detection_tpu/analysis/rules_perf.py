@@ -126,3 +126,51 @@ def check_hot_path_alloc(mod: ModuleInfo) -> Iterator[Finding]:
                     f"array inside hot-path region {fn.name!r} — pass "
                     "out=<staging slot> (ops/scorer.StagingPool) instead",
                 )
+
+
+#: per-row interpreter work the hyperloop ingest path exists to remove:
+#: a json.loads/dumps call costs ~µs per KB, and a list/dict/set
+#: comprehension over the batch rebuilds one Python object per ROW — both
+#: re-introduce exactly the per-row costs the binary lane deleted. The
+#: sanctioned replacements are the fixed-layout frame decode
+#: (service/binlane: np.frombuffer views + bulk copies into pooled
+#: staging) and vectorized numpy column math.
+_JSON_CALLS = {"json.loads", "json.dumps"}
+_COMP_NODES = (ast.ListComp, ast.DictComp, ast.SetComp)
+_COMP_NAME = {
+    ast.ListComp: "list comprehension",
+    ast.DictComp: "dict comprehension",
+    ast.SetComp: "set comprehension",
+}
+
+
+@register_rule(
+    "hot-path-json",
+    Severity.WARNING,
+    "json.loads/json.dumps or a per-row list/dict comprehension inside a "
+    "region marked '# graftcheck: hot-path' — the steady-state ingest/"
+    "flush path must decode fixed-layout frames into pooled staging "
+    "(service/binlane) and use vectorized column math, never rebuild "
+    "per-row Python objects",
+)
+def check_hot_path_json(mod: ModuleInfo) -> Iterator[Finding]:
+    rule = check_hot_path_json.rule
+    for fn in _marked_functions(mod):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                if callee in _JSON_CALLS:
+                    yield mod.finding(
+                        rule, node,
+                        f"{callee}(...) inside hot-path region {fn.name!r} "
+                        "— JSON (de)serialization is per-request "
+                        "interpreter work; use the fixed-layout binary "
+                        "frame decode (service/binlane) instead",
+                    )
+            elif isinstance(node, _COMP_NODES):
+                yield mod.finding(
+                    rule, node,
+                    f"{_COMP_NAME[type(node)]} inside hot-path region "
+                    f"{fn.name!r} builds one Python object per element — "
+                    "vectorize over the staged numpy columns instead",
+                )

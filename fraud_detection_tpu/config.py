@@ -270,9 +270,70 @@ def scorer_adaptive_wait() -> bool:
     """``SCORER_ADAPTIVE_WAIT=1``: scale the micro-batcher's collection
     deadline with an arrival-rate EWMA — light traffic flushes almost
     immediately (p50 ≈ one dispatch), heavy traffic waits up to
-    ``SCORER_MAX_WAIT_MS`` to fill buckets. Default off: the fixed
-    ``SCORER_MAX_WAIT_MS`` deadline."""
+    ``SCORER_MAX_WAIT_MS`` to fill buckets. The rate EWMA counts ROWS, not
+    requests, so a binary-lane frame of 512 rows weighs the same as 512
+    single-row requests (hyperloop continuous batching). Default off: the
+    fixed ``SCORER_MAX_WAIT_MS`` deadline."""
     return env_flag("SCORER_ADAPTIVE_WAIT") is True
+
+
+# --------------------------------------------------------------------------
+# Hyperloop: zero-copy binary ingest lane + continuous batching
+# (service/binlane; docs/ARCHITECTURE.md "hyperloop")
+# --------------------------------------------------------------------------
+
+def ingest_port() -> int:
+    """``INGEST_PORT`` — TCP port of the persistent-connection binary
+    ingest lane (length-prefixed frames, columnar f32/int8 row blocks
+    parsed straight into the scorer's staging pool). 0 (default) disables
+    the lane; the HTTP ``/ingest/batch`` endpoint serves frame-shaped and
+    msgpack batch POSTs either way."""
+    return _get_int("INGEST_PORT", 0)
+
+
+def ingest_host() -> str:
+    """``INGEST_HOST`` — bind address of the binary ingest lane."""
+    return _get("INGEST_HOST", "0.0.0.0")
+
+
+def ingest_max_rows() -> int:
+    """``INGEST_MAX_ROWS`` — per-frame row ceiling on the ingest lanes.
+    0 (default) = ``SCORER_MAX_BATCH``: a frame never exceeds one flush
+    bucket, so the warmed executable ladder covers every frame."""
+    return _get_int("INGEST_MAX_ROWS", 0)
+
+
+def ingest_max_frame() -> int:
+    """``INGEST_MAX_FRAME_BYTES`` — hard ceiling on one binary frame's
+    payload (the wire.py MAX_FRAME discipline, sized for row blocks rather
+    than store snapshots). An oversized length prefix is answered with an
+    error frame and the connection is closed — it is never buffered."""
+    return _get_int("INGEST_MAX_FRAME_BYTES", 8 << 20)
+
+
+def ingest_stall_timeout_s() -> float:
+    """``INGEST_STALL_TIMEOUT_S`` — per-recv progress timeout on ingest
+    connections (the wire.py CONN_STALL_TIMEOUT discipline): idle at a
+    frame boundary just re-arms; a peer stalling MID-frame is dropped
+    (StalledPeerError) instead of wedging a handler thread."""
+    return _get_float("INGEST_STALL_TIMEOUT_S", 30.0)
+
+
+def scorer_admit_max_rows() -> int:
+    """``SCORER_ADMIT_MAX_ROWS`` — bound on rows waiting in the
+    micro-batcher's admission queue (hyperloop backpressure). At the bound,
+    admission raises and the edges shed: HTTP answers 429 + ``Retry-After``
+    (the PR-6/7 degradation contract), the binary lane answers a busy
+    frame carrying the same retry hint — overload sheds instead of growing
+    an unbounded queue. 0 disables the bound (pre-hyperloop behavior)."""
+    return _get_int("SCORER_ADMIT_MAX_ROWS", 65536)
+
+
+def scorer_admit_retry_after_s() -> float:
+    """``SCORER_ADMIT_RETRY_AFTER_S`` — the retry hint a shed admission
+    carries (HTTP ``Retry-After`` header / busy-frame field). One flush
+    window is usually enough for the queue to drain; default 1s."""
+    return _get_float("SCORER_ADMIT_RETRY_AFTER_S", 1.0)
 
 
 # --------------------------------------------------------------------------

@@ -78,14 +78,15 @@ class ShardHandle:
         self._g_healthy.set(1)
         self._g_inflight.set(0)
 
-    def note_ok(self) -> bool:
-        """Record one scoring success; returns True when this success was
-        a half-open probe resolving — the shard revives (the caller
-        refreshes the health gauge)."""
+    def note_ok(self, rows: int = 1) -> bool:
+        """Record one scoring success (``rows`` > 1 for an ingest block —
+        a frame counts its rows, so ShardLoadSkew reads true row rates);
+        returns True when this success was a half-open probe resolving —
+        the shard revives (the caller refreshes the health gauge)."""
         self.consecutive_errors = 0
         self.probation = False
-        self.rows_total += 1
-        self._c_rows.inc()
+        self.rows_total += rows
+        self._c_rows.inc(rows)
         if self.state == HALF_OPEN:
             self.set_state(HEALTHY)
             return True
@@ -262,9 +263,22 @@ class ShardFront:
         mid-burst re-routes the row WITH its explain output intact."""
         return await self._route("score_ex", row, timeline, entity)
 
+    async def score_block(self, block, timeline=None, entity=None):
+        """Route one hyperloop ingest block (the binary lane / packed POST
+        frame) as a unit: the whole frame lands on ONE shard's forming
+        bucket (frames keep buckets full instead of scattering), with the
+        same shed/retry semantics as :meth:`score`. A shard whose
+        admission queue is full is NOT an error — the block tries the
+        other shards and sheds (AdmissionFull → 429/busy at the edge)
+        only when every healthy shard is saturated."""
+        return await self._route("score_block", block, timeline, entity)
+
     async def _route(self, method: str, row, timeline=None, entity=None):
+        from fraud_detection_tpu.service.microbatch import AdmissionFull
+
         last_exc: BaseException | None = None
         tried: set[int] = set()
+        n_rows = row.n if method == "score_block" else 1
         for _ in range(len(self.shards)):
             try:
                 h = self.pick(exclude=tried, entity=entity)
@@ -273,7 +287,7 @@ class ShardFront:
                     raise last_exc
                 raise
             tried.add(h.shard_id)
-            h.inflight += 1
+            h.inflight += n_rows
             h._g_inflight.set(h.inflight)
             try:
                 # fraud-range injection point: a chaos plan fails a named
@@ -283,6 +297,12 @@ class ShardFront:
                 out = await getattr(h.batcher, method)(
                     row, timeline, entity
                 )
+            except AdmissionFull as e:
+                # backpressure, not failure: the shard is healthy but
+                # saturated — try the others without burning its error
+                # budget, and surface the shed if all are full
+                last_exc = e
+                continue
             except Exception as e:
                 last_exc = e
                 if h.note_error(e):
@@ -294,14 +314,15 @@ class ShardFront:
                     )
                 continue
             else:
-                if h.note_ok():  # a half-open probe resolved: shard revived
+                # a half-open probe resolved: shard revived
+                if h.note_ok(n_rows):
                     self._refresh_health_gauge()
                     log.warning(
                         "shard %d revived by half-open probe", h.shard_id
                     )
                 return out
             finally:
-                h.inflight -= 1
+                h.inflight -= n_rows
                 h._g_inflight.set(h.inflight)
         raise last_exc if last_exc is not None else NoHealthyShards(
             "no healthy shards"

@@ -370,6 +370,58 @@ class _BucketedScorer:
         slot.valid[n:] = 0.0
         return self._encode_slot(slot)
 
+    def stage_items(self, slot: _StagingSlot, items: list) -> np.ndarray:
+        """Stage a mixed micro-batch of queue items — single rows (1-D
+        ``item[0]``) and hyperloop ingest blocks (2-D ``item[0]``, a view
+        into a pooled ingest slot) — contiguously into the flush slot.
+        Blocks land with ONE bulk ``np.copyto`` each (no per-row Python
+        objects), single rows with one row assignment; same zero-alloc
+        contract as :meth:`stage_rows`."""
+        # graftcheck: hot-path — runs once per micro-batch flush; every
+        # buffer below is preallocated pool state, never a fresh array
+        off = 0
+        f32 = slot.f32
+        for item in items:
+            rows = item[0]
+            if rows.ndim == 2:
+                k = rows.shape[0]
+                np.copyto(f32[off:off + k], rows, casting="unsafe")
+                off += k
+            else:
+                f32[off] = rows
+                off += 1
+        f32[off:] = 0.0
+        slot.valid[:off] = 1.0
+        slot.valid[off:] = 0.0
+        return self._encode_slot(slot)
+
+    def stage_items_placed(
+        self, slot: _StagingSlot, items: list, positions
+    ) -> np.ndarray:
+        """Placement variant of :meth:`stage_items` for the sharded ledger
+        flush: row ``i`` (row-major across items, blocks expanded) lands at
+        ``positions[i]``. Single rows place one at a time; a block scatters
+        in ONE fancy-index assignment (the same vectorized scatter the
+        entity-column staging uses)."""
+        # graftcheck: hot-path
+        slot.f32[:] = 0.0
+        slot.valid[:] = 0.0
+        i = 0
+        for item in items:
+            rows = item[0]
+            if rows.ndim == 2:
+                k = rows.shape[0]
+                pos = positions[i:i + k]
+                slot.f32[pos] = rows
+                slot.valid[pos] = 1.0
+                i += k
+            else:
+                p = positions[i]
+                slot.f32[p] = rows
+                slot.valid[p] = 1.0
+                i += 1
+        return self._encode_slot(slot)
+
     def stage_rows_placed(self, slot: _StagingSlot, rows: list, positions) -> np.ndarray:
         """Placement staging for the sharded ledger flush: each row lands at
         its hash-mod-shard position (ledger/placement.shard_placement) so a

@@ -11,6 +11,10 @@ native, stdlib-based implementations with the same semantics:
 - :mod:`.app`        — the scoring API (same endpoints/middleware/metric
   names as api/app.py)
 - :mod:`.microbatch` — async micro-batching in front of the jitted scorer
+  (hyperloop continuous batching: ingest blocks + bounded admission)
+- :mod:`.binlane`    — the zero-copy binary ingest lane: persistent
+  connections, length-prefixed columnar frames parsed straight into the
+  staging pool (replaces per-request JSON for heavy traffic)
 - :mod:`.taskq`      — SQLite-backed task queue with Celery's delivery
   semantics (acks_late, visibility timeout, retry backoff)
 - :mod:`.worker`     — the XAI worker (replaces xai_tasks.py/api/worker.py,

@@ -53,6 +53,7 @@ import numpy as np
 from fraud_detection_tpu import config
 from fraud_detection_tpu.mesh.front import NoHealthyShards
 from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.service.microbatch import AdmissionFull
 from fraud_detection_tpu.service.db import ResultsDB
 from fraud_detection_tpu.service.http import App, HTTPError, Request, Response
 from fraud_detection_tpu.service.loading import load_production_model
@@ -89,6 +90,25 @@ from fraud_detection_tpu.service.errors import StoreError
 
 _STORE_OUTAGE_ERRORS = (sqlite3.Error, StoreError, OSError)
 STORE_RETRY_AFTER_S = 10  # ≥ the net client's exhausted retry budget
+
+# Hyperloop per-lane edge accounting + stage stamps, bound once (a
+# Counter.labels() lookup costs ~0.6µs — real money at lane rates).
+_LANE_JSON_REQ = metrics.ingest_requests.labels("json")
+_LANE_JSON_ROWS = metrics.ingest_rows.labels("json")
+_LANE_JSON_SHED = metrics.ingest_shed.labels("json")
+_OBSERVE_PARSE = metrics.request_stage_duration.labels("parse").observe
+
+
+def _admission_shed(e: AdmissionFull, lane_shed) -> Response:
+    """The hyperloop backpressure contract: a full admission queue answers
+    429 + Retry-After (not 500, not an unbounded queue) so load balancers
+    and batch clients back off for one flush window."""
+    lane_shed.inc()
+    return Response(
+        {"detail": str(e)},
+        status_code=429,
+        headers={"retry-after": str(max(1, round(e.retry_after_s)))},
+    )
 
 
 def _unavailable(error: str, detail: str, retry_after_s: int) -> Response:
@@ -168,6 +188,7 @@ def create_app(
         "lifecycle_store": None,
         "flightrecorder": None,
         "profiler": None,
+        "binlane": None,
         "started_at": None,
     }
     app.state = state  # exposed for tests/embedding
@@ -198,6 +219,21 @@ def create_app(
         # state["model"] only seeds it at startup.
         slot = state["slot"]
         return slot.model if slot is not None else state["model"]
+
+    def _ingest_scale(model):
+        """The int8-layout dequant scale for the LIVE model, cached per
+        scorer identity — deriving a calibration per /ingest/batch request
+        would pay scaler math on every POST; the scale only changes on a
+        hot swap (which changes the scorer object)."""
+        from fraud_detection_tpu.service import binlane
+
+        scorer = model.scorer
+        cached = state.get("_ingest_scale")
+        if cached is not None and cached[0] is scorer:
+            return cached[1]
+        scale = binlane.ingest_dequant_scale(model)
+        state["_ingest_scale"] = (scorer, scale)
+        return scale
 
     # -- middleware: correlation ID + HTTP metrics -------------------------
     async def correlation_and_metrics(req: Request, nxt):
@@ -325,6 +361,27 @@ def create_app(
             )
             reloader.start()
             state["reloader"] = reloader
+            # Hyperloop binary ingest lane (INGEST_PORT>0): persistent-
+            # connection frame endpoint feeding the SAME batcher (or shard
+            # front) as /predict — scores bitwise-equal across lanes.
+            if config.ingest_port() > 0:
+                try:
+                    from fraud_detection_tpu.service.binlane import (
+                        BinaryIngestServer,
+                    )
+
+                    lane = BinaryIngestServer(
+                        batcher,
+                        scorer_fn=lambda: state["slot"].model.scorer,
+                        model_fn=lambda: state["slot"].model,
+                    )
+                    lane.start(asyncio.get_running_loop())
+                    state["binlane"] = lane
+                except Exception as e:
+                    # the HTTP lanes keep serving; the fast lane is the
+                    # optimization, never the availability story
+                    state["binlane"] = None
+                    log.error("binary ingest lane failed to start: %s", e)
             metrics.model_loaded.set(1)
         except RuntimeError as e:
             metrics.model_loaded.set(0)
@@ -337,6 +394,9 @@ def create_app(
             log.error("model load/warmup failed at startup: %s", e)
 
     async def shutdown():
+        if state.get("binlane"):
+            await asyncio.to_thread(state["binlane"].stop)
+            state["binlane"] = None
         if state["reloader"]:
             state["reloader"].stop()
         if state["batcher"]:
@@ -404,6 +464,7 @@ def create_app(
             # batcher can be None with a loaded model if its startup warmup
             # raised (e.g. device compile failure) — degraded, not a 500.
             raise HTTPError(503, "model not loaded")
+        t_parse = time.perf_counter()
         try:
             payload = req.json()
             features = parse_transaction(payload)
@@ -411,6 +472,13 @@ def create_app(
             entity_id, event_ts = parse_entity(payload)
         except ValueError as e:
             raise HTTPError(422, str(e)) from e
+        # hyperloop lane telemetry: how much of the request went to JSON
+        # parsing (the IngestParseDominates alert input). Requests count
+        # at accept; the ROW counts only after a successful score, so the
+        # per-lane row accounting stays comparable under overload (the
+        # batch lanes count rows post-score too).
+        _OBSERVE_PARSE(time.perf_counter() - t_parse)
+        _LANE_JSON_REQ.inc()
 
         # ledger: hash the entity once at the edge (host-side multiply-
         # shift — ledger/state); the (slot, fingerprint, timestamp) triple
@@ -447,6 +515,10 @@ def create_app(
                         score = await state["batcher"].score(
                             row, timeline=timeline, entity=entity
                         )
+                except AdmissionFull as e:
+                    # bounded admission queue at capacity: shed with the
+                    # 429 + Retry-After backpressure contract
+                    return _admission_shed(e, _LANE_JSON_SHED)
                 except NoHealthyShards as e:
                     # every switchyard shard dead/draining: a known,
                     # retryable capacity outage — same 503 + Retry-After
@@ -459,6 +531,7 @@ def create_app(
                         str(e),
                         max(int(config.mesh_shard_reopen_s()), 1),
                     )
+            _LANE_JSON_ROWS.inc()
             if timeline is not None:
                 # re-emit the stage decomposition as child spans of this
                 # predict span (explicit timestamps from the timeline)
@@ -522,6 +595,118 @@ def create_app(
                 reason_codes=reason_codes,
             ).model_dump()
         )
+
+    @app.post("/ingest/batch")
+    async def ingest_batch(req: Request) -> Response:
+        """Hyperloop batch lane for clients that can't hold a socket: one
+        POST scores a whole row block through the same continuous-batching
+        admission as the binary lane (one IngestBlock, one future — never
+        per-row futures). Two content types:
+
+        - ``application/x-fraud-frame``: the binary lane's frame payload
+          as the body (README wire contract); response body is the binary
+          response payload (scores f32 + optional reason codes).
+        - ``application/msgpack``: ``{"rows": [[...]], "entity_fps":
+          [...], "timestamps": [...]}``; response is msgpack.
+
+        Admission-full answers 429 + Retry-After; scores are bitwise the
+        ``/predict`` scores for identical f32 rows."""
+        from fraud_detection_tpu.service import binlane
+
+        model = _model()
+        batcher = state["batcher"]
+        if model is None or batcher is None:
+            raise HTTPError(503, "model not loaded")
+        scorer = model.scorer
+        if not hasattr(scorer, "staging"):
+            raise HTTPError(409, "served model has no staging scorer")
+        # clamped to the batcher's flush ceiling: a body the row check
+        # admits must never die on score_block's max_batch bound (a 500)
+        max_rows = min(
+            config.ingest_max_rows() or config.scorer_max_batch(),
+            binlane.batcher_max_batch(batcher),
+        )
+        ctype = (
+            req.headers.get("content-type", "").split(";")[0].strip().lower()
+        )
+        t_parse = time.perf_counter()
+        if ctype == "application/x-fraud-frame":
+            lane = "binary"
+            try:
+                slot, n, entity = binlane.decode_frame_body(
+                    scorer, req.body, max_rows,
+                    dequant=_ingest_scale(model),
+                )
+            except binlane.FrameError as e:
+                metrics.ingest_frame_errors.labels(e.kind).inc()
+                raise HTTPError(422, str(e)) from e
+        elif ctype == "application/msgpack":
+            lane = "msgpack"
+            try:
+                import msgpack
+            except ImportError as e:  # pragma: no cover - baked into image
+                raise HTTPError(415, "msgpack not available") from e
+            try:
+                payload = msgpack.unpackb(req.body)
+                slot, n, entity = binlane.block_from_arrays(
+                    scorer,
+                    np.asarray(payload["rows"], np.float32),
+                    payload.get("entity_fps"),
+                    payload.get("timestamps"),
+                    max_rows,
+                )
+            except binlane.FrameError as e:
+                metrics.ingest_frame_errors.labels(e.kind).inc()
+                raise HTTPError(422, str(e)) from e
+            except HTTPError:
+                raise
+            except Exception as e:
+                # msgpack unpack errors, ragged rows, non-numeric values —
+                # all client input errors
+                raise HTTPError(422, f"bad msgpack batch: {e}") from e
+        else:
+            raise HTTPError(
+                415,
+                "use application/x-fraud-frame or application/msgpack",
+            )
+        _OBSERVE_PARSE(time.perf_counter() - t_parse)
+        metrics.ingest_requests.labels(lane).inc()
+        try:
+            from fraud_detection_tpu.service.microbatch import IngestBlock
+
+            timeline = (
+                RequestTimeline(correlation_id=req.state["correlation_id"])
+                if batcher.telemetry
+                else None
+            )
+            try:
+                ek = await batcher.score_block(
+                    IngestBlock(slot, n, entity), timeline
+                )
+            except AdmissionFull as e:
+                return _admission_shed(e, metrics.ingest_shed.labels(lane))
+            except NoHealthyShards as e:
+                return _unavailable(
+                    "no healthy scoring shards", str(e),
+                    max(int(config.mesh_shard_reopen_s()), 1),
+                )
+            metrics.ingest_rows.labels(lane).inc(n)
+            if lane == "binary":
+                return Response(
+                    binlane.encode_response_body(slot, n, ek),
+                    media_type="application/x-fraud-frame",
+                )
+            import msgpack
+
+            out = {"n": n, "scores": slot.scores[:n].tolist()}
+            if ek:
+                out["reason_idx"] = slot.ei[:n, :ek].tolist()
+                out["reason_val"] = slot.ev[:n, :ek].tolist()
+            return Response(
+                msgpack.packb(out), media_type="application/msgpack"
+            )
+        finally:
+            scorer.staging.release(slot)
 
     @app.get("/explain/{transaction_id}")
     async def explain(req: Request) -> Response:

@@ -151,7 +151,51 @@ scorer_explained_rows = Counter(
 )
 scorer_queue_depth = Gauge(
     "scorer_queue_depth",
-    "Rows waiting in the micro-batcher queue at the last collection cycle",
+    "Queue ITEMS (single requests or whole ingest frames) waiting in the "
+    "micro-batcher at the last collection cycle — row-denominated backlog "
+    "is scorer_admission_queue_rows",
+    registry=registry,
+)
+scorer_admission_queue_rows = Gauge(
+    "scorer_admission_queue_rows",
+    "Rows currently admitted but not yet collected into a flush (the "
+    "hyperloop continuous-batching queue; bounded by SCORER_ADMIT_MAX_ROWS "
+    "— at the bound new admissions shed with 429/busy instead of queueing)",
+    registry=registry,
+)
+
+# Hyperloop: per-lane ingest accounting (service/binlane + the /predict and
+# /ingest/batch edges). The lane label is bounded: json (per-row /predict),
+# msgpack (/ingest/batch packed POST), binary (the persistent-connection
+# frame lane). These names are the alerting contract for
+# monitoring/prometheus/rules/ingest-alerts.yml (IngestParseDominates,
+# IngestShedSustained) and the hyperloop dashboard row.
+ingest_requests = Counter(
+    "ingest_requests",
+    "Scoring requests accepted per ingest lane (one /predict call or one "
+    "batch frame each)",
+    ["lane"],
+    registry=registry,
+)
+ingest_rows = Counter(
+    "ingest_rows",
+    "Rows admitted to the scorer per ingest lane",
+    ["lane"],
+    registry=registry,
+)
+ingest_shed = Counter(
+    "ingest_shed",
+    "Requests shed at the admission bound (HTTP 429 + Retry-After, or a "
+    "binary busy frame) — overload backpressure doing its job; sustained "
+    "growth means capacity, not a bug (IngestShedSustained alert input)",
+    ["lane"],
+    registry=registry,
+)
+ingest_frame_errors = Counter(
+    "ingest_frame_errors",
+    "Malformed binary ingest frames rejected (bad magic/layout, size "
+    "overflow, non-finite features) or connections dropped mid-frame",
+    ["kind"],
     registry=registry,
 )
 scorer_effective_wait = Gauge(

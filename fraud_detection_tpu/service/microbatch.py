@@ -36,6 +36,23 @@ between 0 and ``SCORER_MAX_WAIT_MS`` (light traffic flushes immediately,
 heavy traffic fills buckets); the applied deadline exports as
 ``scorer_effective_wait_seconds``.
 
+**Hyperloop** (continuous batching): queue items are either single rows
+(one ``/predict`` request each — unchanged contract) or ingest BLOCKS — a
+2-D row view into a pooled staging slot, admitted by the binary ingest
+lane (``service/binlane``) or the ``/ingest/batch`` packed POST as ONE
+item with ONE future for the whole frame. The collector counts ROWS, not
+items: a block fills the forming bucket like that many requests, the
+adaptive deadline's arrival EWMA weighs it accordingly, and a block that
+would overflow ``max_batch`` closes the current batch and opens the next
+(the warmed bucket ladder is never exceeded). Completion fans out by
+per-flush sequence: each item resolves from its row offset inside the
+flush — a frame's scores (and lantern reason codes) bulk-copy into its
+ingest slot's preallocated decode buffers, never N per-row futures.
+Admission is bounded (``SCORER_ADMIT_MAX_ROWS``): at the bound
+:class:`AdmissionFull` is raised and the edges shed — HTTP 429 +
+``Retry-After``, binary busy frame — so overload backs off instead of
+growing an unbounded queue.
+
 Spyglass (telemetry/): with telemetry on (default), each flush runs the
 decomposed scoring path — host pad/encode, device dispatch fenced with ONE
 ``block_until_ready`` per flush, then the d2h fetch — and stamps any
@@ -78,6 +95,54 @@ log = logging.getLogger("fraud_detection_tpu.microbatch")
 _OBSERVE_STAGE = {
     s: metrics.request_stage_duration.labels(s).observe for s in STAGES
 }
+#: hyperloop ingest stages (per request/frame, not per row): ``parse`` is
+#: stamped at the lane edges (app.py /predict + /ingest/batch, binlane),
+#: ``admit`` here at submission — admission check + queue put.
+_OBSERVE_ADMIT = metrics.request_stage_duration.labels("admit").observe
+
+
+class AdmissionFull(RuntimeError):
+    """The bounded admission queue (SCORER_ADMIT_MAX_ROWS) is at capacity:
+    the caller must shed this request with a retry hint (HTTP 429 +
+    ``Retry-After``; binary busy frame) instead of queueing it."""
+
+    def __init__(self, retry_after_s: float, queued_rows: int):
+        self.retry_after_s = retry_after_s
+        self.queued_rows = queued_rows
+        super().__init__(
+            f"admission queue full ({queued_rows} rows queued) — retry in "
+            f"{retry_after_s:g}s"
+        )
+
+
+class IngestBlock:
+    """One admitted ingest frame: ``slot.f32[:n]`` holds the staged rows
+    (parsed straight off the wire into the pooled buffer), results decode
+    back into the same slot's preallocated ``scores``/``ei``/``ev``
+    buffers. ``entity`` is the optional ledger column triple
+    ``(table_slots int64[n], fingerprints uint32[n], rel_ts f32[n])`` —
+    fingerprint 0 marks an entity-less row (the reserved null path)."""
+
+    __slots__ = ("slot", "n", "entity")
+
+    def __init__(self, slot, n: int, entity=None):
+        self.slot = slot
+        self.n = n
+        self.entity = entity
+
+
+def _item_rows(item) -> int:
+    """Rows one queue item contributes: blocks carry a 2-D view."""
+    rows = item[0]
+    return rows.shape[0] if rows.ndim == 2 else 1
+
+
+def _batch_rows(batch) -> int:
+    n = 0
+    for item in batch:
+        rows = item[0]
+        n += rows.shape[0] if rows.ndim == 2 else 1
+    return n
 
 #: EWMA smoothing for the adaptive-deadline arrival-rate estimate: ~0.3
 #: converges within a handful of collection cycles while damping
@@ -101,6 +166,7 @@ class MicroBatcher:
         return_wire: str | None = None,
         explain: bool | None = None,
         explain_k: int | None = None,
+        admit_max_rows: int | None = None,
     ):
         # Either a fixed scorer (offline tools, tests) or a lifecycle
         # ModelSlot (serving): with a slot, every flush re-reads the slot's
@@ -178,6 +244,16 @@ class MicroBatcher:
         self.max_wait = (
             max_wait_ms if max_wait_ms is not None else config.scorer_max_wait_ms()
         ) / 1000.0
+        # hyperloop bounded admission: rows admitted but not yet collected
+        # into a flush. 0 = unbounded (pre-hyperloop behavior).
+        self.admit_max = (
+            admit_max_rows
+            if admit_max_rows is not None
+            else config.scorer_admit_max_rows()
+        )
+        self.admit_retry_after = config.scorer_admit_retry_after_s()
+        self._queued_rows = 0
+        self._carry: tuple | None = None  # block deferred to the next batch
         self._rate = 0.0  # rows/s arrival EWMA (adaptive deadline input)
         self._last_cycle: float | None = None
         self._queue: asyncio.Queue[tuple] = asyncio.Queue()
@@ -272,14 +348,58 @@ class MicroBatcher:
         if self._flushes:
             await asyncio.gather(*self._flushes, return_exceptions=True)
         # Fail anything still enqueued so no request awaits forever.
+        if self._carry is not None:
+            item, self._carry = self._carry, None
+            if not item[1].done():
+                item[1].set_exception(RuntimeError("scorer shutting down"))
         while not self._queue.empty():
-            _, fut, _, _ = self._queue.get_nowait()
+            fut = self._queue.get_nowait()[1]
             if not fut.done():
                 fut.set_exception(RuntimeError("scorer shutting down"))
+        self._queued_rows = 0
+
+    def _admit(self, n: int) -> None:
+        """Bounded-admission gate (runs on the event loop, so the counter
+        needs no lock): raises :class:`AdmissionFull` at the bound — the
+        caller sheds with a retry hint instead of queueing."""
+        if self.admit_max and self._queued_rows + n > self.admit_max:
+            raise AdmissionFull(self.admit_retry_after, self._queued_rows)
+        self._queued_rows += n
 
     async def _submit(self, row: np.ndarray, timeline=None, entity=None):
+        t0 = time.perf_counter() if timeline is not None else 0.0
+        self._admit(1)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         await self._queue.put((row, fut, timeline, entity))
+        if timeline is not None:
+            _OBSERVE_ADMIT(time.perf_counter() - t0)
+        return await fut
+
+    async def score_block(self, block: IngestBlock, timeline=None, entity=None):
+        """Admit one pre-staged ingest block (hyperloop continuous
+        batching): the frame's rows ride ONE queue item with ONE future.
+        On resolve, the block slot's preallocated buffers hold the results
+        — ``slot.scores[:n]`` the f32 probabilities and, when the lantern
+        explain leg rode the flush, ``slot.ei/ev[:n]`` the top-k reason
+        codes. Returns the explain ``k`` (0 = no reason codes). ``entity``
+        is accepted for ShardFront routing-signature compatibility and
+        ignored — a block carries its entity columns itself."""
+        n = block.n
+        if n < 1:
+            raise ValueError("empty ingest block")
+        if n > self.max_batch:
+            raise ValueError(
+                f"ingest block of {n} rows exceeds max_batch="
+                f"{self.max_batch} — split the frame (INGEST_MAX_ROWS)"
+            )
+        t0 = time.perf_counter() if timeline is not None else 0.0
+        self._admit(n)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put(
+            (block.slot.f32[:n], fut, timeline, block.entity, block.slot)
+        )
+        if timeline is not None:
+            _OBSERVE_ADMIT(time.perf_counter() - t0)
         return await fut
 
     async def score(self, row: np.ndarray, timeline=None, entity=None) -> float:
@@ -334,33 +454,51 @@ class MicroBatcher:
         batch: list[tuple] = []
         loop = asyncio.get_running_loop()
         stamp = self._stamp_collected
+        rows_of = _item_rows
         try:
             while True:
-                batch = [stamp(await self._queue.get())]
+                if self._carry is not None:
+                    # a block deferred because it would have overflowed the
+                    # previous batch opens this one
+                    item, self._carry = self._carry, None
+                else:
+                    item = await self._queue.get()
+                n_rows = rows_of(item)
+                self._queued_rows -= n_rows
+                batch = [stamp(item)]
                 metrics.scorer_queue_depth.set(self._queue.qsize())
-                # Collect more rows until the window closes or the batch
-                # fills. Greedy drain first: under load the queue already
-                # holds rows, and one timer-armed wait_for PER ROW (a Task +
-                # TimerHandle each) was measured to cap the whole pipeline
-                # at ~2.7k rows/s on CPU — get_nowait costs ~1µs.
+                metrics.scorer_admission_queue_rows.set(self._queued_rows)
+                # Collect more ROWS (items weighted by their block size)
+                # until the window closes or the batch fills. Greedy drain
+                # first: under load the queue already holds rows, and one
+                # timer-armed wait_for PER ROW (a Task + TimerHandle each)
+                # was measured to cap the whole pipeline at ~2.7k rows/s on
+                # CPU — get_nowait costs ~1µs.
                 deadline = loop.time() + self._effective_wait()
-                while len(batch) < self.max_batch:
+                while n_rows < self.max_batch:
                     try:
-                        while len(batch) < self.max_batch:
-                            batch.append(stamp(self._queue.get_nowait()))
-                        break
+                        nxt = self._queue.get_nowait()
                     except asyncio.QueueEmpty:
-                        pass
-                    timeout = deadline - loop.time()
-                    if timeout <= 0:
+                        timeout = deadline - loop.time()
+                        if timeout <= 0:
+                            break
+                        try:
+                            nxt = await asyncio.wait_for(
+                                self._queue.get(), timeout
+                            )
+                        except asyncio.TimeoutError:
+                            break
+                    k = rows_of(nxt)
+                    if n_rows + k > self.max_batch:
+                        # a block that would overflow the warmed bucket
+                        # ladder closes this batch and opens the next —
+                        # max_batch stays a hard shape bound
+                        self._carry = nxt
                         break
-                    try:
-                        batch.append(
-                            stamp(await asyncio.wait_for(self._queue.get(), timeout))
-                        )
-                    except asyncio.TimeoutError:
-                        break
-                n_collected = len(batch)
+                    self._queued_rows -= k
+                    batch.append(stamp(nxt))
+                    n_rows += k
+                n_collected = n_rows
                 # Bounded pipeline: hand the batch to a flush task and go
                 # straight back to collecting. The semaphore caps in-flight
                 # batches (memory + fairness); awaiting it applies
@@ -387,11 +525,14 @@ class MicroBatcher:
                         )
                 self._last_cycle = now
         except asyncio.CancelledError:
-            # Cancellation mid-collection: fail the partial batch so its
-            # waiters don't hang, then propagate.
-            for _, f, _, _ in batch:
-                if not f.done():
-                    f.set_exception(RuntimeError("scorer shutting down"))
+            # Cancellation mid-collection: fail the partial batch (and any
+            # carried-over block) so its waiters don't hang, then propagate.
+            if self._carry is not None:
+                batch.append(self._carry)
+                self._carry = None
+            for item in batch:
+                if not item[1].done():
+                    item[1].set_exception(RuntimeError("scorer shutting down"))
             raise
 
     async def _flush_one(self, batch: list[tuple]) -> None:
@@ -521,7 +662,7 @@ class MicroBatcher:
         # fails a flush here. Disarmed (the default) this is one global
         # load — no allocation, priced inside the ≤5% telemetry bench gate.
         fire("microbatch.flush")
-        n = len(batch)
+        n = _batch_rows(batch)
         staging = scorer.staging
         # ledger (stateful feature engine): active when the fused spec is a
         # widened family AND the drift monitor carries the entity table
@@ -534,16 +675,31 @@ class MicroBatcher:
         if ledger_on and getattr(target[0], "n_shards", 1) > 1:
             # sharded ledger flush: rows must land in the row range of the
             # device shard owning their entity's table slot (slot mod N) —
-            # a host-side permutation, never a device collective
+            # a host-side permutation, never a device collective. Row-major
+            # walk so ingest blocks expand in place (fingerprint 0 inside
+            # a block's entity columns = the null path).
             from fraud_detection_tpu.ledger.placement import shard_placement
 
-            slots_arr = np.asarray(
-                [e[0] if (e := item[3]) is not None else 0 for item in batch],
-                np.int64,
-            )
-            has_arr = np.asarray(
-                [item[3] is not None for item in batch], bool
-            )
+            slots_list: list = []
+            has_list: list = []
+            for item in batch:
+                ent = item[3]
+                if item[0].ndim == 2:
+                    k = item[0].shape[0]
+                    if ent is None:
+                        slots_list.extend([0] * k)
+                        has_list.extend([False] * k)
+                    else:
+                        slots_list.extend(ent[0].tolist())
+                        has_list.extend((ent[1] != 0).tolist())
+                elif ent is None:
+                    slots_list.append(0)
+                    has_list.append(False)
+                else:
+                    slots_list.append(ent[0])
+                    has_list.append(True)
+            slots_arr = np.asarray(slots_list, np.int64)
+            has_arr = np.asarray(has_list, bool)
             bucket, placement = shard_placement(
                 slots_arr, has_arr, target[0].n_shards, scorer.min_bucket
             )
@@ -558,13 +714,9 @@ class MicroBatcher:
             with annotate("microbatch-score"):
                 t_flush_start = time.perf_counter()
                 if placement is None:
-                    hx = scorer.stage_rows(
-                        slot, [item[0] for item in batch]
-                    )
+                    hx = scorer.stage_items(slot, batch)
                 else:
-                    hx = scorer.stage_rows_placed(
-                        slot, [item[0] for item in batch], placement
-                    )
+                    hx = scorer.stage_items_placed(slot, batch, placement)
                 ledger_rows = None
                 n_null = 0
                 if ledger_on:
@@ -699,34 +851,59 @@ class MicroBatcher:
         now = (
             spec.rel_ts(time.time()) if spec is not None else time.time()
         )
-        n = len(batch)
-        # one pass building python columns, then bulk numpy assignment:
-        # per-element ndarray setitem costs ~100ns — a 1024-row flush paid
-        # ~0.4ms to the loop, a third of the whole stateless flush
-        svals = [0] * n
-        fvals = [0] * n
-        tvals = [0.0] * n
-        hvals = [0.0] * n
-        for j, item in enumerate(batch):
+        # Row-major walk: single rows collect into python columns for ONE
+        # bulk fancy-index assignment (per-element ndarray setitem costs
+        # ~100ns — a 1024-row flush paid ~0.4ms to the loop, a third of
+        # the whole stateless flush); ingest blocks bulk-copy their entity
+        # columns directly (fingerprint 0 = null path within a block).
+        s_pos: list = []
+        svals: list = []
+        fvals: list = []
+        tvals: list = []
+        hvals: list = []
+        off = 0
+        for item in batch:
+            rows = item[0]
             ent = item[3]
+            if rows.ndim == 2:
+                k = rows.shape[0]
+                if ent is None:
+                    n_null += k
+                elif placement is None:
+                    ls_a, lf_a, lt_a = ent
+                    sl = slice(off, off + k)
+                    slot.ls[sl] = ls_a
+                    slot.lf[sl] = lf_a
+                    slot.lt[sl] = lt_a
+                    has = lf_a != 0
+                    slot.lh[sl] = has
+                    n_null += int(k) - int(has.sum())
+                else:
+                    ls_a, lf_a, lt_a = ent
+                    pos = placement[off:off + k]
+                    slot.ls[pos] = ls_a
+                    slot.lf[pos] = lf_a
+                    slot.lt[pos] = lt_a
+                    has = lf_a != 0
+                    slot.lh[pos] = has
+                    n_null += int(k) - int(has.sum())
+                off += k
+                continue
             if ent is None:
                 n_null += 1
-                continue
-            s, fp, ts = ent
-            svals[j] = s
-            fvals[j] = fp
-            tvals[j] = ts if ts and ts > 0 else now
-            hvals[j] = 1.0
-        if placement is None:
-            slot.ls[:n] = svals
-            slot.lf[:n] = fvals
-            slot.lt[:n] = tvals
-            slot.lh[:n] = hvals
-        else:
-            slot.ls[placement] = svals
-            slot.lf[placement] = fvals
-            slot.lt[placement] = tvals
-            slot.lh[placement] = hvals
+            else:
+                s, fp, ts = ent
+                s_pos.append(off if placement is None else placement[off])
+                svals.append(s)
+                fvals.append(fp)
+                tvals.append(ts if ts and ts > 0 else now)
+                hvals.append(1.0)
+            off += 1
+        if s_pos:
+            slot.ls[s_pos] = svals
+            slot.lf[s_pos] = fvals
+            slot.lt[s_pos] = tvals
+            slot.lh[s_pos] = hvals
         # fraud-range injection point: the poison_entity_state campaign
         # corrupts one entity's staged amounts/timestamps here; the traced
         # body's clamp (ledger/features) is the blast door under test
@@ -751,12 +928,13 @@ class MicroBatcher:
         fused = False
         holdover = None
         scorer = None
+        n_rows = _batch_rows(batch)
         try:
             # Everything that can fail stays inside this try — a raise
             # before the waiters are resolved (e.g. np.stack on a
             # mixed-shape batch) would otherwise leave clients awaiting
             # forever inside a detached task.
-            metrics.microbatch_size.observe(len(batch))
+            metrics.microbatch_size.observe(n_rows)
             # ONE slot read per flush: the scorer is pinned for this batch
             # even if a promotion swaps the slot mid-dispatch.
             if self.slot is not None:
@@ -784,7 +962,13 @@ class MicroBatcher:
                     # the demotion must latch here too (the quickwire
                     # silent-demotion lesson)
                     self._note_explain_fused(False, scorer)
-                rows = np.stack([item[0] for item in batch])
+                if any(item[0].ndim == 2 for item in batch):
+                    # ingest blocks routed to a non-staging scorer
+                    rows = np.concatenate(
+                        [np.atleast_2d(item[0]) for item in batch]
+                    )
+                else:
+                    rows = np.stack([item[0] for item in batch])
 
                 def _score() -> np.ndarray:
                     with annotate("microbatch-score"):
@@ -797,59 +981,78 @@ class MicroBatcher:
                 monitor_scores = probs
                 monitor_reasons = None
             if explain_out is not None:
-                metrics.scorer_explained_rows.inc(len(batch))
+                metrics.scorer_explained_rows.inc(n_rows)
             metrics.scorer_device_calls_per_flush.set(device_calls)
             metrics.scorer_flushes.labels(
                 "fused" if fused
                 else ("split" if self.watchtower is not None else "solo")
             ).inc()
         except Exception as e:  # resolve all waiters with the failure
-            for _, f, _, _ in batch:
-                if not f.done():
-                    f.set_exception(e)
+            for item in batch:
+                if not item[1].done():
+                    item[1].set_exception(e)
             return
         fi = None
         if telemetry:
-            n = len(batch)
             try:
                 drift_flag = bool(metrics.watchtower_drift_detected._value.get())
             except Exception:  # graftcheck: ignore[silent-except] — private gauge attr probe; absence just means "no drift info"
                 drift_flag = False
             fi = FlushInfo(
                 t_flush_start=t_flush, t_padded=t_padded, t_synced=t_synced,
-                t_fetched=t_fetched, batch_size=n,
-                bucket=_bucket(n, scorer.min_bucket),
+                t_fetched=t_fetched, batch_size=n_rows,
+                bucket=_bucket(n_rows, scorer.min_bucket),
                 model_version=version, model_source=source, drift=drift_flag,
             )
+        # Completion fan-out by per-flush row offset (hyperloop): each item
+        # resolves from its slice of the flush's results — single rows as
+        # today (float, or the (score, idx, vals) triple with explain on),
+        # ingest blocks by ONE bulk copy into their pooled slot's decode
+        # buffers (the frame handler reads scores/reasons out of them and
+        # then releases the slot) — never one future per frame row.
+        # Everything is materialized here, before the holdover releases
+        # below: waiters read their results on a later loop turn, after
+        # the flush slot's buffers may have recycled.
+        eidx = evals = None
+        explain_k = 0
         if explain_out is not None:
-            # materialize each row's reason codes at resolve time (the
-            # slot's explain buffers recycle once the holdover releases
-            # below, and waiters read their results on a later loop turn)
             eidx, evals = explain_out
-            results = [
-                (float(p), eidx[j].tolist(), evals[j].tolist())
-                for j, p in enumerate(probs)
-            ]
-        else:
-            results = None
-        if fi is not None and tracing._tracer is not None:
-            # Link rows to the flush ONLY when a tracer will read the
-            # timelines back (emit_stage_spans): one ref per row is ~60ns
-            # and the telemetry budget lives and dies on this loop — the
-            # flight recorder gets the FlushInfo through its entry instead.
-            for j, ((_, f, tl, _), p) in enumerate(zip(batch, probs)):
+            explain_k = int(eidx.shape[1])
+        link_timelines = fi is not None and tracing._tracer is not None
+        off = 0
+        for item in batch:
+            f = item[1]
+            rows = item[0]
+            if rows.ndim == 2:
+                k = rows.shape[0]
+                out = item[4]  # the block's pooled ingest slot
+                np.copyto(out.scores[:k], probs[off:off + k], casting="unsafe")
+                if explain_k:
+                    out.ensure_explain(explain_k)
+                    np.copyto(out.ei[:k], eidx[off:off + k], casting="unsafe")
+                    np.copyto(out.ev[:k], evals[off:off + k], casting="unsafe")
                 if not f.done():
-                    f.set_result(
-                        results[j] if results is not None else float(p)
+                    f.set_result(explain_k)
+                off += k
+            else:
+                if explain_k:
+                    res = (
+                        float(probs[off]),
+                        eidx[off].tolist(),
+                        evals[off].tolist(),
                     )
-                if tl is not None:
-                    tl.flush = fi
-        else:
-            for j, ((_, f, _, _), p) in enumerate(zip(batch, probs)):
+                else:
+                    res = float(probs[off])
                 if not f.done():
-                    f.set_result(
-                        results[j] if results is not None else float(p)
-                    )
+                    f.set_result(res)
+                off += 1
+            if link_timelines and item[2] is not None:
+                # Link rows to the flush ONLY when a tracer will read the
+                # timelines back (emit_stage_spans): one ref per row is
+                # ~60ns and the telemetry budget lives and dies on this
+                # loop — the flight recorder gets the FlushInfo through
+                # its entry instead.
+                item[2].flush = fi
         if holdover is not None:
             # narrow return wire: the waiters read their floats out of the
             # slot's decode buffer above — now it can recycle

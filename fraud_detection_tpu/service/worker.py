@@ -284,9 +284,12 @@ class XaiWorker:
         semantics stay per-task."""
         outcome: dict[str, Exception | None] = {}
         prepared: list[tuple[Task, np.ndarray]] = []
+        prepared_rows: list[np.ndarray] = []
         for t in tasks:
             try:
-                prepared.append((t, self.model.prepare_row(t.args[1])))
+                row = self.model.prepare_row(t.args[1])
+                prepared.append((t, row))
+                prepared_rows.append(row)
             except Exception as e:  # graftcheck: ignore[silent-except] — captured into outcome, settled+logged by _settle
                 # bad input fails only ITS task
                 outcome[t.id] = e
@@ -306,7 +309,7 @@ class XaiWorker:
         scorer = self.model.scorer
         slot = scorer.staging.acquire(_bucket(k, scorer.min_bucket))
         try:
-            np.stack([r for _, r in prepared], out=slot.f32[:k])
+            np.stack(prepared_rows, out=slot.f32[:k])
             slot.f32[k:] = 0.0
             scores = scorer.predict_proba(slot.f32)[:k]
             phis, expected_value = self.model.explain_batch(slot.f32)

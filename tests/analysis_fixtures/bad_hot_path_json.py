@@ -1,0 +1,27 @@
+"""Known-bad fixture for the hot-path-json rule: JSON (de)serialization
+and per-row comprehensions inside ``# graftcheck: hot-path`` regions —
+exactly the per-request interpreter work the hyperloop binary lane
+removed."""
+
+import json
+
+import numpy as np
+
+
+def parse_frame(body, batch):
+    # graftcheck: hot-path — per-frame ingest path
+    payload = json.loads(body)  # finding: JSON parse per frame
+    rows = [item[0] for item in batch]  # finding: per-row list comp
+    by_id = {t["id"]: t for t in payload}  # finding: per-row dict comp
+    return np.asarray(rows), by_id
+
+
+def respond(scores):
+    # graftcheck: hot-path
+    return json.dumps({"scores": list(scores)})  # finding: JSON encode
+
+
+def cold_path(body):
+    # no marker: JSON at the cold control-plane edge is fine
+    payload = json.loads(body)
+    return [row for row in payload]

@@ -1,0 +1,31 @@
+"""Known-good fixture for the hot-path-json rule: the sanctioned
+hyperloop idioms — fixed-layout frombuffer decode into pooled staging,
+vectorized column math, explicit loops that bulk-assign, and JSON kept
+strictly outside marked regions."""
+
+import json
+
+import numpy as np
+
+
+def parse_frame(slot, payload, n, d):
+    # graftcheck: hot-path — per-frame ingest path
+    rows = np.frombuffer(payload, "<f4", n * d).reshape(n, d)
+    np.copyto(slot.f32[:n], rows, casting="unsafe")
+    # an explicit loop that bulk-copies blocks is fine (no per-row
+    # Python object is built)
+    off = 0
+    for block in (slot.f32[:n],):
+        off += block.shape[0]
+    return off
+
+
+def respond(slot, n):
+    # graftcheck: hot-path
+    return memoryview(slot.scores[:n])
+
+
+def control_plane(body):
+    # unmarked: JSON belongs at the cold edges
+    payload = json.loads(body)
+    return json.dumps({"ok": True, "n": len(payload)})

@@ -80,6 +80,21 @@ def test_decode_alloc_true_positives():
     assert not any("decode_cold" in m for m in msgs), msgs
 
 
+def test_hot_path_json_true_positives():
+    """Hyperloop guard: json.loads/dumps and per-row comprehensions must
+    not creep back into marked hot regions — the binary ingest lane
+    exists to delete exactly that per-request interpreter work."""
+    counts, findings = rule_counts("bad_hot_path_json.py")
+    assert counts["hot-path-json"] == 4, findings
+    msgs = [f.message for f in findings if f.rule_id == "hot-path-json"]
+    assert any("json.loads" in m for m in msgs), msgs
+    assert any("json.dumps" in m for m in msgs), msgs
+    assert any("list comprehension" in m for m in msgs), msgs
+    assert any("dict comprehension" in m for m in msgs), msgs
+    # unmarked functions are never flagged
+    assert not any("cold_path" in m for m in msgs), msgs
+
+
 def test_service_rules_true_positives():
     counts, findings = rule_counts("bad_service.py")
     assert counts["socket-no-timeout"] == 3, findings
@@ -110,6 +125,7 @@ def test_retry_no_backoff_true_positives():
         "good_service.py",
         "good_prometheus.py",
         "good_hot_path_alloc.py",
+        "good_hot_path_json.py",
         "good_decode_alloc.py",
         "good_retry_backoff.py",
     ],

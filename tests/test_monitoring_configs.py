@@ -298,6 +298,69 @@ def test_grafana_ledger_panels_present():
         assert "watchtower_shadow_reason_divergence" in text, rel
 
 
+def test_ingest_rules_file_ships():
+    """The hyperloop contract (ISSUE 11): ingest-alerts.yml ships
+    IngestParseDominates (+ the shed/frame-error capacity pages) and is
+    promlint-clean."""
+    path = os.path.join(RULES_DIR, "ingest-alerts.yml")
+    assert os.path.exists(path)
+    assert promlint.lint_rules_file(path) == []
+    with open(path) as f:
+        text = f.read()
+    assert "IngestParseDominates" in text
+    assert "IngestShedSustained" in text
+    assert 'stage="parse"' in text
+
+
+def test_ingest_alert_metrics_exist_in_registry():
+    """Every ingest_* / stage metric the hyperloop rules reference must be
+    exported by service/metrics.py — same drift-proofing contract as the
+    other rule files."""
+    exported = _exported_metric_names()
+    with open(os.path.join(RULES_DIR, "ingest-alerts.yml")) as f:
+        text = f.read()
+    referenced = set(
+        re.findall(r"\b(ingest_[a-z_]+|request_stage_[a-z_]+)\b", text)
+    )
+    referenced -= {"ingest_alerts"}  # the file's own name
+    assert referenced, "ingest rules reference no ingest metrics?"
+    missing = {
+        name for name in referenced
+        if name not in exported
+        and name.removesuffix("_total") not in exported
+        and re.sub(r"_(bucket|sum|count)$", "", name) not in exported
+        and f"{name}_total" not in exported
+    }
+    assert not missing, f"alert rules reference unexported metrics: {missing}"
+
+
+def test_ingest_stage_labels_exported():
+    """The parse/admit stage label values must actually be exported (they
+    are bound at import in app.py/microbatch.py/binlane.py, so the
+    histogram always carries the children)."""
+    from fraud_detection_tpu.service import app, binlane, microbatch  # noqa: F401
+    from fraud_detection_tpu.service import metrics as m
+
+    text = m.render().decode()
+    assert 'request_stage_duration_seconds_count{stage="parse"}' in text
+    assert 'request_stage_duration_seconds_count{stage="admit"}' in text
+
+
+def test_grafana_hyperloop_row_present():
+    """Both dashboards carry the hyperloop ingest row (per-lane rows/s,
+    parse-vs-compute, admission queue + sheds)."""
+    for rel in (
+        "grafana_dashboard.json",
+        os.path.join("grafana_provisioning", "dashboards", "fraud-tpu.json"),
+    ):
+        with open(os.path.join(MONITORING, rel)) as f:
+            text = f.read()
+        assert "ingest_rows_total" in text, rel
+        assert "ingest_shed_total" in text, rel
+        assert "scorer_admission_queue_rows" in text, rel
+        assert 'stage=\\"parse\\"' in text, rel
+
+
 def test_grafana_switchyard_row_present():
     """Both dashboards carry the switchyard panels (shard health, per-shard
     rates, in-flight)."""

@@ -442,6 +442,17 @@ def test_scenario_replica_burst():
 
 
 @pytest.mark.slow
+def test_scenario_ingest_storm():
+    """Hyperloop (ISSUE 11): the binary lane under an open-loop Pareto
+    storm with a mid-burst shard drain — bounded sheds with Retry-After,
+    every admitted row answered, drift window bitwise vs a closed-loop
+    replay of the same rows."""
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario("ingest_storm").raise_if_failed()
+
+
+@pytest.mark.slow
 def test_scenario_poison_entity_state():
     """Ledger satellite (ISSUE 10): one entity hammered with NaN/extreme
     amounts through the ``ledger.update`` injection point — the poison
