@@ -473,6 +473,108 @@ def _build_lantern_flush(mesh: Mesh):
     )
 
 
+def _abstract_tree_explainer(mesh: Mesh, n_trees: int = 4, depth: int = 3,
+                             n_bins: int = 16):
+    from fraud_detection_tpu.ops.tree_shap import TreeShapExplainer
+
+    n_leaves = 2**depth
+    return TreeShapExplainer(
+        model=_abstract_gbt_model(mesh, n_trees, depth, n_bins),
+        bg_table=sds((n_trees, n_leaves, n_leaves), jnp.float32, mesh, P()),
+        expected_value=sds((), jnp.float32, mesh, P()),
+    )
+
+
+@register_entrypoint("evergreen.flush")
+def _build_evergreen_flush(mesh: Mesh):
+    """The GBT family's fully-fused serving flush (evergreen): int8 wire
+    codes dequantized in-program (explicit-dequant branch — the forest
+    scores raw-space values), exact TreeSHAP top-k reason codes traced
+    inline (``drift._topk_attributions`` family dispatch over the
+    TreeShapExplainer pytree), uint8 return wire, drift fold donated
+    through — the harshest wire/explain combo the GBT family serves,
+    proven at every mesh size like the linear ``lantern.flush``."""
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import (
+        N_CALIB_BINS,
+        DriftWindow,
+        _fused_flush_quant_explain,
+    )
+    from fraud_detection_tpu.ops.scorer import _raw_score_gbt
+
+    window = DriftWindow(
+        feature_counts=sds((_FEATURES, N_FEATURE_BINS), jnp.float32, mesh, P()),
+        score_counts=sds((N_SCORE_BINS,), jnp.float32, mesh, P()),
+        calib_count=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_conf=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_label=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        n_rows=sds((), jnp.float32, mesh, P()),
+    )
+    x = sds((_ROWS, _FEATURES), jnp.int8, mesh, P(DATA_AXIS))
+    valid = sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((_FEATURES, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = _abstract_gbt_model(mesh)
+    dq = sds((_FEATURES,), jnp.float32, mesh, P())
+    explain_args = _abstract_tree_explainer(mesh)
+    fn = lambda w, xx, vv, dd, fe, se, sa, qs, ea: (  # noqa: E731
+        _fused_flush_quant_explain(
+            w, xx, vv, dd, fe, se, sa, qs, ea,
+            score_fn=_raw_score_gbt, score_codes=False, explain_k=3,
+            out_dtype=jnp.uint8,
+        )
+    )
+    return fn, (
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        dq, explain_args,
+    )
+
+
+@register_entrypoint("mesh.evergreen_flush")
+def _build_mesh_evergreen_flush(mesh: Mesh):
+    """The evergreen mesh flush: the GBT dequant·score·TreeSHAP·drift
+    program as ONE shard_map dispatch — int8 codes and reason codes
+    row-sharded, the forest + explainer pytrees replicated, per-shard
+    windows donated through, no collectives."""
+    from fraud_detection_tpu.mesh.shardflush import _sharded_flush_quant_explain
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import N_CALIB_BINS, DriftWindow
+    from fraud_detection_tpu.ops.scorer import _raw_score_gbt
+
+    n_shards = mesh.shape[DATA_AXIS]
+    shard = P(DATA_AXIS)
+    window = DriftWindow(
+        feature_counts=sds(
+            (n_shards, _FEATURES, N_FEATURE_BINS), jnp.float32, mesh, shard
+        ),
+        score_counts=sds((n_shards, N_SCORE_BINS), jnp.float32, mesh, shard),
+        calib_count=sds((n_shards, N_CALIB_BINS), jnp.float32, mesh, shard),
+        calib_conf=sds((n_shards, N_CALIB_BINS), jnp.float32, mesh, shard),
+        calib_label=sds((n_shards, N_CALIB_BINS), jnp.float32, mesh, shard),
+        n_rows=sds((n_shards,), jnp.float32, mesh, shard),
+    )
+    x = sds((_ROWS, _FEATURES), jnp.int8, mesh, shard)
+    valid = sds((_ROWS,), jnp.float32, mesh, shard)
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((_FEATURES, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = _abstract_gbt_model(mesh)
+    dq = sds((_FEATURES,), jnp.float32, mesh, P())
+    explain_args = _abstract_tree_explainer(mesh)
+    fn = lambda w, xx, vv, dd, fe, se, sa, qs, ea: (  # noqa: E731
+        _sharded_flush_quant_explain(
+            w, xx, vv, dd, fe, se, sa, qs, ea,
+            score_fn=_raw_score_gbt, mesh=mesh, score_codes=False,
+            explain_k=3, out_dtype=jnp.uint8,
+        )
+    )
+    return fn, (
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        dq, explain_args,
+    )
+
+
 @register_entrypoint("mesh.sharded_flush")
 def _build_mesh_sharded_flush(mesh: Mesh):
     """The switchyard serving flush: the fused score+drift program as ONE

@@ -81,6 +81,67 @@ def warm_scorer(scorer, max_batch: int | None = None) -> None:
             b *= 2
 
 
+def warm_fused_ladder(
+    watchtower,
+    scorer,
+    max_batch: int | None = None,
+    explain_k: int | None = None,
+    return_wire: str | None = None,
+) -> None:
+    """Pre-compile the FUSED flush executables for a freshly loaded model
+    before it swaps in. Same-family promotions hit the jit cache (the
+    params change, the program doesn't), but a CROSS-family promotion —
+    linear champion → GBT challenger or back (evergreen) — binds a
+    different static score body and a different explain-args pytree, so
+    without this warm the first post-swap flush would pay a cold XLA
+    compile under live traffic. Warms the exact executables serving will
+    dispatch: the configured return wire, and the fused explain leg when
+    SCORER_EXPLAIN=topk. No-op when no fused target exists (no watchtower
+    / no drift monitor / no fused spec). Runs under expected_compiles —
+    a promotion's ladder is not a RecompileStorm."""
+    from fraud_detection_tpu.ops import scorer as scorer_mod
+    from fraud_detection_tpu.ops.scorer import _bucket
+    from fraud_detection_tpu.telemetry.compile_sentinel import (
+        expected_compiles,
+    )
+
+    drift = getattr(watchtower, "drift", None)
+    if drift is None or not hasattr(drift, "warm_fused"):
+        return
+    spec = getattr(scorer, "fused_spec", lambda: None)()
+    if spec is None:
+        return
+    # the serving configuration (what the micro-batcher will dispatch) by
+    # default; explicit overrides for callers that configured the batcher
+    # directly rather than through env
+    out_dtype = scorer_mod.RETURN_WIRES[
+        return_wire if return_wire is not None else config.scorer_return_wire()
+    ][1]
+    if explain_k is None:
+        explain_k = (
+            config.scorer_explain_k()
+            if config.scorer_explain() == "topk"
+            else 0
+        )
+    if spec.explain_args is None:
+        explain_k = 0
+    explain_k = min(explain_k, scorer.n_features)
+    max_batch = max_batch or config.scorer_max_batch()
+    top = _bucket(max_batch, scorer.min_bucket)
+    if (
+        getattr(spec, "ledger", None) is not None
+        and getattr(drift, "n_shards", 1) > 1
+    ):
+        # sharded ledger placement can bump a skewed batch's bucket by up
+        # to the shard factor (the micro-batcher start() discipline)
+        top *= drift.n_shards
+    b = scorer.min_bucket
+    with expected_compiles():
+        while b <= top:
+            drift.warm_fused(scorer, b, out_dtype=out_dtype, explain_k=explain_k)
+            b *= 2
+
+
 class ModelReloader:
     """Alias watcher + swap driver for one serving process."""
 
@@ -158,6 +219,11 @@ class ModelReloader:
                 "refusing to hot-swap (deploy instead)"
             )
         warm_scorer(model.scorer, self.max_batch)  # compile BEFORE the swap
+        if self.watchtower is not None:
+            # cross-family promotions (evergreen: linear ↔ GBT) bind a new
+            # fused program — warm its flush/explain executables BEFORE
+            # the swap so the first post-swap flush is a cache hit
+            warm_fused_ladder(self.watchtower, model.scorer, self.max_batch)
         source = f"registry:models:/{name}@{stage}"
         self.slot.swap(model, source, version)
         if self.watchtower is not None:

@@ -9,9 +9,17 @@ so the serving app, worker, and offline tools treat both families alike.
 The scaler is folded into the bin edges at construction
 (:func:`~fraud_detection_tpu.ops.gbt.fold_scaler_into_gbt`), so like the
 linear model this one scores *raw* inputs with zero preprocessing launches.
+Because the fold consumes the scaler, the int8 wire calibration
+(``quant_calibration.npz``) is derived HERE — while the scaler still exists
+— and stamped beside the forest by :meth:`save`, exactly like the linear
+family: a later ``SCORER_WIRE=int8`` deploy (or a hot swap into one) must
+quantize against the training profile this forest was fitted on (evergreen:
+full fused wire/explain parity for the GBT family).
 """
 
 from __future__ import annotations
+
+import logging
 
 import numpy as np
 
@@ -21,23 +29,69 @@ from fraud_detection_tpu.ckpt.checkpoint import (
 )
 from fraud_detection_tpu.models.base import FraudModelBase
 from fraud_detection_tpu.ops.gbt import GBTModel, fold_scaler_into_gbt
+from fraud_detection_tpu.ops.quant import (
+    QuantCalibration,
+    derive_calibration,
+    load_calibration,
+    save_calibration,
+)
 from fraud_detection_tpu.ops.scorer import GBTBatchScorer
+
+log = logging.getLogger("fraud_detection_tpu.models")
 
 
 class FraudGBTModel(FraudModelBase):
+    #: serve-time vs backfill attribution tolerance for the worker's
+    #: consistency check: TreeSHAP attributions live in margin space and a
+    #: quantized wire can flip a bin boundary — φ then moves by a leaf-value
+    #: delta, not an elementwise rounding error — so the GBT bar is wider
+    #: than the linear family's 5e-2 (on the f32 wire the two paths share
+    #: one traced body and agree bitwise; this bar only absorbs the int8
+    #: lattice).
+    explain_consistency_atol = 0.25
+
     def __init__(
         self,
         model: GBTModel,
         feature_names: list[str],
         scaler=None,
         background: np.ndarray | None = None,
+        calibration: QuantCalibration | None = None,
+        io_dtype: str | None = None,
     ):
         if scaler is not None:
+            # derive the int8 calibration BEFORE the fold consumes the
+            # scaler (serve-time loads get it from the stamped sidecar)
+            if calibration is None:
+                calibration = derive_calibration(scaler)
             model = fold_scaler_into_gbt(model, scaler)
         self.model = model
         self.feature_names = list(feature_names)
         self.background = background  # raw-space sample for TreeSHAP
-        self._scorer = GBTBatchScorer(model)
+        self.calibration = calibration
+        # quickwire/evergreen: the serving wire comes from SCORER_WIRE
+        # unless pinned. int8 needs the stamped calibration — without one,
+        # fall back to f32 loudly rather than refuse to serve (the linear
+        # family's contract).
+        if io_dtype is None:
+            from fraud_detection_tpu import config
+
+            io_dtype = config.scorer_wire()
+        if io_dtype == "int8" and calibration is None:
+            log.warning(
+                "SCORER_WIRE=int8 but the GBT model carries no stamped "
+                "quant_calibration.npz (and its scaler is folded into the "
+                "bin edges) — serving on the float32 wire instead"
+            )
+            io_dtype = "float32"
+        self._scorer = GBTBatchScorer(
+            model,
+            io_dtype=io_dtype,
+            calibration=calibration if io_dtype == "int8" else None,
+            # lazy: the fused explain leg resolves the cached TreeSHAP
+            # explainer on first fused_spec() (warmup), never at load
+            explainer=self.raw_explainer,
+        )
         self._raw_explainer = None
 
     # -- explainability ----------------------------------------------------
@@ -46,7 +100,10 @@ class FraudGBTModel(FraudModelBase):
         taking raw inputs — same role as the linear model's closed-form SHAP
         explainer. Background: the stored training sample, or a single
         all-zeros row when absent (the legacy reference worker's zero
-        background, api/worker.py:52-53). Built once and cached."""
+        background, api/worker.py:52-53). Built once and cached; the SAME
+        explainer pytree rides ``FusedSpec.explain_args`` into the fused
+        serve-time reason codes, so the worker backfill and the fused leg
+        share one background table by construction."""
         if self._raw_explainer is None:
             from fraud_detection_tpu.ops.tree_shap import build_tree_explainer
 
@@ -65,11 +122,22 @@ class FraudGBTModel(FraudModelBase):
 
     # -- persistence -------------------------------------------------------
     def save(self, directory: str) -> str:
-        return save_gbt_artifacts(
+        out = save_gbt_artifacts(
             directory, self.model, self.feature_names, self.background
         )
+        if self.calibration is not None:
+            # evergreen: the int8 wire calibration ships beside the forest
+            # regardless of the CURRENT serving wire (train.py contract —
+            # the linear family stamps the same sidecar)
+            save_calibration(directory, self.calibration)
+        return out
 
     @classmethod
     def load(cls, directory: str) -> "FraudGBTModel":
         model, feature_names, background = load_gbt_artifacts(directory)
-        return cls(model, feature_names, background=background)
+        return cls(
+            model,
+            feature_names,
+            background=background,
+            calibration=load_calibration(directory),
+        )

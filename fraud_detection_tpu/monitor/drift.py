@@ -121,16 +121,34 @@ def _narrow_reasons(
 def _topk_attributions(
     xf: jax.Array, explain_args, explain_k: int
 ) -> tuple[jax.Array, jax.Array]:
-    """The lantern explain leg: exact interventional linear-SHAP
+    """The lantern/evergreen explain leg: exact interventional SHAP
     attributions over the values the model actually scored (``xf`` is the
     dequantized/upcast f32 batch the drift histograms bin), reduced to the
-    per-row arg-top-k. Shares the ``ops/linear_shap`` body, so fused
-    attributions are bitwise the standalone explainer's on the f32 wire."""
+    per-row arg-top-k.
+
+    Family dispatch rides the ``explain_args`` pytree STRUCTURE (part of
+    the jit cache key, so each family compiles its own executable under
+    the same fused program): a ``TreeShapExplainer`` traces the exact
+    interventional TreeSHAP body (``ops/tree_shap._raw_tree_shap`` — the
+    GPUTreeShap-style all-rows formulation, arXiv 2010.13972), anything
+    else is the linear family's ``(coef, background_mean)`` pair. Both
+    share their standalone explainer's body, so fused attributions are
+    bitwise the standalone explainer's on the f32 wire for BOTH
+    families."""
     from fraud_detection_tpu.ops.linear_shap import (
         _raw_linear_shap,
         topk_reasons,
     )
+    from fraud_detection_tpu.ops.tree_shap import (
+        TreeShapExplainer,
+        _raw_tree_shap,
+    )
 
+    if isinstance(explain_args, TreeShapExplainer):
+        return topk_reasons(
+            _raw_tree_shap(explain_args.model, explain_args.bg_table, xf),
+            explain_k,
+        )
     coef, background_mean = explain_args
     return topk_reasons(_raw_linear_shap(coef, background_mean, xf), explain_k)
 

@@ -187,6 +187,19 @@ def _raw_score_gbt(model, x: jax.Array) -> jax.Array:
     return gbt_predict_proba(model, x)
 
 
+@partial(jax.jit, static_argnames=("out_dtype",))
+def _gbt_score_dequant(model, x: jax.Array, scale: jax.Array, out_dtype=jnp.float32):
+    """The GBT family's SPLIT int8 path: explicit dequant + forest scoring
+    in one jitted program — the parity reference the fused evergreen quant
+    flush is gated against. (The fused path shares the identical dequant
+    multiply with the drift histogram bin; here it exists only for the
+    demoted/split flush and offline predict_proba over wire codes.)"""
+    from fraud_detection_tpu.ops.gbt import gbt_predict_proba
+
+    p = gbt_predict_proba(model, x.astype(jnp.float32) * scale)
+    return _cast_scores(p, out_dtype)
+
+
 # --------------------------------------------------------------------------
 # Zero-allocation staging: reusable per-bucket host buffers
 # --------------------------------------------------------------------------
@@ -317,14 +330,38 @@ class _BucketedScorer:
     min_bucket: int
     n_features: int
     _io_np_dtype = np.float32  # overridden for bf16/int8 host↔device IO
+    #: per-feature int8 wire state (set by subclasses on an int8 wire; the
+    #: base encode/quantize paths key on it so both model families share
+    #: ONE host-side quantizer)
+    _quant_scale: np.ndarray | None = None
+    #: served model family — the ``scorer_served_family`` gauge label
+    family: str = "linear"
 
     def _score_padded(self, x: jax.Array, out_dtype=jnp.float32) -> jax.Array:
         raise NotImplementedError
 
+    def _bind_calibration(self, calibration: "QuantCalibration") -> None:
+        """Adopt a quant calibration as this scorer's int8 wire: the host
+        encoder multiplies by 1/scale, the fused/split dequant paths by
+        scale. Shared by both families (the linear family additionally
+        folds the scale into its weights — see BatchScorer)."""
+        self.calibration = calibration
+        self._quant_scale = np.asarray(calibration.scale, np.float32)
+        self._inv_quant_scale = (1.0 / self._quant_scale).astype(np.float32)
+        self._dequant_scale = jnp.asarray(self._quant_scale)
+        self._io_np_dtype = np.int8
+
     def _prepare_host(self, x: np.ndarray) -> np.ndarray:
         """Host-side wire encoding (cast/quantize) — the transfer ships
         ``_io_np_dtype`` bytes."""
-        return x.astype(self._io_np_dtype, copy=False)
+        if self._quant_scale is None:
+            return x.astype(self._io_np_dtype, copy=False)
+        # single temporary + in-place rint/clip: this runs per chunk on the
+        # streaming hot path, so allocation churn matters
+        buf = x * self._inv_quant_scale
+        np.rint(buf, out=buf)
+        np.clip(buf, -127.0, 127.0, out=buf)
+        return buf.astype(np.int8)
 
     # -- fastlane: fusion + zero-allocation staging -------------------------
 
@@ -355,7 +392,16 @@ class _BucketedScorer:
     def _encode_slot(self, slot: _StagingSlot) -> np.ndarray:
         """Wire-encode the staged f32 rows into the slot's io buffer —
         allocation-free counterpart of :meth:`_prepare_host`. Identity for
-        f32 wire (io aliases f32)."""
+        f32 wire (io aliases f32); int8 wires quantize through the slot's
+        preallocated scratch (both families share this path)."""
+        if self._quant_scale is not None:
+            # graftcheck: hot-path — quantize via the slot's preallocated
+            # f32 scratch (the raw rows must survive for monitoring)
+            np.multiply(slot.f32, self._inv_quant_scale, out=slot.scratch)
+            np.rint(slot.scratch, out=slot.scratch)
+            np.clip(slot.scratch, -127.0, 127.0, out=slot.scratch)
+            np.copyto(slot.io, slot.scratch, casting="unsafe")
+            return slot.io
         if slot.io is not slot.f32:
             np.copyto(slot.io, slot.f32, casting="unsafe")
         return slot.io
@@ -595,7 +641,6 @@ class BatchScorer(_BucketedScorer):
             raise ValueError(
                 f"io_dtype must be float32|bfloat16|int8, got {io_dtype}"
             )
-        self._quant_scale: np.ndarray | None = None
         self.calibration: QuantCalibration | None = None
         if io_dtype == "int8":
             if calibration is None:
@@ -605,20 +650,21 @@ class BatchScorer(_BucketedScorer):
                         "stats for calibration"
                     )
                 calibration = derive_calibration(scaler, int8_sigma_range)
-            self.calibration = calibration
-            self._quant_scale = np.asarray(calibration.scale, np.float32)
             if ledger_spec is not None:
                 # the wire carries BASE columns only — a widened scaler's
                 # calibration slices to the base schema, and the scale is
                 # NOT folded into the weights (the ledger program scores
                 # the explicit-dequant widened block with raw-space coef —
                 # the dequant multiply is shared with the histogram bin)
-                self._quant_scale = self._quant_scale[: self.n_base_features]
-            self._inv_quant_scale = (1.0 / self._quant_scale).astype(np.float32)
-            self._dequant_scale = jnp.asarray(self._quant_scale)
+                calibration = QuantCalibration(
+                    scale=np.asarray(
+                        calibration.scale[: self.n_base_features], np.float32
+                    ),
+                    sigma_range=calibration.sigma_range,
+                )
+            self._bind_calibration(calibration)
             if ledger_spec is None:
                 self.coef = self.coef * self._dequant_scale
-            self._io_np_dtype = np.int8
         elif io_dtype == "bfloat16":
             self._io_np_dtype = _np_bfloat16()
         else:
@@ -652,25 +698,7 @@ class BatchScorer(_BucketedScorer):
             # bypasses the wire encode: the velocity columns never ship on
             # a narrow wire, they are raw f32 by construction
             return x.astype(np.float32, copy=False)
-        if self._quant_scale is None:
-            return x.astype(self._io_np_dtype, copy=False)
-        # single temporary + in-place rint/clip: this runs per chunk on the
-        # streaming hot path, so allocation churn matters
-        buf = x * self._inv_quant_scale
-        np.rint(buf, out=buf)
-        np.clip(buf, -127.0, 127.0, out=buf)
-        return buf.astype(np.int8)
-
-    def _encode_slot(self, slot: _StagingSlot) -> np.ndarray:
-        if self._quant_scale is None:
-            return super()._encode_slot(slot)
-        # graftcheck: hot-path — quantize via the slot's preallocated f32
-        # scratch (the raw rows in slot.f32 must survive for monitoring)
-        np.multiply(slot.f32, self._inv_quant_scale, out=slot.scratch)
-        np.rint(slot.scratch, out=slot.scratch)
-        np.clip(slot.scratch, -127.0, 127.0, out=slot.scratch)
-        np.copyto(slot.io, slot.scratch, casting="unsafe")
-        return slot.io
+        return super()._prepare_host(x)
 
     def fused_spec(self) -> FusedSpec:
         if self.ledger_spec is not None:
@@ -760,19 +788,98 @@ class GBTBatchScorer(_BucketedScorer):
     same protocol as :class:`BatchScorer` so the micro-batcher and serving
     path are model-family agnostic. Expects a model whose bin edges are
     already in raw input space (``fold_scaler_into_gbt``), mirroring the
-    linear scaler fold."""
+    linear scaler fold.
 
-    def __init__(self, model, min_bucket: int = 8):
+    Evergreen (full fused parity with the linear family):
+
+    - **wire formats**: ``bfloat16`` halves the h2d bytes (the forest bins
+      the bf16-rounded values — the values it actually scored); ``int8``
+      quarters them again via a stamped :class:`QuantCalibration` (GBT has
+      no serving-time scaler — the fold moved it into the bin edges — so
+      the calibration MUST ride the artifact, stamped at train/retrain
+      time). The forest always scores raw-space values, so the int8 wire
+      rides the fused program's explicit-dequant branch
+      (``score_codes=False``): the dequant multiply is shared with the
+      drift-histogram bin, zero extra device compute.
+    - **fused explain leg**: ``explainer`` is (a thunk returning) the
+      family's :class:`~fraud_detection_tpu.ops.tree_shap
+      .TreeShapExplainer`; its pytree rides ``FusedSpec.explain_args`` and
+      the fused flush traces the exact TreeSHAP body inline
+      (``drift._topk_attributions`` family dispatch) — serve-time GBT
+      reason codes in the same single dispatch, bitwise the standalone
+      ``tree_shap`` on the f32 wire.
+    """
+
+    family = "gbt"
+
+    def __init__(
+        self,
+        model,
+        min_bucket: int = 8,
+        io_dtype: str = "float32",
+        calibration: QuantCalibration | None = None,
+        explainer=None,
+    ):
         from fraud_detection_tpu.ops.gbt import gbt_predict_proba
 
         self._model = model
         self._predict = gbt_predict_proba
         self.n_features = int(model.bin_edges.shape[0])
         self.min_bucket = min_bucket
+        if io_dtype not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"io_dtype must be float32|bfloat16|int8, got {io_dtype}"
+            )
+        self.io_dtype = io_dtype
+        self.calibration: QuantCalibration | None = None
+        if io_dtype == "int8":
+            if calibration is None:
+                raise ValueError(
+                    "int8 IO for the GBT family needs a stamped "
+                    "QuantCalibration (quant_calibration.npz beside the "
+                    "model — the scaler is folded into the bin edges, so "
+                    "there is nothing to re-derive one from at serve time)"
+                )
+            self._bind_calibration(calibration)
+        elif io_dtype == "bfloat16":
+            self._io_np_dtype = _np_bfloat16()
+        # lantern/evergreen: the fused explain leg's TreeShapExplainer —
+        # passed lazily (a callable) so constructing the scorer never pays
+        # the background-table build; the first fused_spec() resolves and
+        # pins it (the model wrapper caches its explainer anyway)
+        self._explainer = explainer
+
+    def _resolve_explainer(self):
+        if callable(self._explainer):
+            self._explainer = self._explainer()
+        return self._explainer
 
     def _score_padded(self, x: jax.Array, out_dtype=jnp.float32) -> jax.Array:
+        if self._quant_scale is not None and x.dtype == jnp.int8:
+            # the split int8 path: explicit dequant + forest in one program
+            return _gbt_score_dequant(
+                self._model, x, self._dequant_scale, out_dtype=out_dtype
+            )
         p = self._predict(self._model, x)
         return _cast_scores(p, out_dtype) if out_dtype != jnp.float32 else p
 
     def fused_spec(self) -> FusedSpec:
-        return FusedSpec(_raw_score_gbt, self._model)
+        if self._quant_scale is not None:
+            # evergreen quickwire: int8 codes dequantize IN-program (the
+            # multiply shared with the histogram bin) and the forest scores
+            # the raw-space xf — the explicit-dequant branch, exactly the
+            # pallas discipline
+            return FusedSpec(
+                _raw_score_gbt,
+                self._model,
+                dequant_scale=self._dequant_scale,
+                score_codes=False,
+                wire="int8",
+                explain_args=self._resolve_explainer(),
+            )
+        return FusedSpec(
+            _raw_score_gbt,
+            self._model,
+            wire=self.io_dtype,
+            explain_args=self._resolve_explainer(),
+        )

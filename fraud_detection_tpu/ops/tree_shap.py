@@ -43,6 +43,7 @@ once.
 
 from __future__ import annotations
 
+from functools import partial
 from math import factorial
 from typing import NamedTuple
 
@@ -156,9 +157,18 @@ def build_tree_explainer(
     return TreeShapExplainer(model=model, bg_table=bg_table, expected_value=ev)
 
 
-@jax.jit
-def tree_shap(explainer: TreeShapExplainer, x: jax.Array) -> jax.Array:
-    """SHAP values (n, d) in margin (logit) space; exact:
+def _raw_tree_shap(
+    model: GBTModel, bg_table: jax.Array, x: jax.Array
+) -> jax.Array:
+    """Un-jitted batched TreeSHAP body — the evergreen fusion surface.
+
+    The fused flush programs (monitor/drift ``_fused_flush_explain`` and
+    siblings, via ``_topk_attributions``) trace THIS expression inline when
+    the served family is GBT, exactly as lantern traces
+    ``linear_shap._raw_linear_shap`` for the linear family — both the
+    standalone :func:`tree_shap` explainer and the serve-time reason codes
+    share one body, so the f32-wire bitwise-parity contract holds by
+    construction. SHAP values are (n, d) in margin (logit) space; exact:
     ``Σ_j φ_j + expected_value == gbt_predict_logits(model, x)``.
 
     Batched so NO scatter exists (r5 — the previous vmap-over-rows form
@@ -168,7 +178,6 @@ def tree_shap(explainer: TreeShapExplainer, x: jax.Array) -> jax.Array:
     one-hot matmul on the MXU (HIGHEST precision — exact for these
     operands' f32 values). The remaining index ops are shared-index
     gathers (column permutations), which vectorize."""
-    model = explainer.model
     d_features = model.bin_edges.shape[0]
     depth = int(np.log2(model.split_feature.shape[1] + 1))
     anc, direc, bits_np, pair_np = _tree_static(depth)
@@ -235,13 +244,36 @@ def tree_shap(explainer: TreeShapExplainer, x: jax.Array) -> jax.Array:
             model.split_feature,
             model.split_bin,
             model.leaf_value,
-            explainer.bg_table,
+            bg_table,
         ),
     )
     return phi
 
 
 @jax.jit
+def tree_shap(explainer: TreeShapExplainer, x: jax.Array) -> jax.Array:
+    """SHAP values (n, d) in margin (logit) space — the jitted standalone
+    explainer over :func:`_raw_tree_shap` (one shared body with the fused
+    serve-time reason codes)."""
+    return _raw_tree_shap(explainer.model, explainer.bg_table, x)
+
+
+@jax.jit
 def tree_shap_single(explainer: TreeShapExplainer, x: jax.Array) -> jax.Array:
     """SHAP values (d,) for one row."""
     return tree_shap(explainer, x[None, :])[0]
+
+
+@partial(jax.jit, static_argnames=("k",))
+def tree_shap_topk(
+    explainer: TreeShapExplainer, x: jax.Array, k: int
+) -> tuple[jax.Array, jax.Array]:
+    """Standalone top-k GBT reason codes — the parity reference the fused
+    score+explain flush is gated against bitwise on the f32 wire (the GBT
+    mirror of ``linear_shap.linear_shap_topk``, sharing its tie-breaking
+    contract through ``topk_reasons``)."""
+    from fraud_detection_tpu.ops.linear_shap import topk_reasons
+
+    return topk_reasons(
+        _raw_tree_shap(explainer.model, explainer.bg_table, x), k
+    )

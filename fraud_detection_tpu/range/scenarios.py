@@ -42,6 +42,11 @@ The suite (``run_scenario(name)``):
                           holds, EVERY scored row carries its k reason
                           codes, the kill sheds load without dropping the
                           explain output
+``gbt_explain_under_burst``  the evergreen combo: a GBT champion on the
+                          int8 wire with in-dispatch TreeSHAP reason
+                          codes, Pareto burst + shard kill mid-burst —
+                          same invariants as explain_under_burst on the
+                          family that used to demote out of both legs
 ``poison_entity_state``   one entity hammered with NaN/extreme amounts via
                           the ``ledger.update`` injection point; the poison
                           clamp bounds the victim slot, every other
@@ -1005,21 +1010,20 @@ def scenario_replica_burst(
     return result
 
 
-def scenario_explain_under_burst(
-    seed: int = 2026, total_rows: int = 4096, n_shards: int = 3,
-    victim: int = 1, explain_k: int = 3,
-) -> ScenarioResult:
-    """Pareto burst with SCORER_EXPLAIN=topk on a shard front, a shard
-    killed mid-burst: the p99 invariant holds with the explain leg fused
-    into every flush, EVERY scored row carries its k reason codes (the
-    lantern contract — explanations at flush latency, not minutes behind),
-    and the mid-burst shard kill sheds load WITHOUT dropping the explain
-    output (a re-routed row gets its reason codes from the surviving
-    shard)."""
-    from fraud_detection_tpu.mesh.front import DEAD, ShardFront
+def _drive_explain_burst(
+    rm: RangeModel, seed: int, total_rows: int, n_shards: int,
+    victim: int, explain_k: int,
+) -> tuple[dict, dict, int]:
+    """The shared explain-under-burst harness (lantern AND evergreen
+    scenarios): an explain-on shard front over ``rm``'s scorer, warmed,
+    driven with a Pareto burst, the victim shard killed mid-burst.
+    Returns ``(out, front_status, failures_injected)``. A row counts as
+    explained only when it carries k FINITE reason codes — ONE counting
+    rule for every family, so a NaN-attribution regression fails whichever
+    scenario serves it."""
+    from fraud_detection_tpu.mesh.front import ShardFront
     from fraud_detection_tpu.service.microbatch import MicroBatcher
 
-    rm = build_model(seed=seed)
     wt = _watchtower(rm.profile)
     spec = CampaignSpec(
         total_rows=total_rows, seed=seed, w_true=rm.w_true,
@@ -1076,6 +1080,7 @@ def scenario_explain_under_burst(
                     reasons is not None
                     and len(reasons[0]) == explain_k
                     and len(reasons[1]) == explain_k
+                    and np.all(np.isfinite(np.asarray(reasons[1])))
                 ):
                     n_with_reasons += 1
 
@@ -1096,14 +1101,25 @@ def scenario_explain_under_burst(
             await front.stop()
 
     plan = faults.FaultPlan().call("mesh.shard_flush", shard_fault, times=-1)
-    result = ScenarioResult("explain_under_burst")
     try:
         with plan.armed():
             out = asyncio.run(run())
     finally:
         wt.close()
-    front = fronts[0]
-    status = front.status()
+    return out, fronts[0].status(), injected["n"]
+
+
+def _explain_burst_result(
+    name: str, out: dict, status: dict, injected_n: int,
+    total_rows: int, n_shards: int, victim: int, explain_k: int,
+) -> ScenarioResult:
+    """Common metrics + invariants of the explain-under-burst scenarios:
+    p99 within budget, every row scored, every row explained (k finite
+    reason codes), victim shard dead-and-shed. Family-specific scenarios
+    add their own metrics/invariants on top."""
+    from fraud_detection_tpu.mesh.front import DEAD
+
+    result = ScenarioResult(name)
     result.metrics = {
         "rows": total_rows,
         "rows_scored": out["rows_scored"],
@@ -1112,7 +1128,7 @@ def scenario_explain_under_burst(
         "shards": n_shards,
         "victim": victim,
         "victim_state": status["per_shard"][victim]["state"],
-        "failures_injected": injected["n"],
+        "failures_injected": injected_n,
         "baseline_p99_ms": round(out["baseline_p99_s"] * 1e3, 3),
         "burst_p99_ms": round(
             float(np.percentile(out["latencies_s"], 99)) * 1e3, 3
@@ -1136,8 +1152,8 @@ def scenario_explain_under_burst(
         InvariantOutcome(
             "reasons-on-every-row",
             out["rows_with_reasons"] == total_rows,
-            f"{out['rows_with_reasons']}/{total_rows} rows carried their "
-            f"{explain_k} reason codes — the lantern contract is every "
+            f"{out['rows_with_reasons']}/{total_rows} rows carried "
+            f"{explain_k} finite reason codes — the contract is every "
             "scored row, including rows re-routed off the dead shard",
         )
     )
@@ -1145,11 +1161,101 @@ def scenario_explain_under_burst(
         InvariantOutcome(
             "shard-killed-and-shed",
             status["per_shard"][victim]["state"] == DEAD
-            and injected["n"] > 0,
+            and injected_n > 0,
             f"victim shard {victim} ended "
             f"{status['per_shard'][victim]['state']!r} after "
-            f"{injected['n']} injected failure(s); load shed without "
+            f"{injected_n} injected failure(s); load shed without "
             "dropping explain output",
+        )
+    )
+    return result
+
+
+def scenario_explain_under_burst(
+    seed: int = 2026, total_rows: int = 4096, n_shards: int = 3,
+    victim: int = 1, explain_k: int = 3,
+) -> ScenarioResult:
+    """Pareto burst with SCORER_EXPLAIN=topk on a shard front, a shard
+    killed mid-burst: the p99 invariant holds with the explain leg fused
+    into every flush, EVERY scored row carries its k reason codes (the
+    lantern contract — explanations at flush latency, not minutes behind),
+    and the mid-burst shard kill sheds load WITHOUT dropping the explain
+    output (a re-routed row gets its reason codes from the surviving
+    shard)."""
+    out, status, injected_n = _drive_explain_burst(
+        build_model(seed=seed), seed, total_rows, n_shards, victim, explain_k
+    )
+    return _explain_burst_result(
+        "explain_under_burst", out, status, injected_n,
+        total_rows, n_shards, victim, explain_k,
+    )
+
+
+def build_gbt_model(seed: int = 7, n_base: int = 2400) -> RangeModel:
+    """Fit a small GBT champion on the same synthetic Kaggle-schema data —
+    served on the int8 wire with the fused TreeSHAP explain leg (the
+    evergreen stack: calibration derived from the training scaler before
+    the bin-edge fold, exactly what train.py --model gbt stamps)."""
+    from fraud_detection_tpu.models.gbt import FraudGBTModel
+    from fraud_detection_tpu.monitor.baseline import build_baseline_profile
+    from fraud_detection_tpu.ops.gbt import GBTConfig, gbt_fit
+    from fraud_detection_tpu.ops.scaler import scaler_fit, scaler_transform
+
+    rng = np.random.default_rng(seed)
+    w_true = rng.standard_normal(D).astype(np.float32)
+    x, y = _make_rows(n_base, rng, w_true)
+    scaler = scaler_fit(x)
+    # fit on SCALED inputs — fold_scaler_into_gbt maps the bin edges back
+    # to raw space at wrap time, so the served forest scores raw rows
+    # identically to this fit (the train.py --model gbt pipeline)
+    forest = gbt_fit(
+        np.asarray(scaler_transform(scaler, x)), y.astype(np.float32),
+        GBTConfig(n_trees=16, max_depth=3, n_bins=32),
+    )
+    model = FraudGBTModel(
+        forest, KAGGLE, scaler=scaler, background=x[:64], io_dtype="int8"
+    )
+    scores = np.asarray(model.scorer.predict_proba(x[:1024]))
+    profile = build_baseline_profile(x, scores, feature_names=KAGGLE)
+    return RangeModel(model, profile, w_true, x, y)
+
+
+def scenario_gbt_explain_under_burst(
+    seed: int = 2027, total_rows: int = 4096, n_shards: int = 3,
+    victim: int = 1, explain_k: int = 3,
+) -> ScenarioResult:
+    """The evergreen combo under fire: a GBT champion serving the int8
+    wire with in-dispatch TreeSHAP reason codes, Pareto burst, a shard
+    killed mid-burst. Same harness and invariants as
+    ``explain_under_burst`` (shared ``_drive_explain_burst`` — the two
+    scenarios cannot diverge), plus the evergreen exit criterion: BOTH
+    fusion gauges hold 1 throughout, on the family that before evergreen
+    loudly demoted out of both legs."""
+    from fraud_detection_tpu.service import metrics as svc_metrics
+
+    rm = build_gbt_model(seed=seed)
+    assert rm.model.scorer.io_dtype == "int8", "evergreen serves int8"
+    out, status, injected_n = _drive_explain_burst(
+        rm, seed, total_rows, n_shards, victim, explain_k
+    )
+    result = _explain_burst_result(
+        "gbt_explain_under_burst", out, status, injected_n,
+        total_rows, n_shards, victim, explain_k,
+    )
+    explain_fused = svc_metrics.scorer_explain_fused._value.get()
+    wire_fused = svc_metrics.scorer_wire_fused._value.get()
+    result.metrics.update(
+        wire=rm.model.scorer.io_dtype,
+        explain_fused_gauge=float(explain_fused),
+        wire_fused_gauge=float(wire_fused),
+    )
+    result.add(
+        InvariantOutcome(
+            "fused-end-to-end",
+            explain_fused == 1 and wire_fused == 1,
+            "scorer_explain_fused and scorer_wire_fused must BOTH hold 1 "
+            "with a GBT champion on the int8 wire — the ROADMAP item-3 "
+            "exit criterion (demotion can only be config error)",
         )
     )
     return result
@@ -1700,6 +1806,7 @@ SCENARIOS = {
     "shard_kill_mid_swap": scenario_shard_kill_mid_swap,
     "replica_burst": scenario_replica_burst,
     "explain_under_burst": scenario_explain_under_burst,
+    "gbt_explain_under_burst": scenario_gbt_explain_under_burst,
     "poison_entity_state": scenario_poison_entity_state,
     "ingest_storm": scenario_ingest_storm,
 }

@@ -143,6 +143,15 @@ scorer_explain_fused = Gauge(
     "scorer_wire_fused). Stays 1 when explanation is off or unrequested",
     registry=registry,
 )
+scorer_served_family = Gauge(
+    "scorer_served_family",
+    "1 for the model family the micro-batcher is currently flushing "
+    "(evergreen: both families run every wire/explain combo fused, so the "
+    "lantern/quickwire fusion-state panels carry this label to say WHICH "
+    "family the gauges describe; transitions on hot swap)",
+    ["family"],
+    registry=registry,
+)
 scorer_explained_rows = Counter(
     "scorer_explained_rows",
     "Scored rows whose response carried fused top-k reason codes (the "

@@ -235,6 +235,10 @@ class MicroBatcher:
             )
         self._explain_fused: bool | None = None
         metrics.scorer_explain_fused.set(1)
+        # evergreen: which model family the flushes serve — latched like
+        # the fusion gauges (one string compare per flush), transitioning
+        # on hot swap so the dashboard family label follows promotions
+        self._family: str | None = None
         self.adaptive_wait = (
             adaptive_wait
             if adaptive_wait is not None
@@ -540,6 +544,19 @@ class MicroBatcher:
             await self._flush(batch)
         finally:
             self._inflight.release()
+
+    def _note_family(self, scorer) -> None:
+        """Latch the served model family onto ``scorer_served_family`` (the
+        dashboard label saying which family the lantern/quickwire fusion
+        gauges currently describe). Steady state: one string compare."""
+        fam = getattr(scorer, "family", "linear")
+        if fam == self._family:
+            return
+        prev = self._family
+        self._family = fam
+        metrics.scorer_served_family.labels(fam).set(1)
+        if prev is not None:
+            metrics.scorer_served_family.labels(prev).set(0)
 
     def _note_wire_fused(self, fused: bool, scorer) -> None:
         """Export + (on transition) log whether the active wire format runs
@@ -942,6 +959,7 @@ class MicroBatcher:
                 scorer = model.scorer
             else:
                 scorer, source, version = self.scorer, None, None
+            self._note_family(scorer)
             loop = asyncio.get_running_loop()
             explain_out = None
             if hasattr(scorer, "stage_rows") and hasattr(scorer, "_score_padded"):

@@ -76,8 +76,21 @@ class XaiWorker:
     #: tolerance of the serve-time vs backfill attribution comparison: must
     #: cover the int8 wire's quantization error (the fused leg attributes
     #: the dequantized lattice values the model actually scored) — same
-    #: order as the quickwire score-parity gate.
+    #: order as the quickwire score-parity gate. A model family can widen
+    #: it via an ``explain_consistency_atol`` attribute (the GBT family
+    #: does: a quantized bin flip moves φ by a leaf-value delta, not an
+    #: elementwise rounding error — see models/gbt.FraudGBTModel).
     EXPLAIN_CONSISTENCY_ATOL = 5e-2
+
+    @property
+    def _explain_atol(self) -> float:
+        return float(
+            getattr(
+                getattr(self, "model", None),
+                "explain_consistency_atol",
+                self.EXPLAIN_CONSISTENCY_ATOL,
+            )
+        )
 
     def _check_explain_consistency(
         self, phi, serve_topk, correlation_id, transaction_id
@@ -100,7 +113,7 @@ class XaiWorker:
         phi = np.asarray(phi, np.float64).reshape(-1)
         if not idxs or len(idxs) != vals.shape[0] or max(idxs) >= phi.shape[0]:
             return True  # malformed/absent payload: nothing to check
-        atol = self.EXPLAIN_CONSISTENCY_ATOL
+        atol = self._explain_atol
         spec = getattr(getattr(self, "model", None), "ledger_spec", None)
         if spec is not None:
             # ledger-widened family: serve-time attributions for the K

@@ -285,6 +285,10 @@ def train(
             # The wrapper folds the scaler into the bin edges, so the saved
             # forest scores raw inputs directly (no scaler sidecar needed).
             # A raw-space training subsample ships as the TreeSHAP background.
+            # The wrapper also derives the int8 wire calibration from the
+            # scaler BEFORE the fold consumes it, and save() stamps
+            # quant_calibration.npz beside the forest (evergreen) — same
+            # sidecar contract as the linear branch below.
             bg_idx = np.random.default_rng(seed).choice(
                 len(x_train), min(128, len(x_train)), replace=False
             )

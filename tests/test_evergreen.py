@@ -1,0 +1,721 @@
+"""Evergreen acceptance tests (ISSUE 12): full fused parity for the GBT
+family — in-dispatch TreeSHAP reason codes + the int8 wire.
+
+The fused flush's explain leg now dispatches on the explain-args pytree
+family: a ``TreeShapExplainer`` traces the exact interventional TreeSHAP
+body (``ops/tree_shap._raw_tree_shap``) inline with scoring and the drift
+fold, so a GBT champion serves reason codes in the SAME single donated
+dispatch as the linear family — bitwise the standalone ``tree_shap``
+explainer on the f32 wire, tolerance-gated on the int8 wire (attributions
+explain the dequantized lattice values the forest actually scored). The
+int8 wire itself is first-class for GBT: a stamped ``QuantCalibration``
+rides the artifact (the scaler is folded into the bin edges at train time,
+so there is nothing to re-derive from at serve), the fused program runs the
+explicit-dequant branch, fused scores bitwise-match the split dequant path,
+and N-shard output bitwise-matches single-device. Exit criterion (ROADMAP
+item 3): with a GBT champion + SCORER_EXPLAIN=topk + SCORER_WIRE=int8,
+``scorer_explain_fused = 1`` AND ``scorer_wire_fused = 1`` —
+ExplainUnfused/WireFormatUnfused can only fire on genuine config error,
+never on family choice.
+"""
+
+import asyncio
+import logging
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fraud_detection_tpu.models.gbt import FraudGBTModel
+from fraud_detection_tpu.monitor.baseline import build_baseline_profile
+from fraud_detection_tpu.monitor.drift import DriftMonitor, psi_np
+from fraud_detection_tpu.monitor.watchtower import Thresholds, Watchtower
+from fraud_detection_tpu.ops.gbt import GBTConfig, gbt_fit
+from fraud_detection_tpu.ops.quant import derive_calibration
+from fraud_detection_tpu.ops.scaler import scaler_fit
+from fraud_detection_tpu.ops.scorer import (
+    GBTBatchScorer,
+    _bucket,
+    decode_explain_into,
+    decode_scores_into,
+)
+from fraud_detection_tpu.ops.tree_shap import (
+    build_tree_explainer,
+    tree_shap,
+    tree_shap_topk,
+)
+from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.service.microbatch import MicroBatcher
+
+D = 30
+K = 3
+THR = Thresholds(psi=0.2, ks=0.15, ece=0.1, disagree=0.05, min_rows=64)
+NAMES = [f"f{i}" for i in range(D)]
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(12)
+    return (rng.standard_normal((4096, D)) * 2.0 + 0.5).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def labels(data):
+    rng = np.random.default_rng(13)
+    w = rng.standard_normal(D).astype(np.float32)
+    logits = data @ w - 2.0
+    return (rng.random(len(data)) < 1.0 / (1.0 + np.exp(-logits))).astype(
+        np.float32
+    )
+
+
+@pytest.fixture(scope="module")
+def forest(data, labels):
+    """A small-but-real fitted forest (the serving shapes, cheap on CPU)."""
+    return gbt_fit(
+        data[:2048], labels[:2048], GBTConfig(n_trees=16, max_depth=3, n_bins=32)
+    )
+
+
+@pytest.fixture(scope="module")
+def explainer(forest, data):
+    return build_tree_explainer(forest, data[:64])
+
+
+@pytest.fixture(scope="module")
+def scaler(data):
+    return scaler_fit(data)
+
+
+@pytest.fixture(scope="module")
+def calibration(scaler):
+    return derive_calibration(scaler)
+
+
+@pytest.fixture(scope="module")
+def profile(data, forest):
+    scorer = GBTBatchScorer(forest)
+    return build_baseline_profile(
+        data, scorer.predict_proba(data[:1024]), feature_names=NAMES
+    )
+
+
+def _gbt_scorer(forest, explainer, calibration=None, io_dtype="float32"):
+    return GBTBatchScorer(
+        forest,
+        io_dtype=io_dtype,
+        calibration=calibration if io_dtype == "int8" else None,
+        explainer=lambda: explainer,
+    )
+
+
+def _explain_once(scorer, monitor, batch_rows, k=K, out_dtype=jnp.float32):
+    """One fused score+explain flush through the real staging path."""
+    n = len(batch_rows)
+    spec = scorer.fused_spec()
+    slot = scorer.staging.acquire(_bucket(n, scorer.min_bucket))
+    try:
+        hx = scorer.stage_rows(slot, list(batch_rows))
+        s, ei, ev = monitor.fused_flush(
+            jnp.asarray(hx), jnp.asarray(slot.valid), n,
+            spec.score_args, spec.score_fn,
+            dequant_scale=spec.dequant_scale, score_codes=spec.score_codes,
+            out_dtype=out_dtype,
+            explain_args=spec.explain_args, explain_k=k,
+        )
+        raw = np.asarray(s)
+        if raw.dtype != np.float32:
+            raw = decode_scores_into(raw, slot.scores).copy()
+        ei, ev = decode_explain_into(np.asarray(ei), np.asarray(ev), slot)
+        return raw[:n].copy(), ei[:n].copy(), ev[:n].copy()
+    finally:
+        scorer.staging.release(slot)
+
+
+def _flush_once(scorer, monitor, batch_rows):
+    """One fused flush WITHOUT the explain leg."""
+    n = len(batch_rows)
+    spec = scorer.fused_spec()
+    slot = scorer.staging.acquire(_bucket(n, scorer.min_bucket))
+    try:
+        hx = scorer.stage_rows(slot, list(batch_rows))
+        out = monitor.fused_flush(
+            jnp.asarray(hx), jnp.asarray(slot.valid), n,
+            spec.score_args, spec.score_fn,
+            dequant_scale=spec.dequant_scale, score_codes=spec.score_codes,
+        )
+        return np.asarray(out, np.float32)[:n].copy()
+    finally:
+        scorer.staging.release(slot)
+
+
+# -- f32 wire: bitwise parity with the standalone explainer ------------------
+
+
+@pytest.mark.parametrize("n", [1, 7, 64, 700])
+def test_fused_gbt_topk_bitwise_matches_standalone(
+    data, forest, explainer, profile, n
+):
+    """Fused GBT reason codes (indices AND values) are bitwise the
+    standalone tree_shap top-k on the f32 wire — the evergreen parity
+    contract, held by the shared ``_raw_tree_shap`` body."""
+    scorer = _gbt_scorer(forest, explainer)
+    mon = DriftMonitor(profile)
+    batch = data[:n]
+    scores, idx, val = _explain_once(scorer, mon, [batch[i] for i in range(n)])
+    ref_idx, ref_val = tree_shap_topk(explainer, jnp.asarray(batch), K)
+    assert np.array_equal(idx, np.asarray(ref_idx))
+    assert np.array_equal(
+        val.view(np.uint32), np.asarray(ref_val).view(np.uint32)
+    ), "fused GBT attribution values diverge from standalone tree_shap"
+    ref_scores = scorer.predict_proba(batch)
+    assert np.array_equal(
+        np.asarray(scores, np.float32).view(np.uint32),
+        ref_scores.view(np.uint32),
+    )
+
+
+def test_fused_gbt_topk_matches_worker_explainer(data, forest, scaler):
+    """The fused explain pytree IS the async worker's cached TreeSHAP
+    explainer: per-row top-k of model.explain_batch equals the fused
+    output bitwise — the consistency check the task payload rides on."""
+    model = FraudGBTModel(forest, NAMES, background=data[:64])
+    batch = data[:32]
+    phi, _ = model.explain_batch(batch)
+    spec = model.scorer.fused_spec()
+    fused_phi = np.asarray(
+        tree_shap(spec.explain_args, jnp.asarray(batch))
+    )
+    assert np.array_equal(
+        phi.astype(np.float32).view(np.uint32),
+        fused_phi.astype(np.float32).view(np.uint32),
+    )
+
+
+def test_gbt_k_clamps_to_n_features(data, forest, explainer, profile):
+    scorer = _gbt_scorer(forest, explainer)
+    mon = DriftMonitor(profile)
+    _, idx, val = _explain_once(scorer, mon, [data[0], data[1]], k=D + 11)
+    assert idx.shape == (2, D) and val.shape == (2, D)
+    for r in range(2):
+        assert sorted(idx[r].tolist()) == list(range(D))
+        assert np.all(np.diff(val[r]) <= 0)
+
+
+def test_gbt_explain_leg_does_not_move_the_window(
+    data, forest, explainer, profile
+):
+    """Identical traffic through the plain fused flush and the GBT explain
+    flush ends in bitwise-identical windows."""
+    scorer = _gbt_scorer(forest, explainer)
+    mon_plain, mon_explain = DriftMonitor(profile), DriftMonitor(profile)
+    rows = [data[i] for i in range(200)]
+    _flush_once(scorer, mon_plain, rows)
+    _explain_once(scorer, mon_explain, rows)
+    for f in mon_plain.window._fields:
+        a = np.asarray(getattr(mon_plain.window, f), np.float32)
+        b = np.asarray(getattr(mon_explain.window, f), np.float32)
+        assert np.array_equal(a.view(np.uint32), b.view(np.uint32)), (
+            f"GBT explain leg moved window field {f}"
+        )
+
+
+def test_gbt_explain_warmup_leaves_window_bitwise_unchanged(
+    data, forest, explainer, calibration, profile
+):
+    """warm_fused through the GBT quant+explain program (all-padding
+    batch): window state bitwise untouched on the harshest combo."""
+    scorer = _gbt_scorer(forest, explainer, calibration, io_dtype="int8")
+    mon = DriftMonitor(profile)
+    mon.update(data[:100], scorer.predict_proba(data[:100]))
+    before = {
+        f: np.asarray(getattr(mon.window, f)).copy()
+        for f in mon.window._fields
+    }
+    mon.warm_fused(scorer, 64, explain_k=K)
+    for f, a in before.items():
+        assert np.array_equal(a, np.asarray(getattr(mon.window, f))), f
+
+
+# -- the int8 wire -----------------------------------------------------------
+
+
+def test_gbt_int8_needs_stamped_calibration(forest):
+    """GBT has no serve-time scaler (folded into the bin edges): the int8
+    wire without a stamped calibration is a constructor error at the
+    scorer layer and a loud f32 fallback at the model layer."""
+    with pytest.raises(ValueError, match="stamped"):
+        GBTBatchScorer(forest, io_dtype="int8")
+
+
+def test_gbt_model_int8_without_calibration_falls_back_loudly(
+    forest, caplog
+):
+    with caplog.at_level(logging.WARNING, logger="fraud_detection_tpu.models"):
+        model = FraudGBTModel(forest, NAMES, io_dtype="int8")
+    assert model.scorer.io_dtype == "float32"
+    assert any("float32 wire" in r.message for r in caplog.records)
+
+
+def test_gbt_quant_fused_scores_match_split_bitwise(
+    data, forest, explainer, calibration, profile
+):
+    """Fused int8 GBT scores bitwise-match the split explicit-dequant path
+    (one shared dequant expression, quickwire's parity discipline)."""
+    scorer = _gbt_scorer(forest, explainer, calibration, io_dtype="int8")
+    mon = DriftMonitor(profile)
+    rows = [data[i] for i in range(128)]
+    fused = _flush_once(scorer, mon, rows)
+    split = scorer.predict_proba(np.stack(rows))
+    assert np.array_equal(fused.view(np.uint32), split.view(np.uint32))
+
+
+def test_gbt_quant_explain_matches_dequant_reference(
+    data, forest, explainer, calibration, profile
+):
+    """Int8 wire: fused GBT attributions match the standalone tree_shap
+    top-k over the DEQUANTIZED rows — reason codes explain the lattice
+    values the forest actually binned. TreeSHAP depends on the input only
+    through exact bin comparisons, so the in-program dequant reproduces
+    the host-staged reference bitwise here (unlike the linear family's
+    FMA reassociation)."""
+    scorer = _gbt_scorer(forest, explainer, calibration, io_dtype="int8")
+    mon = DriftMonitor(profile)
+    batch = [data[i] for i in range(64)]
+    _, idx, val = _explain_once(scorer, mon, batch)
+    spec = scorer.fused_spec()
+    codes = scorer._prepare_host(np.stack(batch)).astype(np.float32)
+    xf = codes * np.asarray(spec.dequant_scale)
+    ref_idx, ref_val = tree_shap_topk(explainer, jnp.asarray(xf), K)
+    assert np.array_equal(idx, np.asarray(ref_idx))
+    np.testing.assert_allclose(
+        val.astype(np.float64), np.asarray(ref_val, np.float64),
+        rtol=0, atol=1e-6,
+    )
+
+
+def test_gbt_quant_drift_windows_bin_comparably(
+    data, forest, explainer, calibration, profile
+):
+    """After identical traffic, PSI between the int8-path and f32-path GBT
+    windows stays under the quickwire epsilon — watchtower thresholds mean
+    the same thing on both wires for the GBT family too."""
+    f32 = _gbt_scorer(forest, explainer)
+    q8 = _gbt_scorer(forest, explainer, calibration, io_dtype="int8")
+    mon_f, mon_q = DriftMonitor(profile), DriftMonitor(profile)
+    for lo in range(0, 2048, 256):
+        rows = [data[lo + i] for i in range(256)]
+        _flush_once(f32, mon_f, rows)
+        _flush_once(q8, mon_q, rows)
+    wf, wq = mon_f.window, mon_q.window
+    assert psi_np(
+        np.asarray(wq.score_counts), np.asarray(wf.score_counts)
+    ) <= 0.02
+    fc_q, fc_f = np.asarray(wq.feature_counts), np.asarray(wf.feature_counts)
+    assert max(
+        psi_np(fc_q[i], fc_f[i]) for i in range(fc_q.shape[0])
+    ) <= 0.1
+
+
+def test_gbt_bf16_wire_flushes_fused(data, forest, explainer, profile):
+    """The bf16 wire rides the plain fused program for GBT (the forest
+    bins the bf16-rounded values it actually scored)."""
+    scorer = _gbt_scorer(forest, explainer, io_dtype="bfloat16")
+    mon = DriftMonitor(profile)
+    rows = [data[i] for i in range(64)]
+    fused = _flush_once(scorer, mon, rows)
+    split = scorer.predict_proba(np.stack(rows))
+    assert np.array_equal(fused.view(np.uint32), split.view(np.uint32))
+
+
+def test_gbt_return_wire_narrows_and_decodes(
+    data, forest, explainer, calibration, profile
+):
+    """uint8 d2h return over the int8 h2d wire (the full compressed
+    round trip): decoded scores within one lattice step."""
+    scorer = _gbt_scorer(forest, explainer, calibration, io_dtype="int8")
+    mon = DriftMonitor(profile)
+    rows = [data[i] for i in range(64)]
+    s_narrow, idx, val = _explain_once(
+        scorer, mon, rows, out_dtype=jnp.uint8
+    )
+    s_full = _flush_once(scorer, DriftMonitor(profile), rows)
+    assert np.abs(s_narrow - s_full).max() <= 0.5 / 255.0 + 1e-7
+    assert idx.shape == (64, K)
+
+
+# -- artifacts / persistence -------------------------------------------------
+
+
+def test_gbt_model_stamps_and_rebinds_calibration(tmp_path, data, forest, scaler):
+    """FraudGBTModel derives the calibration from the scaler BEFORE the
+    fold consumes it, save() stamps quant_calibration.npz, and load()
+    rebinds it — a promoted GBT artifact serves int8 with ITS lattice."""
+    model = FraudGBTModel(
+        forest, NAMES, scaler=scaler, background=data[:64]
+    )
+    assert model.calibration is not None
+    out = tmp_path / "gbt"
+    model.save(str(out))
+    assert (out / "quant_calibration.npz").exists()
+    loaded = FraudGBTModel.load(str(out))
+    assert loaded.calibration is not None
+    np.testing.assert_array_equal(
+        loaded.calibration.scale, model.calibration.scale
+    )
+    # and an int8 deploy of the loaded artifact binds the stamped lattice
+    m_int8 = FraudGBTModel(
+        loaded.model, NAMES, background=loaded.background,
+        calibration=loaded.calibration, io_dtype="int8",
+    )
+    assert m_int8.scorer.io_dtype == "int8"
+    np.testing.assert_array_equal(
+        m_int8.scorer._quant_scale, model.calibration.scale
+    )
+
+
+def test_train_gbt_stamps_calibration(tmp_path, data, labels, monkeypatch):
+    """train.py --model gbt stamps quant_calibration.npz beside the forest
+    in BOTH the out_dir and the registry artifact copy."""
+    import os
+
+    from fraud_detection_tpu.train import train
+
+    csv = tmp_path / "cc.csv"
+    cols = ",".join(NAMES + ["Class"])
+    rows = np.concatenate(
+        [data[:400], labels[:400, None].astype(np.float32)], axis=1
+    )
+    np.savetxt(csv, rows, delimiter=",", header=cols, comments="")
+    monkeypatch.setenv("TRACKING_ROOT", str(tmp_path / "mlruns"))
+    out_dir = tmp_path / "models"
+    res = train(
+        data_csv=str(csv), n_folds=2, use_smote=False, register=False,
+        out_dir=str(out_dir), model_family="gbt",
+        gbt_config=GBTConfig(n_trees=4, max_depth=3, n_bins=16),
+    )
+    assert "test_auc" in res
+    assert os.path.exists(out_dir / "quant_calibration.npz")
+    loaded = FraudGBTModel.load(str(out_dir))
+    assert loaded.calibration is not None
+    # and the loaded artifact serves the int8 wire end to end
+    m = FraudGBTModel(
+        loaded.model, loaded.feature_names, background=loaded.background,
+        calibration=loaded.calibration, io_dtype="int8",
+    )
+    p = m.scorer.predict_proba(data[:16, : len(loaded.feature_names)])
+    assert np.all(np.isfinite(p))
+
+
+# -- mesh --------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_shards", [2, 4, 8])
+@pytest.mark.parametrize("wire", ["float32", "int8"])
+def test_mesh_gbt_explain_bitwise_matches_single_device(
+    data, forest, explainer, calibration, profile, n_shards, wire
+):
+    """N-shard fused GBT explain (scores, indices, values, merged window)
+    is bitwise the single-device flush on BOTH wires — reason codes
+    row-shard with zero collectives, no new programs."""
+    import jax
+
+    from fraud_detection_tpu.mesh.shardflush import (
+        MeshDriftMonitor,
+        merge_window,
+    )
+    from fraud_detection_tpu.parallel.mesh import MeshSpec, create_mesh
+
+    scorer = _gbt_scorer(forest, explainer, calibration, io_dtype=wire)
+    mono = DriftMonitor(profile)
+    rows = [data[i] for i in range(256)]
+    s1, i1, v1 = _explain_once(scorer, mono, rows)
+
+    mesh = create_mesh(
+        MeshSpec(data=n_shards), devices=jax.devices()[:n_shards]
+    )
+    mm = MeshDriftMonitor(profile, mesh)
+    sN, iN, vN = _explain_once(scorer, mm, rows)
+    assert np.array_equal(
+        np.asarray(s1, np.float32).view(np.uint32),
+        np.asarray(sN, np.float32).view(np.uint32),
+    )
+    assert np.array_equal(i1, iN)
+    assert np.array_equal(v1.view(np.uint32), vN.view(np.uint32))
+    merged = merge_window(mm.shard_window)
+    for f in mono.window._fields:
+        a = np.asarray(getattr(mono.window, f), np.float32)
+        b = np.asarray(getattr(merged, f), np.float32)
+        assert np.array_equal(a.view(np.uint32), b.view(np.uint32)), f
+
+
+def test_meshcheck_registers_evergreen_entrypoints():
+    from fraud_detection_tpu.analysis.meshcheck import (
+        _ENTRYPOINTS,
+        verify_entrypoint,
+    )
+
+    for name in ("evergreen.flush", "mesh.evergreen_flush"):
+        res = verify_entrypoint(_ENTRYPOINTS[name])
+        assert res and all(r["ok"] for r in res), res
+
+
+# -- compile sentinel --------------------------------------------------------
+
+
+def _compiles(entrypoint: str) -> float:
+    return metrics.xla_compiles.labels(entrypoint)._value.get()
+
+
+def test_gbt_sentinel_exact_across_bucket_ladder(
+    data, forest, explainer, calibration, profile
+):
+    """The GBT quant+explain program folds into the lantern.flush sentinel
+    entrypoint: exactly one compile per shape bucket, zero on re-drive."""
+    import jax
+
+    from fraud_detection_tpu.telemetry import compile_sentinel
+
+    jax.clear_caches()
+    compile_sentinel.install()
+    try:
+        scorer = _gbt_scorer(forest, explainer, calibration, io_dtype="int8")
+        mon = DriftMonitor(profile)
+        rows = [data[i] for i in range(40)]
+        base = _compiles("lantern.flush")
+        for n in (3, 12, 20):  # buckets 8, 16, 32
+            _explain_once(scorer, mon, rows[:n])
+        assert _compiles("lantern.flush") - base == 3
+        for n in (5, 9, 31):  # same buckets: cache hits only
+            _explain_once(scorer, mon, rows[:n])
+        assert _compiles("lantern.flush") - base == 3
+    finally:
+        compile_sentinel.uninstall()
+
+
+# -- serving: gauges, single dispatch, hot swap ------------------------------
+
+
+def test_microbatcher_gbt_int8_explain_single_dispatch(
+    data, forest, explainer, calibration, profile
+):
+    """THE exit criterion: GBT champion + SCORER_EXPLAIN=topk +
+    SCORER_WIRE=int8 → one device dispatch per flush, every row carries k
+    reason codes, and BOTH fusion gauges hold 1."""
+    scorer = _gbt_scorer(forest, explainer, calibration, io_dtype="int8")
+    wt = Watchtower(profile, thresholds=THR)
+
+    async def run():
+        mb = MicroBatcher(
+            scorer, max_batch=64, max_wait_ms=1.0, watchtower=wt,
+            telemetry=False, fused=True, explain=True, explain_k=K,
+        )
+        await mb.start()
+        try:
+            return await asyncio.gather(
+                *(mb.score_ex(data[i]) for i in range(48))
+            )
+        finally:
+            await mb.stop()
+
+    try:
+        out = asyncio.run(run())
+    finally:
+        wt.drain()
+        wt.close()
+    assert len(out) == 48
+    for score, reasons in out:
+        assert 0.0 <= score <= 1.0
+        assert reasons is not None
+        assert len(reasons[0]) == K and len(reasons[1]) == K
+    assert metrics.scorer_device_calls_per_flush._value.get() == 1
+    assert metrics.scorer_wire_fused._value.get() == 1
+    assert metrics.scorer_explain_fused._value.get() == 1
+    assert metrics.scorer_served_family.labels("gbt")._value.get() == 1
+
+
+def test_hot_swap_rebinds_across_families(
+    data, forest, explainer, calibration, profile, scaler
+):
+    """Satellite: promote a linear champion → GBT challenger (and back)
+    through the ModelSlot with the fused ladder pre-warmed
+    (lifecycle/swap.warm_fused_ladder — what ModelReloader now runs before
+    the swap): post-swap reason codes come from the NEW family's explainer,
+    ZERO unexpected lantern compiles, and the fusion gauges stay 1 across
+    both directions; an explainer-less spec still transitions them 0↔1."""
+    from fraud_detection_tpu.lifecycle.swap import ModelSlot, warm_fused_ladder
+    from fraud_detection_tpu.ops.logistic import LogisticParams
+    from fraud_detection_tpu.ops.scorer import BatchScorer
+    from fraud_detection_tpu.telemetry import compile_sentinel
+
+    rng = np.random.default_rng(3)
+    lin = BatchScorer(
+        LogisticParams(
+            coef=rng.standard_normal(D).astype(np.float32) * 0.3,
+            intercept=np.float32(-1.0),
+        ),
+        scaler,
+    )
+    gbt = _gbt_scorer(forest, explainer)
+    wt = Watchtower(profile, thresholds=THR)
+    slot = ModelSlot(types.SimpleNamespace(scorer=lin), "test:lin", 1)
+
+    compile_sentinel.install()
+    try:
+        async def run():
+            mb = MicroBatcher(
+                slot=slot, max_batch=32, max_wait_ms=1.0, max_inflight=4,
+                watchtower=wt, telemetry=False, fused=True,
+                explain=True, explain_k=K,
+            )
+            await mb.start()
+            # pre-warm the GBT family's fused ladder exactly as the
+            # reloader does before flipping the slot
+            warm_fused_ladder(wt, gbt, max_batch=32, explain_k=K)
+            base = _compiles("lantern.flush")
+            await asyncio.gather(*(mb.score_ex(data[i]) for i in range(16)))
+            slot.swap(types.SimpleNamespace(scorer=gbt), "test:gbt", 2)
+            second = await asyncio.gather(
+                *(mb.score_ex(data[i]) for i in range(16))
+            )
+            gauges_gbt = (
+                metrics.scorer_explain_fused._value.get(),
+                metrics.scorer_wire_fused._value.get(),
+                metrics.scorer_served_family.labels("gbt")._value.get(),
+                metrics.scorer_served_family.labels("linear")._value.get(),
+            )
+            slot.swap(types.SimpleNamespace(scorer=lin), "test:lin", 3)
+            third = await asyncio.gather(
+                *(mb.score_ex(data[i]) for i in range(16))
+            )
+            await mb.stop()
+            return second, third, gauges_gbt, _compiles("lantern.flush") - base
+
+        second, third, gauges_gbt, new_compiles = asyncio.run(run())
+    finally:
+        compile_sentinel.uninstall()
+        wt.drain()
+        wt.close()
+
+    # post-swap reason codes reflect the GBT family's explainer
+    ri, rv = tree_shap_topk(explainer, jnp.asarray(data[:16]), K)
+    ri, rv = np.asarray(ri), np.asarray(rv)
+    for i, (_, reasons) in enumerate(second):
+        assert reasons is not None
+        assert reasons[0] == ri[i].tolist()
+        np.testing.assert_allclose(reasons[1], rv[i], rtol=1e-6, atol=1e-6)
+    assert all(r is not None for _, r in third)
+    assert gauges_gbt == (1, 1, 1, 0), (
+        "a GBT champion must serve with both fusion gauges at 1 and the "
+        f"family label transitioned — got {gauges_gbt}"
+    )
+    assert metrics.scorer_served_family.labels("linear")._value.get() == 1
+    assert new_compiles == 0, (
+        "a pre-warmed cross-family swap recompiled the fused explain program"
+    )
+
+
+def test_demotion_gauge_transitions_across_swaps(
+    data, forest, profile, scaler
+):
+    """An explainer-less GBT spec (genuine config error: no fused explain
+    leg) latches scorer_explain_fused=0; swapping back to a full-parity
+    family returns it to 1 — the gauge transitions 0↔1 with the slot."""
+    from fraud_detection_tpu.lifecycle.swap import ModelSlot
+
+    bare = GBTBatchScorer(forest)  # no explainer bound → explain_args None
+    full = GBTBatchScorer(
+        forest, explainer=lambda: build_tree_explainer(forest, data[:16])
+    )
+    wt = Watchtower(profile, thresholds=THR)
+    slot = ModelSlot(types.SimpleNamespace(scorer=bare), "test:bare", 1)
+
+    async def run():
+        mb = MicroBatcher(
+            slot=slot, max_batch=32, max_wait_ms=1.0, watchtower=wt,
+            telemetry=False, fused=True, explain=True, explain_k=K,
+        )
+        await mb.start()
+        a = await mb.score_ex(data[0])
+        g0 = metrics.scorer_explain_fused._value.get()
+        slot.swap(types.SimpleNamespace(scorer=full), "test:full", 2)
+        b = await mb.score_ex(data[1])
+        g1 = metrics.scorer_explain_fused._value.get()
+        await mb.stop()
+        return a, g0, b, g1
+
+    try:
+        (s0, r0), g0, (s1, r1), g1 = asyncio.run(run())
+    finally:
+        wt.drain()
+        wt.close()
+    assert r0 is None and g0 == 0
+    assert r1 is not None and g1 == 1
+    metrics.scorer_explain_fused.set(1)  # un-latch for later tests
+
+
+# -- worker consistency check ------------------------------------------------
+
+
+def _worker_with(model):
+    from fraud_detection_tpu.service.worker import XaiWorker
+
+    w = XaiWorker.__new__(XaiWorker)
+    w.model = model
+    return w
+
+
+def test_worker_consistency_gbt_f32_and_quant(data, forest):
+    """The backfill consistency check covers the GBT family: exact on the
+    f32 wire (shared body), within the family's widened atol on the int8
+    lattice, counting failures on genuine divergence — single path."""
+    model = FraudGBTModel(forest, NAMES, background=data[:64])
+    w = _worker_with(model)
+    assert w._explain_atol == FraudGBTModel.explain_consistency_atol
+    row = data[0]
+    phi, _ = model.explain_one(row)
+    order = np.argsort(-phi, kind="stable")[:K]
+    serve = {
+        "indices": [int(i) for i in order],
+        "values": [float(phi[i]) for i in order],
+    }
+    before = metrics.xai_explain_consistency_failures._value.get()
+    assert w._check_explain_consistency(phi, serve, "c", "t") is True
+    # int8-lattice-sized perturbation still passes (quant-tolerant atol)
+    fuzzy = {
+        "indices": serve["indices"],
+        "values": [v + 0.1 for v in serve["values"]],
+    }
+    assert w._check_explain_consistency(phi, fuzzy, "c", "t") is True
+    assert metrics.xai_explain_consistency_failures._value.get() == before
+    # genuine divergence (wrong feature, wrong magnitude) fails + counts
+    bad = {
+        "indices": serve["indices"],
+        "values": [v + 10.0 for v in serve["values"]],
+    }
+    assert w._check_explain_consistency(phi, bad, "c", "t") is False
+    assert (
+        metrics.xai_explain_consistency_failures._value.get() == before + 1
+    )
+
+
+def test_worker_consistency_gbt_batched_path(data, forest):
+    """The BATCHED backfill (explain_batch, the claim-many path) agrees
+    with the fused serve-time top-k for every row of a GBT batch."""
+    model = FraudGBTModel(forest, NAMES, background=data[:64])
+    w = _worker_with(model)
+    batch = data[:16]
+    phis, _ = model.explain_batch(batch)
+    spec = model.scorer.fused_spec()
+    fi, fv = tree_shap_topk(spec.explain_args, jnp.asarray(batch), K)
+    fi, fv = np.asarray(fi), np.asarray(fv)
+    for i in range(16):
+        serve = {
+            "indices": fi[i].tolist(),
+            "values": fv[i].astype(float).tolist(),
+        }
+        assert w._check_explain_consistency(
+            phis[i], serve, "corr", f"tx-{i}"
+        ) is True

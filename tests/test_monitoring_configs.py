@@ -223,6 +223,36 @@ def test_lantern_alert_and_panels_present():
         assert "scorer_explained_rows" in dash, rel
 
 
+def test_evergreen_family_label_on_fusion_panels():
+    """The evergreen contract (ISSUE 12): both families serve every
+    wire/explain combo fused, so the lantern + quickwire fusion-state
+    panels on BOTH dashboards carry the ``scorer_served_family`` label
+    saying WHICH family the gauges currently describe, and the gauge is
+    exported by service/metrics.py."""
+    import json
+
+    assert "scorer_served_family" in _exported_metric_names()
+    for rel in (
+        "grafana_dashboard.json",
+        os.path.join("grafana_provisioning", "dashboards", "fraud-tpu.json"),
+    ):
+        with open(os.path.join(MONITORING, rel)) as f:
+            dash = json.load(f)
+        for title in (
+            "Quickwire: wire fusion state",
+            "Lantern: explain fusion state",
+        ):
+            panel = next(
+                p for p in dash["panels"] if p.get("title") == title
+            )
+            exprs = " ".join(t.get("expr", "") for t in panel["targets"])
+            assert "scorer_served_family" in exprs, (rel, title)
+            legends = " ".join(
+                t.get("legendFormat", "") for t in panel["targets"]
+            )
+            assert "{{family}}" in legends, (rel, title)
+
+
 def test_mesh_rules_file_ships():
     """The switchyard contract (ISSUE 7): mesh-alerts.yml ships
     promlint-clean with the two promised alerts."""

@@ -475,6 +475,17 @@ def test_scenario_explain_under_burst():
 
 
 @pytest.mark.slow
+def test_scenario_gbt_explain_under_burst():
+    """Evergreen chaos (ISSUE 12): a GBT champion on the int8 wire with
+    in-dispatch TreeSHAP reason codes, Pareto burst + shard kill — p99
+    holds, every scored row carries its k finite reason codes, and BOTH
+    fusion gauges hold 1 throughout (the ROADMAP item-3 exit criterion)."""
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario("gbt_explain_under_burst").raise_if_failed()
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "kill_point",
     [
