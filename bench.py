@@ -66,6 +66,7 @@ SECTION_BUDGETS = {
     "quantized_flush": 300,  # + the evergreen GBT parity row
     "explain_flush": 300,    # + the evergreen GBT cost/parity row
     "mesh_serving": 300,
+    "wide_flush": 300,
     "telemetry": 240,
     "lifecycle": 240,
     "scenarios": 720,  # 12 scenarios since gbt_explain_under_burst joined
@@ -1308,6 +1309,36 @@ def bench_mesh_serving() -> dict:
         except json.JSONDecodeError:
             continue
     raise RuntimeError("mesh probe printed no JSON")
+
+
+def bench_wide_flush() -> dict:
+    """Broadside: the tensor-parallel wide family's 2-D flush, measured on
+    8 virtual CPU shards in a subprocess (the mesh_serving discipline —
+    the backend device count is fixed at init). Gates: 2-D-shard scores
+    AND reason codes bitwise vs the single-device wide flush at 2x2/4x2/
+    2x4, steady-state staging allocations 0, the wide-vs-narrow cost
+    ratio above the documented CPU floor, and monotone-within-slack
+    model-axis scaling (see fraud_detection_tpu/mesh/widebench.py)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    r = subprocess.run(
+        [sys.executable, "-m", "fraud_detection_tpu.mesh.widebench"],
+        capture_output=True, text=True, timeout=270, env=env,
+    )
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+        raise RuntimeError(f"wide probe rc={r.returncode}: {tail[0][:160]}")
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError("wide probe printed no JSON")
 
 
 def bench_telemetry(x, coef, intercept, mean, scale) -> dict[str, float]:
@@ -2641,6 +2672,29 @@ def main() -> None:
                 mesh_res.get("mesh_quant_parity_ok", False)
             ),
             mesh_scaling_monotone=bool(mesh_res["mesh_scaling_monotone"]),
+        )
+    wf_res = h.section("wide_flush", bench_wide_flush)
+    if wf_res:
+        h.update(
+            # the broadside acceptance bars: the 2-D (data x model) wide
+            # flush bitwise-matches the single-device wide flush (scores
+            # AND top-k reason codes), staging stays zero-alloc, the
+            # wide-vs-narrow cost ratio holds the documented CPU floor,
+            # and the model axis scales monotone-within-slack.
+            wide_parity_ok=bool(wf_res["wide_parity_ok"]),
+            wide_staging_steady_allocations=wf_res[
+                "wide_staging_steady_allocations"
+            ],
+            wide_cost_ratio=wf_res["wide_cost_ratio"],
+            wide_cost_ok=bool(wf_res["wide_cost_ok"]),
+            wide_model_axis_flushes_per_sec=wf_res[
+                "wide_model_axis_flushes_per_sec"
+            ],
+            wide_model_shard_bytes=wf_res["wide_model_shard_bytes"],
+            wide_model_shards_exact=bool(wf_res["wide_model_shards_exact"]),
+            wide_model_ratio=wf_res["wide_model_ratio"],
+            wide_model_ratio_ok=bool(wf_res["wide_model_ratio_ok"]),
+            wide_flushes_per_sec=wf_res["wide_flushes_per_sec"],
         )
     tel_res = h.section("telemetry", bench_telemetry, x, coef, intercept,
                         mean, scale)

@@ -31,9 +31,19 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from fraud_detection_tpu.parallel.mesh import DATA_AXIS, MeshSpec, create_mesh
+from fraud_detection_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    MeshSpec,
+    create_mesh,
+)
 
 DEFAULT_MESH_SIZES = (1, 2, 8)
+
+#: 2-D (data × model) factorizations the broadside entrypoints are proven
+#: on — non-trivial model axes up to the 8 virtual devices, including both
+#: orientations of the full grid.
+WIDE_MESH_SHAPES = ((1, 1), (2, 2), (4, 2), (2, 4))
 
 #: batch row count used by the abstract inputs — divisible by every mesh
 #: size under test (and by the SGD batch below at every size).
@@ -119,19 +129,30 @@ def _out_summary(out) -> str:
     ) + ("..." if len(leaves) > 8 else "")
 
 
-def verify_entrypoint(ep: Entrypoint, sizes: Iterable[int] | None = None) -> list[dict]:
+def verify_entrypoint(ep: Entrypoint, sizes: Iterable | None = None) -> list[dict]:
     results = []
     for size in sizes if sizes is not None else ep.mesh_sizes:
-        res = {"entrypoint": ep.name, "mesh_size": size, "ok": False,
+        # a mesh size is an int (1-D data mesh, the historical contract)
+        # or a (data, model) tuple — the broadside 2-D factorizations
+        if isinstance(size, tuple):
+            d_ax, m_ax = size
+            label: int | str = f"{d_ax}x{m_ax}"
+        else:
+            d_ax, m_ax = size, 1
+            label = size
+        total = d_ax * m_ax
+        res = {"entrypoint": ep.name, "mesh_size": label, "ok": False,
                "error": None, "out": None}
         try:
             devices = jax.devices()
-            if len(devices) < size:
+            if len(devices) < total:
                 raise RuntimeError(
-                    f"need {size} devices, have {len(devices)} — run under "
+                    f"need {total} devices, have {len(devices)} — run under "
                     "XLA_FLAGS=--xla_force_host_platform_device_count=8"
                 )
-            mesh = create_mesh(MeshSpec(data=size), devices=devices[:size])
+            mesh = create_mesh(
+                MeshSpec(data=d_ax, model=m_ax), devices=devices[:total]
+            )
             fn, args = ep.build(mesh)
             _check_sharding(args, mesh)
             out = jax.eval_shape(fn, *args)
@@ -813,6 +834,148 @@ def _build_mesh_ledger_flush(mesh: Mesh):
     return fn, (
         window, ledger, x, valid, decay, feature_edges, score_edges,
         score_args, slot_idx, fp, ts, has, null, hl,
+    )
+
+
+_WIDE_LOG2 = 10  # abstract cross-table size (power of two, like production)
+
+
+def _abstract_cross_spec():
+    from fraud_detection_tpu.ops.crosses import CrossSpec
+
+    return CrossSpec(
+        n_base=_FEATURES, log2_buckets=_WIDE_LOG2, amount_col=_FEATURES - 1,
+        time_col=0,
+    )
+
+
+def _wide_abstract_args(mesh: Mesh, lead: tuple[int, ...] = (), spec: P = P()):
+    """Shared abstract inputs of the broadside flush programs: the widened
+    window (base + n_cross contribution columns), base-width rows, the
+    cross-weight table, fingerprints, and the widened score args."""
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import N_CALIB_BINS, DriftWindow
+
+    cross = _abstract_cross_spec()
+    d = cross.n_features
+    window = DriftWindow(
+        feature_counts=sds((*lead, d, N_FEATURE_BINS), jnp.float32, mesh, spec),
+        score_counts=sds((*lead, N_SCORE_BINS), jnp.float32, mesh, spec),
+        calib_count=sds((*lead, N_CALIB_BINS), jnp.float32, mesh, spec),
+        calib_conf=sds((*lead, N_CALIB_BINS), jnp.float32, mesh, spec),
+        calib_label=sds((*lead, N_CALIB_BINS), jnp.float32, mesh, spec),
+        n_rows=sds(lead, jnp.float32, mesh, spec if lead else P()),
+    )
+    row = P(DATA_AXIS)
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, row)
+    valid = sds((_ROWS,), jnp.float32, mesh, row)
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((d, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = (
+        sds((d,), jnp.float32, mesh, P()),
+        sds((), jnp.float32, mesh, P()),
+    )
+    fp = sds((_ROWS,), jnp.uint32, mesh, row)
+    has = sds((_ROWS,), jnp.float32, mesh, row)
+    return cross, window, x, valid, decay, feature_edges, score_edges, \
+        score_args, fp, has
+
+
+@register_entrypoint("broadside.flush")
+def _build_broadside_flush(mesh: Mesh):
+    """The wide-family fused flush (broadside): hashed cross indices,
+    table gather, widened-block scoring, top-k reason codes AND the drift
+    fold in ONE donated dispatch — the serving hot path for a wide
+    champion, proven at every mesh size like the other fused programs."""
+    from fraud_detection_tpu.monitor.drift import _fused_flush_wide
+
+    (cross, window, x, valid, decay, feature_edges, score_edges,
+     score_args, fp, has) = _wide_abstract_args(mesh)
+    table = sds((cross.buckets,), jnp.float32, mesh, P())
+    explain_args = (
+        sds((cross.n_features,), jnp.float32, mesh, P()),
+        sds((cross.n_features,), jnp.float32, mesh, P()),
+    )
+    fn = lambda w, xx, vv, dd, fe, se, sa, tt, ff, hh, ea: (  # noqa: E731
+        _fused_flush_wide(
+            w, xx, vv, dd, fe, se, sa, tt, ff, hh, None, ea,
+            cross_spec=cross, explain_k=3,
+        )
+    )
+    return fn, (
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        table, fp, has, explain_args,
+    )
+
+
+@register_entrypoint("mesh.broadside_flush", mesh_sizes=WIDE_MESH_SHAPES)
+def _build_mesh_broadside_flush(mesh: Mesh):
+    """The 2-D broadside mesh flush: rows sharded over data, the
+    cross-weight table column-sharded over the MODEL axis (the
+    tensor-parallel score_args leaves the topology always promised),
+    per-(data,model)-shard windows donated through, exactly ONE model-axis
+    psum assembling the widened block. Proven at the non-trivial model
+    factorizations (1×1, 2×2, 4×2, 2×4)."""
+    from fraud_detection_tpu.mesh.shardflush import _sharded_flush_wide
+
+    shape = dict(mesh.shape)
+    n_shards = shape[DATA_AXIS] * shape.get(MODEL_AXIS, 1)
+    grid = P((DATA_AXIS, MODEL_AXIS))
+    (cross, window, x, valid, decay, feature_edges, score_edges,
+     score_args, fp, has) = _wide_abstract_args(mesh, (n_shards,), grid)
+    table = sds((cross.buckets,), jnp.float32, mesh, P(MODEL_AXIS))
+    explain_args = (
+        sds((cross.n_features,), jnp.float32, mesh, P()),
+        sds((cross.n_features,), jnp.float32, mesh, P()),
+    )
+    fn = lambda w, xx, vv, dd, fe, se, sa, tt, ff, hh, ea: (  # noqa: E731
+        _sharded_flush_wide(
+            w, xx, vv, dd, fe, se, sa, tt, ff, hh, None, ea,
+            cross_spec=cross, mesh=mesh, explain_k=3, has_explain=True,
+        )
+    )
+    return fn, (
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        table, fp, has, explain_args,
+    )
+
+
+@register_entrypoint("mesh.wide_update", mesh_sizes=WIDE_MESH_SHAPES)
+def _build_mesh_wide_update(mesh: Mesh):
+    """The 2-D wide-family weight update (2004.13336 in 2-D): the cross
+    table column-owned on the model axis, subdivided with its momentum
+    state over the data axis, grads psum_scatter'd on data, the widened
+    logit assembled with one model-axis psum per step."""
+    from fraud_detection_tpu.mesh.retrain import (
+        WIDE_PARAM_SPEC,
+        _wide_update_epoch,
+    )
+
+    cross = _abstract_cross_spec()
+    batch = 64
+    shard = P(DATA_AXIS)
+    coef = sds((_FEATURES,), jnp.float32, mesh, P())
+    vel = sds((_FEATURES,), jnp.float32, mesh, P())
+    wl = sds((cross.buckets,), jnp.float32, mesh, WIDE_PARAM_SPEC)
+    wvl = sds((cross.buckets,), jnp.float32, mesh, WIDE_PARAM_SPEC)
+    intercept = sds((), jnp.float32, mesh, P())
+    vel_b = sds((), jnp.float32, mesh, P())
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, shard)
+    idx = sds((_ROWS, cross.n_cross), jnp.int32, mesh, shard)
+    per_row = lambda: sds((_ROWS,), jnp.float32, mesh, shard)  # noqa: E731
+    size = dict(mesh.shape)[DATA_AXIS]
+    perm = sds((_ROWS // size,), jnp.int32, mesh, P())
+    lr = sds((), jnp.float32, mesh, P())
+    fn = lambda c, v, w, wv, b, vb, xx, ii, hh, yy, ss, vv, pp, ll: (  # noqa: E731
+        _wide_update_epoch(
+            c, v, w, wv, b, vb, xx, ii, hh, yy, ss, vv, pp, ll,
+            mesh=mesh, c=1.0, n_total=_ROWS, momentum=0.9, batch=batch,
+        )
+    )
+    return fn, (
+        coef, vel, wl, wvl, intercept, vel_b, x, idx, per_row(), per_row(),
+        per_row(), per_row(), perm, lr,
     )
 
 

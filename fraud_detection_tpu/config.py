@@ -380,6 +380,40 @@ def ledger_synth_events_per_entity() -> int:
 
 
 # --------------------------------------------------------------------------
+# Broadside: the tensor-parallel wide family (ops/crosses, mesh 2-D)
+# --------------------------------------------------------------------------
+
+def wide_buckets() -> int:
+    """``WIDE_BUCKETS`` — width of the hashed-cross weight table the wide
+    family learns (power of two; the model axis column-shards it, so it
+    must also divide by ``MESH_MODEL_DEVICES``). Default 2¹⁴ = 16384 —
+    d ~ 10⁴, the scale at which the feature dimension is worth sharding."""
+    return _get_int("WIDE_BUCKETS", 1 << 14)
+
+
+def wide_enabled() -> bool:
+    """``WIDE_ENABLED=1`` — train-side opt-in: train.py / the conductor's
+    retrain fit the WIDE family (hashed feature crosses over the request
+    fields the wire already carries, d = WIDE_BUCKETS) and stamp
+    ``wide_params.npz`` beside the weights. Serving needs no flag: it
+    widens whenever the loaded artifact carries the sidecar. Default
+    off."""
+    return env_flag("WIDE_ENABLED") is True
+
+
+def mesh_model_devices() -> int:
+    """``MESH_MODEL_DEVICES`` — model-axis size of the 2-D serving mesh
+    (the tensor-parallel axis the wide family's cross-weight table
+    column-shards over). 0/1 (default) keeps the 1-D data mesh; with M>1
+    the serving mesh becomes (MESH_FLUSH_DEVICES × M): narrow families
+    row-shard over the flattened grid, the wide family row-shards over
+    data and column-shards its WIDE_BUCKETS table over model with exactly
+    one hot-path ``psum``. Must be a power of two, and data×model must
+    stay within the local device count."""
+    return _get_int("MESH_MODEL_DEVICES", 0)
+
+
+# --------------------------------------------------------------------------
 # Watchtower: online drift & quality monitoring + shadow scoring (monitor/)
 # --------------------------------------------------------------------------
 

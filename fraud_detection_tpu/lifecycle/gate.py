@@ -121,19 +121,38 @@ def _gate_stats(
 
 
 def _slice_stats(
-    champion, challenger, x: np.ndarray, y: np.ndarray
+    champion, challenger, x: np.ndarray, y: np.ndarray,
+    x_champion: np.ndarray | None = None,
 ) -> dict | None:
     """Score both models on one eval slice (two batched device passes) and
     run the fused stats program. None when the slice can't be judged
-    (empty or single-class — AUC undefined)."""
+    (empty or single-class — AUC undefined). ``x_champion`` is the
+    champion's OWN view of the same rows when the two models widen
+    differently (broadside: contribution columns gathered from each
+    model's own cross table) — without it a widened champion would score
+    the CHALLENGER's contributions through its coefficients."""
     y = np.asarray(y).reshape(-1)
     if x.shape[0] == 0 or (y > 0).all() or (y <= 0).all():
         return None
+
+    def view(model, block) -> np.ndarray:
+        # width-aware slice: a WIDENED eval block (broadside — base
+        # columns followed by device-computed cross contributions) judges
+        # a narrow model on its base prefix, so a narrow→wide gate scores
+        # each model exactly as it would serve these rows
+        d = getattr(model.scorer, "n_features", block.shape[1])
+        return np.asarray(
+            block[:, :d] if block.shape[1] > d else block, np.float32
+        )
+
     champ = np.asarray(
-        champion.scorer.predict_proba(np.asarray(x, np.float32)), np.float32
+        champion.scorer.predict_proba(
+            view(champion, x_champion if x_champion is not None else x)
+        ),
+        np.float32,
     ).reshape(-1)
     chall = np.asarray(
-        challenger.scorer.predict_proba(np.asarray(x, np.float32)), np.float32
+        challenger.scorer.predict_proba(view(challenger, x)), np.float32
     ).reshape(-1)
     score_edges = jnp.asarray(
         np.linspace(0.0, 1.0, N_GATE_SCORE_BINS + 1)[1:-1], jnp.float32
@@ -176,14 +195,22 @@ def evaluate_gate(
     x_recent: np.ndarray | None = None,
     y_recent: np.ndarray | None = None,
     thresholds: GateThresholds | None = None,
+    x_holdout_champion: np.ndarray | None = None,
+    x_recent_champion: np.ndarray | None = None,
 ) -> GateResult:
     """Run the full gate: frozen holdout (required) + recent labeled window
-    (judged only when it clears ``min_eval_rows`` and holds both classes)."""
+    (judged only when it clears ``min_eval_rows`` and holds both classes).
+    ``x_holdout_champion``/``x_recent_champion`` are the champion's OWN
+    widened views of the same rows when both models are widened but carry
+    different tables (the broadside wide→wide retrain)."""
     thr = thresholds or GateThresholds.from_config()
     reasons: list[str] = []
     metrics: dict = {}
 
-    hold = _slice_stats(champion, challenger, x_holdout, y_holdout)
+    hold = _slice_stats(
+        champion, challenger, x_holdout, y_holdout,
+        x_champion=x_holdout_champion,
+    )
     if hold is None:
         return GateResult(
             False, ["holdout slice unusable (empty or single-class)"], {}
@@ -205,7 +232,10 @@ def evaluate_gate(
         )
 
     if x_recent is not None and x_recent.shape[0] >= thr.min_eval_rows:
-        recent = _slice_stats(champion, challenger, x_recent, y_recent)
+        recent = _slice_stats(
+            champion, challenger, x_recent, y_recent,
+            x_champion=x_recent_champion,
+        )
         if recent is not None:
             metrics.update({f"recent_{k}": v for k, v in recent.items()})
             if not (

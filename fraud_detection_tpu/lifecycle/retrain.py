@@ -177,7 +177,50 @@ def run_retrain(
     # Interleaved (not chronological) so both halves span the same period.
     ledger_spec = getattr(champion, "ledger_spec", None)
     ledger_state = None
-    if ledger_spec is None:
+    wide_spec = getattr(champion, "wide_spec", None)
+    if wide_spec is None and config.wide_enabled():
+        if ledger_spec is not None:
+            # the two widenings are mutually exclusive by construction
+            # (models/logistic refuses both sidecars) — and a ledger
+            # champion widens to the SAME total width as a cross-widened
+            # block (K == n_cross == 4), so entering the wide path here
+            # would feed cross contributions into the champion's velocity
+            # coefficients at the gate. Keep the ledger retrain.
+            log.warning(
+                "WIDE_ENABLED ignored: the champion is ledger-widened — "
+                "retraining the ledger family instead"
+            )
+        else:
+            # WIDE_ENABLED retrains fit the wide family even under a
+            # narrow champion — the narrow→wide promotion flow: the
+            # challenger's crosses start from a zero table, the warm
+            # start seeds the base slice from the incumbent, and the
+            # gate judges each model at its own width over the same rows
+            from fraud_detection_tpu.ops.crosses import spec_from_config
+
+            wide_spec = spec_from_config(x.shape[1])
+    wide_table = None
+    fps_base = fps_w = fps_r = None
+    if wide_spec is not None:
+        # broadside: the wide challenger retrains on the SAME hashed
+        # crosses serving computes — recorded entities for feedback rows
+        # (the meta fetch rides the same store read as the rows), the
+        # ledger's seeded pseudo-entities for the entity-less base CSV.
+        # The base block itself stays unwidened: the cross contributions
+        # depend on the table being FITTED, so widening happens at gate /
+        # profile time from the learned table.
+        from fraud_detection_tpu.ledger.replay import synthesize_entities
+        from fraud_detection_tpu.ops.crosses import entity_fingerprints
+
+        fx_w, fs_w, fy_w, fe_w, ft_w = store.window_rows_meta()
+        fx_r, fs_r, fy_r, fe_r, ft_r = store.reservoir_rows_meta()
+        ents_b, _ = synthesize_entities(
+            x, feature_names, seed, config.ledger_synth_events_per_entity()
+        )
+        fps_base = entity_fingerprints(ents_b, x.shape[0])
+        fps_w = entity_fingerprints(fe_w, fx_w.shape[0])
+        fps_r = entity_fingerprints(fe_r, fx_r.shape[0])
+    elif ledger_spec is None:
         fx_w, fs_w, fy_w = store.window_rows()
         fx_r, fs_r, fy_r = store.reservoir_rows()
     else:
@@ -200,6 +243,17 @@ def run_retrain(
     x_hold, y_hold = x[test_idx], y[test_idx]
     fx_train, fy_train = fx_w[0::2], fy_w[0::2]
     fx_eval, fy_eval = fx_w[1::2], fy_w[1::2]
+    fps_fit = fps_hold = fps_eval = None
+    x_hold_champ = fx_eval_champ = None
+    if wide_spec is not None:
+        fps_hold = fps_base[test_idx]
+        fps_eval = fps_w[1::2]
+        fps_fit = np.concatenate(
+            [
+                a for a in (fps_base[train_idx], fps_w[0::2], fps_r)
+                if a.size
+            ]
+        ).astype(np.uint32)
     replay_x = [a for a in (fx_train, fx_r) if a.size]
     replay_y = [a for a in (fy_train, fy_r) if a.size]
     n_replay = int(sum(a.shape[0] for a in replay_x))
@@ -261,8 +315,14 @@ def run_retrain(
         # ---- scaler on the train side only, then the sharded DP fit
         scaler = scaler_fit(x_fit)
         xs_fit = scaler_transform(scaler, x_fit)
-        ws = warm_start_from(champion, scaler)
+        ws = None if wide_spec is not None else warm_start_from(champion, scaler)
         x_final, y_final = xs_fit, y_fit
+        if use_smote and wide_spec is not None:
+            # SMOTE interpolates feature rows; a synthetic row carries no
+            # hashable entity/cross identity, so the wide fit trains on
+            # the class-weighted raw mix instead
+            use_smote = False
+            run.set_tag("smote_skipped", "wide family: crosses are discrete")
         if use_smote:
             try:
                 x_final, y_final = smote(
@@ -273,7 +333,85 @@ def run_retrain(
                 # the raw mix rather than failing the whole loop closure
                 log.warning("retrain SMOTE skipped: %s", e)
                 run.set_tag("smote_skipped", str(e))
-        if config.mesh_retrain():
+        wide_names = None
+        wide_scaler = None
+        if wide_spec is not None:
+            # broadside: the 2-D (data × model) sharded wide fit
+            # (mesh/retrain.wide_sgd_fit, 2004.13336 extended to the
+            # tensor-parallel mesh) — grads psum_scatter on the data axis,
+            # the cross-weight table column-owned on the model axis. The
+            # warm start crosses scaler spaces on the BASE slice; the
+            # champion's table warm-starts verbatim (cross contributions
+            # are raw-space, no scaler touches them).
+            from fraud_detection_tpu.mesh.retrain import (
+                wide_sgd_fit,
+                wide_training_mesh,
+            )
+            from fraud_detection_tpu.ops.crosses import cross_indices
+
+            ws_base = None
+            champ_params = getattr(champion, "params", None)
+            if isinstance(champ_params, LogisticParams):
+                # the warm_start_from discipline on the BASE slice: a
+                # champion without linear params (GBT) cold-starts
+                folded = fold_scaler_into_linear(
+                    champ_params, getattr(champion, "scaler", None)
+                )
+                w_raw = np.asarray(folded.coef, np.float32)[: wide_spec.n_base]
+                sc_v = np.asarray(scaler.scale, np.float32)
+                mu_v = np.asarray(scaler.mean, np.float32)
+                ws_base = LogisticParams(
+                    coef=w_raw * sc_v,
+                    intercept=(
+                        np.float32(folded.intercept) + np.dot(mu_v, w_raw)
+                    ),
+                )
+            # indices hash the RAW rows — the values serving hashes
+            idx_fit = cross_indices(x_fit, fps_fit, wide_spec)
+            has_fit = (fps_fit != 0).astype(np.float32)
+            params, wide_table = wide_sgd_fit(
+                x_final, idx_fit, has_fit, y_final, wide_spec,
+                epochs=max(max_iter // 10, 5), seed=seed,
+                class_weight="balanced",
+                warm_start=(ws_base, getattr(champion, "wide_table", None)),
+                mesh=wide_training_mesh(),
+            )
+            from fraud_detection_tpu.ops.crosses import (
+                widen_scaler,
+                widen_with_crosses,
+            )
+
+            wide_names = list(feature_names) + list(wide_spec.cross_names)
+            wide_scaler = widen_scaler(scaler, wide_spec.n_cross)
+            challenger = FraudLogisticModel(
+                params, wide_scaler, wide_names,
+                wide_spec=wide_spec, wide_table=wide_table,
+            )
+            # the gate judges WIDENED slices — the same widened block the
+            # fused flush materializes for these rows, so the gate's AUC
+            # measures each model as it would actually serve: the
+            # challenger's block gathers from ITS freshly fitted table,
+            # and a wide CHAMPION gets its OWN view from its own table
+            # (feeding it the challenger's contributions would mis-score
+            # the incumbent and bias every wide→wide promotion)
+            champ_table = getattr(champion, "wide_table", None)
+            if champ_table is not None:
+                x_hold_champ = widen_with_crosses(
+                    x_hold, fps_hold, champ_table, champion.wide_spec
+                )
+                fx_eval_champ = (
+                    widen_with_crosses(
+                        fx_eval, fps_eval, champ_table, champion.wide_spec
+                    )
+                    if fx_eval.size
+                    else None
+                )
+            x_hold = widen_with_crosses(x_hold, fps_hold, wide_table, wide_spec)
+            if fx_eval.size:
+                fx_eval = widen_with_crosses(
+                    fx_eval, fps_eval, wide_table, wide_spec
+                )
+        elif config.mesh_retrain():
             # MESH_RETRAIN=1: the warm-started update itself shards across
             # the mesh — each replica owns 1/N of the params and optimizer
             # state (2004.13336) instead of replicating the full update
@@ -288,10 +426,11 @@ def run_retrain(
                 x_final, y_final, max_iter=max_iter, sharded=True,
                 warm_start=ws,
             )
-        challenger = FraudLogisticModel(
-            params, scaler, list(feature_names),
-            ledger_spec=ledger_spec, ledger_state=ledger_state,
-        )
+        if wide_spec is None:
+            challenger = FraudLogisticModel(
+                params, scaler, list(feature_names),
+                ledger_spec=ledger_spec, ledger_state=ledger_state,
+            )
 
         # ---- the challenger gate: frozen holdout + recent labeled window
         gate = evaluate_gate(
@@ -302,6 +441,8 @@ def run_retrain(
             x_recent=fx_eval if fx_eval.size else None,
             y_recent=fy_eval if fy_eval.size else None,
             thresholds=thresholds,
+            x_holdout_champion=x_hold_champ,
+            x_recent_champion=fx_eval_champ,
         )
         for k, v in gate.metrics.items():
             run.log_metric(k, float(v))
@@ -312,7 +453,13 @@ def run_retrain(
         # ---- artifacts: model + drift baseline beside it (every resolution
         # path carries its own monitor profile, train.py contract)
         artifact_dir = run.artifact_path("model")
-        save_artifacts(artifact_dir, params, scaler, list(feature_names))
+        if wide_spec is not None:
+            from fraud_detection_tpu.ops.crosses import save_wide
+
+            save_artifacts(artifact_dir, params, wide_scaler, wide_names)
+            save_wide(artifact_dir, wide_spec, wide_table)
+        else:
+            save_artifacts(artifact_dir, params, scaler, list(feature_names))
         if ledger_spec is not None:
             # stamp the replayed entity table beside the challenger: a
             # promotion hot-swaps the model AND its table snapshot, so
@@ -324,13 +471,31 @@ def run_retrain(
             # quickwire: stamp the int8 wire calibration beside the
             # challenger's weights — a promotion hot-swaps BOTH, so the
             # serving quantizer always matches the scored model
-            save_calibration(artifact_dir, derive_calibration(scaler))
+            save_calibration(
+                artifact_dir,
+                derive_calibration(
+                    wide_scaler if wide_spec is not None else scaler
+                ),
+            )
         hold_scores = np.asarray(
             challenger.scorer.predict_proba(np.asarray(x_hold, np.float32))
         )
-        profile = build_baseline_profile(
-            x_fit, hold_scores, feature_names=list(feature_names)
-        )
+        if wide_spec is not None:
+            # the drift baseline covers the WIDENED block (base + cross
+            # contributions) — the distribution the fused wide flush
+            # bins. Reuses the fit's cross indices: rehashing x_fit here
+            # would duplicate a full-dataset device pass
+            contrib_fit = wide_table[idx_fit] * has_fit[:, None]
+            profile = build_baseline_profile(
+                np.concatenate([x_fit, contrib_fit], axis=1).astype(
+                    np.float32
+                ),
+                hold_scores, feature_names=wide_names,
+            )
+        else:
+            profile = build_baseline_profile(
+                x_fit, hold_scores, feature_names=list(feature_names)
+            )
         save_profile(artifact_dir, profile)
 
         wall = time.time() - t0

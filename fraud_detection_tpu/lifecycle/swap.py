@@ -87,6 +87,7 @@ def warm_fused_ladder(
     max_batch: int | None = None,
     explain_k: int | None = None,
     return_wire: str | None = None,
+    drift=None,
 ) -> None:
     """Pre-compile the FUSED flush executables for a freshly loaded model
     before it swaps in. Same-family promotions hit the jit cache (the
@@ -97,15 +98,21 @@ def warm_fused_ladder(
     compile under live traffic. Warms the exact executables serving will
     dispatch: the configured return wire, and the fused explain leg when
     SCORER_EXPLAIN=topk. No-op when no fused target exists (no watchtower
-    / no drift monitor / no fused spec). Runs under expected_compiles —
-    a promotion's ladder is not a RecompileStorm."""
+    / no drift monitor / no fused spec). ``drift`` overrides the monitor
+    the warm drives through — a CROSS-WIDTH promotion (narrow → wide /
+    ledger, broadside) changes the drift window's feature width, so the
+    warm must trace against a monitor built from the NEW champion's
+    profile (the jit cache is global: the executables warmed here are
+    exactly the ones the post-rebind monitor dispatches). Runs under
+    expected_compiles — a promotion's ladder is not a RecompileStorm."""
     from fraud_detection_tpu.ops import scorer as scorer_mod
     from fraud_detection_tpu.ops.scorer import _bucket
     from fraud_detection_tpu.telemetry.compile_sentinel import (
         expected_compiles,
     )
 
-    drift = getattr(watchtower, "drift", None)
+    if drift is None:
+        drift = getattr(watchtower, "drift", None)
     if drift is None or not hasattr(drift, "warm_fused"):
         return
     spec = getattr(scorer, "fused_spec", lambda: None)()
@@ -211,24 +218,46 @@ class ModelReloader:
         art = registry.artifact_dir(name, version)
         model = load_any_model(art)
         old = self.slot.model
-        if old is not None and list(model.feature_names) != list(
-            old.feature_names
-        ):
+        if old is not None and list(
+            getattr(model, "base_feature_names", model.feature_names)
+        ) != list(getattr(old, "base_feature_names", old.feature_names)):
+            # the hot-swap safety condition is the WIRE schema (what
+            # clients send): a widened family (broadside crosses, ledger
+            # velocity columns) extends feature_names with device-computed
+            # columns but keeps the base schema — narrow ↔ wide promotions
+            # are exactly the conductor's broadside flow and must hot-swap
             raise ValueError(
-                f"v{version} feature schema differs from the served model — "
+                f"v{version} wire schema differs from the served model — "
                 "refusing to hot-swap (deploy instead)"
             )
         warm_scorer(model.scorer, self.max_batch)  # compile BEFORE the swap
-        if self.watchtower is not None:
-            # cross-family promotions (evergreen: linear ↔ GBT) bind a new
-            # fused program — warm its flush/explain executables BEFORE
-            # the swap so the first post-swap flush is a cache hit
-            warm_fused_ladder(self.watchtower, model.scorer, self.max_batch)
-        source = f"registry:models:/{name}@{stage}"
-        self.slot.swap(model, source, version)
+        profile = None
         if self.watchtower is not None:
             from fraud_detection_tpu.monitor.baseline import load_profile
 
+            profile = load_profile(art)
+            # cross-family promotions (evergreen: linear ↔ GBT) bind a new
+            # fused program — warm its flush/explain executables BEFORE
+            # the swap so the first post-swap flush is a cache hit. A
+            # CROSS-WIDTH promotion (narrow → wide/ledger) additionally
+            # changes the drift window's feature width: warm against a
+            # monitor built from the NEW champion's profile — the same
+            # executables the post-rebind monitor dispatches.
+            drift_override = None
+            old_width = len(old.feature_names) if old is not None else None
+            if (
+                profile is not None
+                and old_width is not None
+                and len(model.feature_names) != old_width
+            ):
+                drift_override = self.watchtower._make_drift(profile)
+            warm_fused_ladder(
+                self.watchtower, model.scorer, self.max_batch,
+                drift=drift_override,
+            )
+        source = f"registry:models:/{name}@{stage}"
+        self.slot.swap(model, source, version)
+        if self.watchtower is not None:
             # ledger: a widened champion's entity table rebinds WITH the
             # model (the stamped snapshot its weights were replayed
             # against) — same zero-recompile discipline as the weights,
@@ -238,7 +267,7 @@ class ModelReloader:
                 if getattr(model, "ledger_spec", None) is not None
                 else None
             )
-            self.watchtower.rebind_champion(load_profile(art), ledger=ledger)
+            self.watchtower.rebind_champion(profile, ledger=ledger)
             # rebind_champion drops the shadow scorer (the old challenger is
             # usually the new champion); force the shadow sweep that runs
             # right after this to re-bind even if the @shadow alias version
@@ -267,11 +296,17 @@ class ModelReloader:
         art = self._registry().artifact_dir(name, version)
         challenger = load_any_model(art)
         served = self.slot.model
-        if served is not None and list(challenger.feature_names) != list(
-            served.feature_names
+        if served is not None and list(
+            getattr(challenger, "base_feature_names", challenger.feature_names)
+        ) != list(
+            getattr(served, "base_feature_names", served.feature_names)
         ):
+            # the WIRE schema is the bind condition: a wide/ledger-widened
+            # challenger shadowing a narrow champion (the broadside
+            # promotion flow) scores the same base rows through its null
+            # path — only a genuine schema change refuses
             log.warning(
-                "shadow v%s feature schema mismatch — not binding", version
+                "shadow v%s wire schema mismatch — not binding", version
             )
             self._shadow_version = version  # terminal for this version
             return "schema mismatch"

@@ -39,7 +39,13 @@ from fraud_detection_tpu.ops.logistic import (
     _resolve_sample_weight,
 )
 from fraud_detection_tpu.parallel.compat import shard_map
-from fraud_detection_tpu.parallel.mesh import DATA_AXIS, default_mesh
+from fraud_detection_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    MeshSpec,
+    create_mesh,
+    default_mesh,
+)
 from fraud_detection_tpu.parallel.sharding import (
     pad_to_multiple,
     shard_batch,
@@ -217,6 +223,272 @@ def mesh_sgd_fit(
         coef=jnp.asarray(np.asarray(params.coef)[:d]),
         intercept=params.intercept,
     )
+
+
+# --------------------------------------------------------------------------
+# Broadside: the 2-D (data × model) wide-family update (2004.13336 in 2-D)
+# --------------------------------------------------------------------------
+
+
+def wide_training_mesh(model_devices: int | None = None):
+    """The 2-D retrain mesh for the wide family: all local devices,
+    ``MESH_MODEL_DEVICES`` (or the override) on the model axis, the rest
+    on data. Falls back to a pure data mesh when the model knob is off —
+    the 1×1-model degenerate case is still the same program."""
+    from fraud_detection_tpu import config
+
+    m = model_devices if model_devices is not None else (
+        config.mesh_model_devices() or 1
+    )
+    m = max(int(m), 1)
+    n_dev = jax.device_count()
+    if n_dev % m:
+        raise ValueError(
+            f"MESH_MODEL_DEVICES={m} does not divide the {n_dev} local "
+            "devices"
+        )
+    return create_mesh(MeshSpec(data=n_dev // m, model=m))
+
+
+#: w_wide layout on the 2-D mesh: the MODEL axis owns contiguous column
+#: blocks (buckets/M each — the serving flush's column sharding), and the
+#: DATA axis subdivides each block so the optimizer state is O(P/(D·M))
+#: per device (2004.13336 extended to 2-D).
+WIDE_PARAM_SPEC = P((MODEL_AXIS, DATA_AXIS))
+
+
+def _wide_update_body(c: float, n_total: int, momentum: float, batch: int):
+    """Per-(data,model)-shard epoch for the wide family under shard_map.
+
+    Each step: the DATA axis ``all_gather``s the model group's column
+    slice of w_wide just-in-time for the forward (each data shard owns
+    1/D of its column block — params AND momentum state stay sharded);
+    the forward's widened logit assembles with ONE ``psum`` over the
+    MODEL axis (the serving flush's partial-dot idiom); the wide gradient
+    is ``psum_scatter``'d over the DATA axis straight onto the owning
+    slices — reduce + reshard in one hop, no model-axis gradient
+    collective at all, because each model group's columns receive
+    gradient only from its own cross indices. The 30-float base params
+    stay replicated (sharding them buys nothing; the WIDE table is the
+    O(P/N) article)."""
+
+    def epoch(coef, vel, wl, wvl, intercept, vel_b,
+              x_local, idx_local, has_local, y_pm_local, sw_local,
+              valid_local, perm, lr):
+        n_batches = x_local.shape[0] // batch
+
+        def body(carry, i):
+            coef, vel, wl, wvl, b, vel_b = carry
+            # the model group's full column block, gathered over data
+            w_col = jax.lax.all_gather(wl, DATA_AXIS, axis=0, tiled=True)
+            n_col = w_col.shape[0]
+            lo = (jax.lax.axis_index(MODEL_AXIS) * n_col).astype(jnp.int32)
+            sel = jax.lax.dynamic_slice_in_dim(perm, i * batch, batch)
+            xb = x_local[sel]
+            ib = idx_local[sel]
+            hb = has_local[sel]
+            yb = y_pm_local[sel]
+            swb = sw_local[sel]
+            b_valid = jnp.maximum(
+                jax.lax.psum(jnp.sum(valid_local[sel]), DATA_AXIS), 1.0
+            )
+            rel = ib - lo
+            inb = (rel >= 0) & (rel < n_col)
+            gathered = jnp.where(
+                inb, w_col[jnp.clip(rel, 0, n_col - 1)], 0.0
+            ) * hb[:, None]
+            # THE model-axis collective: assemble the widened logit
+            z_wide = jax.lax.psum(jnp.sum(gathered, axis=1), MODEL_AXIS)
+            z = xb @ coef + z_wide + b
+            # logistic gradient wrt z, 1/n-scaled sklearn primal like
+            # logistic_fit_sgd (manual — differentiating through the psum
+            # would double-count the model axis)
+            g = swb * (-yb) * jax.nn.sigmoid(-yb * z) * (c / b_valid)
+            gw = jax.lax.psum(xb.T @ g, DATA_AXIS) + coef / n_total
+            gb = jax.lax.psum(jnp.sum(g), DATA_AXIS)
+            # wide grads: scatter this shard's rows onto the column block,
+            # then reduce+reshard over data in ONE psum_scatter hop
+            vals = jnp.where(inb, (g * hb)[:, None], 0.0)
+            g_col = jnp.zeros((n_col,), jnp.float32).at[
+                jnp.clip(rel, 0, n_col - 1).ravel()
+            ].add(vals.ravel())
+            g_loc = jax.lax.psum_scatter(
+                g_col, DATA_AXIS, scatter_dimension=0, tiled=True
+            )
+            g_loc = g_loc + wl / n_total
+            vel = momentum * vel - lr * gw
+            coef = coef + vel
+            wvl_n = momentum * wvl - lr * g_loc
+            wl_n = wl + wvl_n
+            vel_b = momentum * vel_b - lr * gb
+            b = b + vel_b
+            return (coef, vel, wl_n, wvl_n, b, vel_b), None
+
+        (coef, vel, wl, wvl, intercept, vel_b), _ = jax.lax.scan(
+            body, (coef, vel, wl, wvl, intercept, vel_b),
+            jnp.arange(n_batches),
+        )
+        return coef, vel, wl, wvl, intercept, vel_b
+
+    return epoch
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "c", "n_total", "momentum", "batch"),
+    donate_argnums=(0, 1, 2, 3),
+)
+def _wide_update_epoch(
+    coef,      # (d_base,) replicated base coef
+    vel,       # (d_base,) replicated base momentum
+    wl,        # (buckets,) wide table, sharded (model-major, data-minor)
+    wvl,       # (buckets,) wide momentum, sharded to match
+    intercept,  # () replicated
+    vel_b,      # () replicated
+    x,         # (n, d_base) row-sharded over data (replicated over model)
+    idx,       # (n, n_cross) int32 cross indices, row-sharded over data
+    has,       # (n,) f32 has-entity mask, row-sharded over data
+    y_pm,      # (n,) ±1 labels, row-sharded over data
+    sw,        # (n,) sample weights (0 on padding), row-sharded over data
+    valid,     # (n,) row validity, row-sharded over data
+    perm,      # (n_local,) per-shard minibatch permutation, replicated
+    lr,        # () replicated
+    *,
+    mesh,
+    c: float,
+    n_total: int,
+    momentum: float,
+    batch: int,
+):
+    """One epoch of the 2-D wide-family update: grads ``psum_scatter`` on
+    the data axis, params already column-owned on the model axis
+    (2004.13336 extended to the tensor-parallel mesh). Registered in
+    meshcheck (``mesh.wide_update``) and the compile sentinel."""
+    mapped = shard_map(
+        _wide_update_body(c, n_total, momentum, batch),
+        mesh=mesh,
+        in_specs=(
+            P(), P(), WIDE_PARAM_SPEC, WIDE_PARAM_SPEC, P(), P(),
+            P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            P(DATA_AXIS), P(DATA_AXIS), P(), P(),
+        ),
+        out_specs=(
+            P(), P(), WIDE_PARAM_SPEC, WIDE_PARAM_SPEC, P(), P(),
+        ),
+        check_vma=False,
+    )
+    return mapped(
+        coef, vel, wl, wvl, intercept, vel_b,
+        x, idx, has, y_pm, sw, valid, perm, lr,
+    )
+
+
+def wide_sgd_fit(
+    x,
+    idx,
+    has,
+    y,
+    cross_spec,
+    c: float = 1.0,
+    epochs: int = 5,
+    batch_size: int = 4096,
+    lr: float = 0.3,
+    momentum: float = 0.9,
+    class_weight: dict | str | None = None,
+    sample_weight=None,
+    seed: int = 0,
+    mesh=None,
+    warm_start: tuple | None = None,
+) -> tuple[LogisticParams, np.ndarray]:
+    """Fit the wide family on the 2-D (data × model) mesh.
+
+    ``x`` is the (scaled) base block, ``idx`` the per-row hashed cross
+    indices (``ops/crosses.cross_indices`` over the RAW rows — the values
+    serving hashes), ``has`` the has-entity mask. ``warm_start`` is the
+    champion's ``(base LogisticParams in this scaler's space, wide
+    table)`` pair. Returns ``(widened LogisticParams, wide table)``: the
+    widened coef is the base coef followed by one 1.0 per cross template
+    (the contribution columns enter the logit with unit weight — the
+    learned mass lives in the table), exactly the parametrization the
+    fused wide flush scores."""
+    mesh = mesh or wide_training_mesh()
+    shape = dict(mesh.shape)
+    n_data = int(shape[DATA_AXIS])
+    n_model = int(shape.get(MODEL_AXIS, 1))
+    buckets = cross_spec.buckets
+    if buckets % (n_data * n_model):
+        raise ValueError(
+            f"WIDE_BUCKETS={buckets} does not shard over the "
+            f"{n_data}×{n_model} mesh"
+        )
+    x_np = np.asarray(x, np.float32)
+    idx_np = np.asarray(idx, np.int32)
+    has_np = np.asarray(has, np.float32)
+    y_np = np.asarray(y)
+    n, d = x_np.shape
+    sw = _resolve_sample_weight(y_np, sample_weight, class_weight)
+    batch_size = _cap_batch_size(n, n_data, batch_size)
+
+    mult = n_data * batch_size
+    x_pad, _ = pad_to_multiple(x_np, mult)
+    idx_pad, _ = pad_to_multiple(idx_np, mult)
+    has_pad, _ = pad_to_multiple(has_np, mult)
+    y_pad, _ = pad_to_multiple(y_np, mult)
+    sw_pad, _ = pad_to_multiple(sw, mult)
+    valid = np.zeros((x_pad.shape[0],), np.float32)
+    valid[:n] = 1.0
+    y_pm = np.where(y_pad > 0, 1.0, -1.0).astype(np.float32)
+
+    row_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    put = lambda a: jax.device_put(a, row_sharding)  # noqa: E731
+    wide_sharding = NamedSharding(mesh, WIDE_PARAM_SPEC)
+
+    coef0 = np.zeros((d,), np.float32)
+    table0 = np.zeros((buckets,), np.float32)
+    b0 = np.float32(0.0)
+    if warm_start is not None:
+        base_params, warm_table = warm_start
+        if base_params is not None:
+            coef0[:] = np.asarray(base_params.coef, np.float32)[:d]
+            b0 = np.float32(base_params.intercept)
+        if warm_table is not None:
+            table0[:] = np.asarray(warm_table, np.float32)
+    coef = jnp.asarray(coef0)
+    vel = jnp.zeros_like(coef)
+    wl = jax.device_put(table0, wide_sharding)
+    wvl = jax.device_put(np.zeros((buckets,), np.float32), wide_sharding)
+    intercept = jnp.float32(b0)
+    vel_b = jnp.float32(0.0)
+
+    x_dev = put(x_pad)
+    idx_dev = put(idx_pad)
+    has_dev = put(has_pad)
+    y_dev = put(y_pm)
+    sw_dev = put(sw_pad)
+    valid_dev = put(valid)
+
+    n_local = x_pad.shape[0] // n_data
+    rng = np.random.default_rng(seed)
+    for e in range(epochs):
+        lr_e = jnp.float32(
+            lr * 0.5 * (1.0 + np.cos(np.pi * e / max(epochs, 1)))
+        )
+        coef, vel, wl, wvl, intercept, vel_b = _wide_update_epoch(
+            coef, vel, wl, wvl, intercept, vel_b,
+            x_dev, idx_dev, has_dev, y_dev, sw_dev, valid_dev,
+            jnp.asarray(rng.permutation(n_local)), lr_e,
+            mesh=mesh, c=float(c), n_total=int(n),
+            momentum=float(momentum), batch=int(batch_size),
+        )
+    base_coef = np.asarray(jax.device_get(coef), np.float32)
+    table = np.asarray(jax.device_get(wl), np.float32)
+    widened = np.concatenate(
+        [base_coef, np.ones(cross_spec.n_cross, np.float32)]
+    )
+    params = LogisticParams(
+        coef=jnp.asarray(widened), intercept=jnp.asarray(jax.device_get(intercept)),
+    )
+    return params, table
 
 
 # --------------------------------------------------------------------------

@@ -46,7 +46,36 @@ from fraud_detection_tpu.monitor.drift import (
     _topk_attributions,
 )
 from fraud_detection_tpu.parallel.compat import shard_map
-from fraud_detection_tpu.parallel.mesh import DATA_AXIS
+from fraud_detection_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+#: Row-type inputs (staged rows, validity, per-shard windows/sub-tables)
+#: shard over the FLATTENED (data × model) grid: on the historical 1-D
+#: mesh the model axis is 1 and this is exactly the old ``P(data)``
+#: layout; with MESH_MODEL_DEVICES>1 every device still receives a row
+#: block, so narrow families keep full data parallelism on the 2-D mesh.
+#: Only the WIDE program (``_sharded_flush_wide``) row-shards over data
+#: alone — its rows must be replicated over the model axis so each model
+#: shard can contribute its column slice of the cross-weight table.
+ROW_SPEC = P((DATA_AXIS, MODEL_AXIS))
+
+
+def _canonical_row_spec(mesh) -> P:
+    """The NORMALIZED form of :data:`ROW_SPEC` on this mesh: shard_map
+    drops size-1 axes from its output shardings, so donated state seeded
+    by ``device_put`` must use the same normalized spec — otherwise the
+    first flush of every bucket sees a different arg sharding than steady
+    state and the executable compiles twice (the sentinel-exactness tests
+    would catch the duplicate)."""
+    shape = dict(mesh.shape)
+    axes = tuple(
+        ax for ax in (DATA_AXIS, MODEL_AXIS) if int(shape.get(ax, 1)) > 1
+    )
+    if not axes:
+        return P()
+    # a single surviving axis must be the BARE name, not a 1-tuple:
+    # PartitionSpec(('data',)) != PartitionSpec('data') for sharding
+    # equality even though they partition identically
+    return P(axes if len(axes) > 1 else axes[0])
 
 
 def init_sharded_window(
@@ -61,7 +90,9 @@ def init_sharded_window(
     leading ``(n_shards,)`` axis, laid out over the mesh's data axis when a
     mesh is given (so donation keeps each shard's slice on its device)."""
     sharding = (
-        NamedSharding(mesh, P(DATA_AXIS)) if mesh is not None else None
+        NamedSharding(mesh, _canonical_row_spec(mesh))
+        if mesh is not None
+        else None
     )
 
     def z(*shape):
@@ -181,15 +212,15 @@ def _sharded_flush(
         partial(_shard_body, score_fn=score_fn, out_dtype=out_dtype),
         mesh=mesh,
         in_specs=(
-            P(DATA_AXIS),  # window: shard axis
-            P(DATA_AXIS),  # x: rows
-            P(DATA_AXIS),  # valid: rows
+            ROW_SPEC,      # window: shard axis (flattened grid)
+            ROW_SPEC,      # x: rows
+            ROW_SPEC,      # valid: rows
             P(),           # decay
             P(),           # feature_edges
             P(),           # score_edges
             P(),           # score_args (replicated pytree prefix)
         ),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(ROW_SPEC, ROW_SPEC),
         check_vma=False,
     )
     return mapped(
@@ -233,16 +264,16 @@ def _sharded_flush_quant(
         ),
         mesh=mesh,
         in_specs=(
-            P(DATA_AXIS),  # window: shard axis
-            P(DATA_AXIS),  # x: rows
-            P(DATA_AXIS),  # valid: rows
+            ROW_SPEC,      # window: shard axis (flattened grid)
+            ROW_SPEC,      # x: rows
+            ROW_SPEC,      # valid: rows
             P(),           # decay
             P(),           # feature_edges
             P(),           # score_edges
             P(),           # score_args (replicated pytree prefix)
             P(),           # dequant_scale (replicated)
         ),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(ROW_SPEC, ROW_SPEC),
         check_vma=False,
     )
     return mapped(
@@ -286,16 +317,16 @@ def _sharded_flush_explain(
         ),
         mesh=mesh,
         in_specs=(
-            P(DATA_AXIS),  # window: shard axis
-            P(DATA_AXIS),  # x: rows
-            P(DATA_AXIS),  # valid: rows
+            ROW_SPEC,      # window: shard axis (flattened grid)
+            ROW_SPEC,      # x: rows
+            ROW_SPEC,      # valid: rows
             P(),           # decay
             P(),           # feature_edges
             P(),           # score_edges
             P(),           # score_args (replicated pytree prefix)
             P(),           # explain_args (replicated)
         ),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(ROW_SPEC, ROW_SPEC, ROW_SPEC, ROW_SPEC),
         check_vma=False,
     )
     return mapped(
@@ -342,9 +373,9 @@ def _sharded_flush_quant_explain(
         ),
         mesh=mesh,
         in_specs=(
-            P(DATA_AXIS),  # window: shard axis
-            P(DATA_AXIS),  # x: rows
-            P(DATA_AXIS),  # valid: rows
+            ROW_SPEC,      # window: shard axis (flattened grid)
+            ROW_SPEC,      # x: rows
+            ROW_SPEC,      # valid: rows
             P(),           # decay
             P(),           # feature_edges
             P(),           # score_edges
@@ -352,7 +383,7 @@ def _sharded_flush_quant_explain(
             P(),           # dequant_scale (replicated)
             P(),           # explain_args (replicated)
         ),
-        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(ROW_SPEC, ROW_SPEC, ROW_SPEC, ROW_SPEC),
         check_vma=False,
     )
     return mapped(
@@ -372,7 +403,11 @@ def init_sharded_ledger(n_shards: int, state, slots: int, mesh=None):
     from fraud_detection_tpu.ledger.state import LedgerState, init_state
 
     base = state if state is not None else init_state(slots)
-    sharding = NamedSharding(mesh, P(DATA_AXIS)) if mesh is not None else None
+    sharding = (
+        NamedSharding(mesh, _canonical_row_spec(mesh))
+        if mesh is not None
+        else None
+    )
     slot_shard = np.arange(slots) % n_shards
 
     def split(leaf, owner_split: bool):
@@ -484,27 +519,25 @@ def _sharded_flush_ledger(
     compile sentinel. ``has_dequant``/``has_explain`` are static so the
     in_specs tuple matches the (pytree-None) optional params."""
     in_specs = [
-        P(DATA_AXIS),  # window: shard axis
-        P(DATA_AXIS),  # ledger: shard axis
-        P(DATA_AXIS),  # x: rows
-        P(DATA_AXIS),  # valid: rows
+        ROW_SPEC,      # window: shard axis (flattened grid)
+        ROW_SPEC,      # ledger: shard axis (flattened grid)
+        ROW_SPEC,      # x: rows
+        ROW_SPEC,      # valid: rows
         P(),           # decay
         P(),           # feature_edges
         P(),           # score_edges
         P(),           # score_args (replicated pytree prefix)
-        P(DATA_AXIS),  # slot_idx: rows
-        P(DATA_AXIS),  # fp: rows
-        P(DATA_AXIS),  # ts: rows
-        P(DATA_AXIS),  # has_entity: rows
+        ROW_SPEC,      # slot_idx: rows
+        ROW_SPEC,      # fp: rows
+        ROW_SPEC,      # ts: rows
+        ROW_SPEC,      # has_entity: rows
         P(),           # null_features
         P(),           # halflife_s
         P(),           # dequant_scale (replicated; pytree-None when f32)
         P(),           # explain_args (replicated; pytree-None when off)
     ]
     out_specs = (
-        (P(DATA_AXIS),) * 4 + (P(DATA_AXIS),)
-        if explain_k > 0
-        else (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS))
+        (ROW_SPEC,) * 5 if explain_k > 0 else (ROW_SPEC, ROW_SPEC, ROW_SPEC)
     )
     mapped = shard_map(
         partial(
@@ -523,6 +556,113 @@ def _sharded_flush_ledger(
         window, ledger, x, valid, decay, feature_edges, score_edges,
         score_args, slot_idx, fp, ts, has_entity, null_features, halflife_s,
         dequant_scale, explain_args,
+    )
+
+
+def _wide_shard_body(
+    window, x, valid, decay, feature_edges, score_edges, score_args,
+    wide_local, fp, has_entity, dequant_scale=None, explain_args=None,
+    *, cross_spec, explain_k=0, out_dtype=jnp.float32,
+):
+    """Per-(data,model)-shard broadside body under shard_map: traces the
+    SAME ``drift._wide_serving_body`` expression the single-device program
+    runs, with ``model_axis`` bound — the local column slice of the
+    cross-weight table gathers its in-range buckets and ONE ``psum`` over
+    the model axis assembles the widened block (the only collective on the
+    wide hot path). Rows are replicated over the model axis; the body
+    masks the drift fold to model-rank 0, so the per-shard windows still
+    merge exactly at scrape time."""
+    from fraud_detection_tpu.monitor.drift import _wide_serving_body
+
+    w = jax.tree.map(lambda t: t[0], window)
+    out = _wide_serving_body(
+        w, x, valid, decay, feature_edges, score_edges, score_args,
+        wide_local, fp, has_entity, dequant_scale, explain_args,
+        cross_spec=cross_spec, explain_k=explain_k, out_dtype=out_dtype,
+        model_axis=MODEL_AXIS,
+    )
+    lead = lambda tree: jax.tree.map(lambda t: t[None], tree)  # noqa: E731
+    if explain_k > 0:
+        scores, ridx, rval, new_w = out
+        return scores, ridx, rval, lead(new_w)
+    scores, new_w = out
+    return scores, lead(new_w)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cross_spec", "mesh", "explain_k", "out_dtype", "has_dequant",
+        "has_explain",
+    ),
+    donate_argnums=(0,),
+)
+def _sharded_flush_wide(
+    window: DriftWindow,  # per-(data,model)-shard windows, leading axis
+    x: jax.Array,  # (b, n_base) staged bucket, b % n_data == 0
+    valid: jax.Array,  # (b,)
+    decay: jax.Array,  # () global drift forgetting factor
+    feature_edges: jax.Array,  # (n_base + n_cross, bins - 1) widened edges
+    score_edges: jax.Array,
+    score_args,  # (widened raw-space coef, intercept), replicated
+    wide_table: jax.Array,  # (buckets,) column-sharded over the MODEL axis
+    fp: jax.Array,  # (b,) uint32 entity fingerprint, row-sharded over data
+    has_entity: jax.Array,  # (b,) f32
+    dequant_scale=None,  # (n_base,) replicated, int8 wire only
+    explain_args=None,  # replicated lantern params, explain_k > 0 only
+    *,
+    cross_spec,  # static ops/crosses.CrossSpec
+    mesh,
+    explain_k: int = 0,
+    out_dtype=jnp.float32,
+    has_dequant: bool = False,
+    has_explain: bool = False,
+):
+    """The broadside mesh flush: the tensor-parallel wide program as ONE
+    shard_map dispatch over the 2-D (data × model) serving mesh. Rows
+    shard over ``data`` (replicated over ``model``), the ``WIDE_BUCKETS``
+    cross-weight table column-shards over ``model`` (``score_args`` leaves
+    sharded over the model axis — the TP the topology always promised),
+    and exactly ONE ``psum`` over the model axis assembles the per-row
+    widened block — scores and reason codes then compute replicated per
+    model group, bitwise the single-device wide flush. Per-(data,model)-
+    shard windows are donated through and merged only at scrape, exactly
+    like every other mesh flush. Registered in meshcheck
+    (``mesh.broadside_flush``) and the compile sentinel."""
+    in_specs = (
+        ROW_SPEC,        # window: shard axis (flattened grid)
+        P(DATA_AXIS),    # x: rows (replicated over model)
+        P(DATA_AXIS),    # valid: rows
+        P(),             # decay
+        P(),             # feature_edges
+        P(),             # score_edges
+        P(),             # score_args (replicated pytree prefix)
+        P(MODEL_AXIS),   # wide_table: column-sharded over model
+        P(DATA_AXIS),    # fp: rows
+        P(DATA_AXIS),    # has_entity: rows
+        P(),             # dequant_scale (replicated; pytree-None when f32)
+        P(),             # explain_args (replicated; pytree-None when off)
+    )
+    out_specs = (
+        (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), ROW_SPEC)
+        if explain_k > 0
+        else (P(DATA_AXIS), ROW_SPEC)
+    )
+    mapped = shard_map(
+        partial(
+            _wide_shard_body,
+            cross_spec=cross_spec,
+            explain_k=explain_k,
+            out_dtype=out_dtype,
+        ),
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    return mapped(
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        wide_table, fp, has_entity, dequant_scale, explain_args,
     )
 
 
@@ -545,21 +685,27 @@ class MeshDriftMonitor(DriftMonitor):
         halflife_rows: float | None = None,
         min_bucket: int = 8,
     ):
-        n_shards = int(mesh.shape[DATA_AXIS])
+        shape = dict(mesh.shape)
+        n_data = int(shape[DATA_AXIS])
+        n_model = int(shape.get(MODEL_AXIS, 1))
+        n_shards = n_data * n_model
         if n_shards & (n_shards - 1):
             raise ValueError(
-                f"mesh data axis must be a power of two, got {n_shards}"
+                f"mesh grid must be a power of two, got {n_data}×{n_model}"
             )
         if n_shards > min_bucket:
             # The micro-batcher buckets and warms by the SCORER's
             # min_bucket, not this monitor's — a shard count above the
             # smallest bucket would fail every lone-request flush (8 rows
-            # cannot shard over 16 devices). Refuse loudly at construction
-            # instead of crashing the warmup ladder.
+            # cannot shard over 16 devices). Narrow families row-shard
+            # over the FLATTENED grid, so the bound covers data×model.
+            # Refuse loudly at construction instead of crashing the
+            # warmup ladder.
             raise ValueError(
-                f"{n_shards} flush shards exceed the smallest flush "
-                f"bucket ({min_bucket}) — every bucket must hand each "
-                "shard at least one row (see topology.MAX_FLUSH_SHARDS)"
+                f"{n_data}×{n_model} = {n_shards} flush shards exceed the "
+                f"smallest flush bucket ({min_bucket}) — every bucket must "
+                "hand each shard at least one row (see "
+                "topology.MAX_FLUSH_SHARDS)"
             )
         super().__init__(
             profile,
@@ -567,7 +713,13 @@ class MeshDriftMonitor(DriftMonitor):
             min_bucket=min_bucket,
         )
         self.mesh = mesh
+        self.n_data = n_data
+        self.n_model = n_model
         self.n_shards = n_shards
+        # broadside: the model-axis-placed cross table cache (one
+        # device_put per swap — see _placed_wide_table)
+        self._wide_placed = None
+        self._wide_src = None
         self.shard_window = init_sharded_window(
             n_shards,
             profile.n_features,
@@ -589,20 +741,30 @@ class MeshDriftMonitor(DriftMonitor):
         explain_args=None,
         explain_k: int = 0,
         ledger_rows=None,
+        wide_args=None,
+        wide_rows=None,
     ):
         """Score one staged bucket across every shard AND fold each shard's
-        rows into its own window — one dispatch, no collectives (the
-        quickwire ``_sharded_flush_quant`` program when ``dequant_scale``
-        rides along for a quantized wire; the lantern
+        rows into its own window — one dispatch, no hot-path collectives
+        except the wide family's single model-axis ``psum`` (the quickwire
+        ``_sharded_flush_quant`` program when ``dequant_scale`` rides
+        along for a quantized wire; the lantern
         ``_sharded_flush_explain``/``_quant_explain`` when ``explain_k >
         0`` adds the row-sharded reason-code leg; the stateful
         ``_sharded_flush_ledger`` when the ledger is bound and
         ``ledger_rows`` rides along — per-shard entity sub-tables donated
-        through beside the per-shard windows). Same locking contract
-        as the base class: the critical section is the async dispatch plus
-        the donated-state store."""
+        through beside the per-shard windows; the broadside
+        ``_sharded_flush_wide`` when ``wide_args``/``wide_rows`` ride
+        along — the cross-weight table column-sharded over the model
+        axis). Same locking contract as the base class: the critical
+        section is the async dispatch plus the donated-state store."""
         # graftcheck: hot-path
         decay = self._decay_for(n_live)
+        if wide_args is not None and wide_rows is not None:
+            return self._wide_flush(
+                x, valid, decay, n_live, score_args, dequant_scale,
+                out_dtype, explain_args, explain_k, wide_args, wide_rows,
+            )
         if ledger_rows is not None and self.ledger is not None:
             return self._ledger_flush(
                 x, valid, decay, n_live, score_args, score_fn,
@@ -683,6 +845,73 @@ class MeshDriftMonitor(DriftMonitor):
 
     def _window_for_stats(self) -> DriftWindow:
         return _merge_total(self.shard_window, self.window)
+
+    def _placed_wide_table(self, wide_table):
+        """The cross-weight table laid out with the model-axis sharding
+        the wide shard_map expects, cached per table identity — without
+        this every flush would reshard the full WIDE_BUCKETS vector from
+        its single-device layout inside the dispatch (the same per-call
+        layout cost ``_canonical_row_spec`` exists to avoid for donated
+        windows). One ``device_put`` per swap, then pure reads."""
+        placed = getattr(self, "_wide_placed", None)
+        if placed is None or self._wide_src is not wide_table:
+            placed = jax.device_put(
+                wide_table, NamedSharding(self.mesh, P(MODEL_AXIS))
+            )
+            self._wide_placed = placed
+            self._wide_src = wide_table
+        return placed
+
+    def _wide_flush(
+        self, x, valid, decay, n_live, score_args, dequant_scale,
+        out_dtype, explain_args, explain_k, wide_args, wide_rows,
+    ):
+        """Dispatch the 2-D broadside flush (``_sharded_flush_wide``) —
+        rows over data, the cross-weight table column-sharded over model,
+        per-(data,model)-shard windows donated through, exactly one
+        model-axis ``psum``."""
+        # graftcheck: hot-path
+        cross_spec, wide_table = wide_args
+        if cross_spec.buckets % self.n_model:
+            # must precede _placed_wide_table: the device_put with
+            # P(MODEL_AXIS) raises an opaque XLA uneven-sharding error on
+            # the same condition
+            raise ValueError(
+                f"wide table of {cross_spec.buckets} buckets does not "
+                f"column-shard over {self.n_model} model devices"
+            )
+        wide_table = self._placed_wide_table(wide_table)
+        fp, has_entity = wide_rows
+        explain_k = min(int(explain_k), int(x.shape[1]) + cross_spec.n_cross)
+        explain_k = explain_k if explain_args is not None else 0
+        with self._lock:
+            out = _sharded_flush_wide(
+                self.shard_window,
+                x,
+                valid,
+                decay,
+                self._feature_edges,
+                self._score_edges,
+                score_args,
+                wide_table,
+                fp,
+                has_entity,
+                dequant_scale,
+                explain_args if explain_k > 0 else None,
+                cross_spec=cross_spec,
+                mesh=self.mesh,
+                explain_k=explain_k,
+                out_dtype=out_dtype,
+                has_dequant=dequant_scale is not None,
+                has_explain=explain_k > 0,
+            )
+            if explain_k > 0:
+                scores, eidx, eval_, self.shard_window = out
+                self.rows_seen += n_live
+                return scores, eidx, eval_
+            scores, self.shard_window = out
+            self.rows_seen += n_live
+        return scores
 
     # -- ledger: per-shard sub-tables --------------------------------------
     def bind_ledger(self, spec, state=None) -> None:

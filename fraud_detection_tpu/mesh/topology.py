@@ -1,13 +1,17 @@
-"""Serving-mesh topology: the data axis the switchyard shards over.
+"""Serving-mesh topology: the (data × model) grid the switchyard shards over.
 
 The training tier already has a ``(data, model)`` mesh
 (:mod:`fraud_detection_tpu.parallel.mesh`); serving reuses the same axis
 names so the sharded flush and the sharded retrain update compose with the
-existing collectives. The serving mesh is 1-D over ``data``: the scaling
-axis of a fraud scorer is rows, and the 30-feature linear flagship has
-nothing worth tensor-sharding (the mechanism generalizes through
-``score_args`` being an arbitrary pytree — a TP-sharded family would carry
-sharded params there).
+existing collectives. Until broadside the serving mesh was effectively 1-D
+over ``data`` (the model axis pinned at 1 — 30-feature families have
+nothing worth tensor-sharding); ``MESH_MODEL_DEVICES`` now grows the
+second axis for the WIDE family, whose hashed-cross weight table
+(``WIDE_BUCKETS`` columns) column-shards over ``model`` with exactly one
+hot-path ``psum``. Narrow families on a 2-D mesh simply row-shard over the
+FLATTENED grid — every device still scores rows, nothing is wasted, and
+the per-(data,model)-shard drift windows merge only at scrape exactly as
+on the 1-D mesh.
 
 Real accelerators when present; otherwise the *virtual CPU shards*
 meshcheck proves shapes on (``--xla_force_host_platform_device_count``)
@@ -71,23 +75,61 @@ def serving_mesh_size(requested: int | None = None) -> int:
     return max(n, 1)
 
 
-def serving_mesh(n_shards: int | None = None, devices=None) -> Mesh:
-    """Build the 1-D ``data`` serving mesh over the first ``n_shards``
-    devices (resolved via :func:`serving_mesh_size` when None)."""
+def serving_mesh_model_size(requested: int | None = None) -> int:
+    """Resolve the serving mesh's model-axis size (``MESH_MODEL_DEVICES``).
+    0 resolves to 1 (no tensor parallelism); must be a power of two —
+    the wide family's bucket table (power-of-two wide) column-shards
+    evenly only then."""
+    m = config.mesh_model_devices() if requested is None else requested
+    if m <= 1:
+        return 1
+    if not _is_pow2(m):
+        raise ValueError(
+            f"MESH_MODEL_DEVICES must be a power of two (the wide bucket "
+            f"table must column-shard evenly), got {m}"
+        )
+    return m
+
+
+def serving_mesh(
+    n_shards: int | None = None, devices=None, model_devices: int | None = None
+) -> Mesh:
+    """Build the ``(data × model)`` serving mesh over the first
+    ``data·model`` devices. ``n_shards`` is the data-axis size (resolved
+    via :func:`serving_mesh_size` when None); ``model_devices`` the model
+    axis (``MESH_MODEL_DEVICES`` when None, default 1 — the historical
+    1-D mesh). The flattened grid must stay within
+    :data:`MAX_FLUSH_SHARDS`: narrow families row-shard over BOTH axes, so
+    every flush bucket must still hand each device a row."""
     if devices is None:
         devices = jax.devices()
+    m = serving_mesh_model_size(model_devices)
     # an explicit size is validated strictly below; only the knob-resolved
     # default gets the clamp-and-floor treatment
     n = serving_mesh_size() if n_shards is None else n_shards
-    if n > len(devices):
+    if m > 1 and n_shards is None and n * m > MAX_FLUSH_SHARDS:
+        log.warning(
+            "MESH_FLUSH_DEVICES×MESH_MODEL_DEVICES = %d×%d exceeds the "
+            "smallest flush bucket (%d) — clamping the data axis",
+            n, m, MAX_FLUSH_SHARDS,
+        )
+        n = max(MAX_FLUSH_SHARDS // m, 1)
+    total = n * m
+    if total > len(devices):
         raise ValueError(
-            f"serving mesh needs {n} devices, have {len(devices)} — run "
-            "under XLA_FLAGS=--xla_force_host_platform_device_count="
-            f"{n} for virtual CPU shards"
+            f"serving mesh needs {n}×{m} = {total} devices, have "
+            f"{len(devices)} — run under XLA_FLAGS=--xla_force_host_"
+            f"platform_device_count={total} for virtual CPU shards"
         )
     if not _is_pow2(n):
         raise ValueError(
-            f"serving mesh size must be a power of two (flush buckets "
-            f"must divide evenly across shards), got {n}"
+            f"serving mesh data-axis size must be a power of two (flush "
+            f"buckets must divide evenly across shards), got {n}"
         )
-    return create_mesh(MeshSpec(data=n), devices=devices[:n])
+    if total > MAX_FLUSH_SHARDS:
+        raise ValueError(
+            f"serving mesh {n}×{m} = {total} shards exceed the smallest "
+            f"flush bucket ({MAX_FLUSH_SHARDS}) — every bucket must hand "
+            "each device a row"
+        )
+    return create_mesh(MeshSpec(data=n, model=m), devices=devices[:total])

@@ -47,6 +47,8 @@ class FraudLogisticModel(FraudModelBase):
         io_dtype: str | None = None,
         ledger_spec=None,
         ledger_state=None,
+        wide_spec=None,
+        wide_table=None,
     ):
         self.params = params
         self.scaler = scaler
@@ -58,6 +60,24 @@ class FraudLogisticModel(FraudModelBase):
         # resumes entity history where training's replay left it.
         self.ledger_spec = ledger_spec
         self.ledger_state = ledger_state
+        # broadside (the wide family): feature_names span base + n_cross
+        # hashed-cross contribution columns; the stamped wide_params.npz
+        # sidecar carries the learned cross-weight table the fused flush
+        # gathers (column-sharded over a 2-D mesh's model axis). Clients
+        # still send the BASE schema, exactly the ledger contract.
+        self.wide_spec = wide_spec
+        self.wide_table = wide_table
+        if wide_spec is not None and ledger_spec is not None:
+            raise ValueError(
+                "a model cannot be both ledger- and wide-widened"
+            )
+        if wide_spec is not None and len(self.feature_names) != (
+            wide_spec.n_features
+        ):
+            raise ValueError(
+                f"wide model carries {len(self.feature_names)} names but "
+                f"the cross spec says {wide_spec.n_features}"
+            )
         if ledger_spec is not None and len(self.feature_names) != (
             ledger_spec.n_features
         ):
@@ -83,24 +103,40 @@ class FraudLogisticModel(FraudModelBase):
             )
             io_dtype = "float32"
         self.calibration = calibration
-        self._scorer = BatchScorer(
-            params, scaler, io_dtype=io_dtype, calibration=calibration,
-            ledger_spec=ledger_spec,
-        )
+        if wide_spec is not None:
+            from fraud_detection_tpu.ops.scorer import WideBatchScorer
+
+            self._scorer = WideBatchScorer(
+                params, scaler, wide_spec, wide_table,
+                io_dtype=io_dtype, calibration=calibration,
+            )
+        else:
+            self._scorer = BatchScorer(
+                params, scaler, io_dtype=io_dtype, calibration=calibration,
+                ledger_spec=ledger_spec,
+            )
         self._raw_explainer = None
+
+    @property
+    def _widened_spec(self):
+        """Whichever widening sidecar (ledger or wide) this family carries
+        — both expose ``n_base``/``n_features`` over the same contract."""
+        return self.ledger_spec if self.ledger_spec is not None else self.wide_spec
 
     @property
     def base_feature_names(self) -> list[str]:
         """The wire schema clients send (= feature_names for a stateless
-        family; the base prefix for a ledger-widened one)."""
-        if self.ledger_spec is None:
+        family; the base prefix for a ledger-/wide-widened one)."""
+        spec = self._widened_spec
+        if spec is None:
             return self.feature_names
-        return self.feature_names[: self.ledger_spec.n_base]
+        return self.feature_names[: spec.n_base]
 
     def prepare_row(self, features) -> "np.ndarray":
-        """Clients of a widened model still send the BASE schema — the K
-        velocity features are device-computed, never client-supplied."""
-        if self.ledger_spec is None:
+        """Clients of a widened model still send the BASE schema — the
+        widened columns (ledger velocity features / wide hashed-cross
+        contributions) are device-computed, never client-supplied."""
+        if self._widened_spec is None:
             return super().prepare_row(features)
         names = self.base_feature_names
         if isinstance(features, dict):
@@ -145,6 +181,19 @@ class FraudLogisticModel(FraudModelBase):
 
     def explain_batch(self, x: np.ndarray) -> tuple[np.ndarray, float]:
         x = np.asarray(x, np.float32)
+        if (
+            self.wide_spec is not None
+            and x.shape[1] == self.wide_spec.n_base
+        ):
+            # base-width input to the wide family (the async worker's
+            # backfill: the entity fingerprint never reaches the worker) —
+            # explain through the null path: a zero cross block, exactly
+            # what an entity-less request scores with. The worker's
+            # consistency check skips cross indices for this reason.
+            x = np.concatenate(
+                [x, np.zeros((x.shape[0], self.wide_spec.n_cross), np.float32)],
+                axis=1,
+            )
         if (
             self.ledger_spec is not None
             and x.shape[1] == self.ledger_spec.n_base
@@ -191,6 +240,12 @@ class FraudLogisticModel(FraudModelBase):
             if state is None:
                 state = init_state(self.ledger_spec.slots)
             save_ledger(directory, self.ledger_spec, state)
+        if self.wide_spec is not None:
+            # stamp the learned cross-weight table + hash geometry beside
+            # the weights — the widened coef is meaningless without it
+            from fraud_detection_tpu.ops.crosses import save_wide
+
+            save_wide(directory, self.wide_spec, self.wide_table)
         if joblib_too:
             try:
                 export_joblib_artifacts(
@@ -204,13 +259,17 @@ class FraudLogisticModel(FraudModelBase):
     def load(cls, directory: str) -> "FraudLogisticModel":
         params, scaler, feature_names = load_artifacts(directory)
         from fraud_detection_tpu.ledger.state import load_ledger
+        from fraud_detection_tpu.ops.crosses import load_wide
 
         ledger = load_ledger(directory)
         spec, state = ledger if ledger is not None else (None, None)
+        wide = load_wide(directory)
+        wide_spec, wide_table = wide if wide is not None else (None, None)
         return cls(
             params, scaler, feature_names,
             calibration=load_calibration(directory),
             ledger_spec=spec, ledger_state=state,
+            wide_spec=wide_spec, wide_table=wide_table,
         )
 
     @classmethod

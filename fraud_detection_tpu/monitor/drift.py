@@ -44,7 +44,7 @@ from fraud_detection_tpu.monitor.baseline import (
     feature_histogram,
     score_histogram,
 )
-from fraud_detection_tpu.ops.scorer import _bucket
+from fraud_detection_tpu.ops.scorer import _bucket, _raw_score_linear
 
 PSI_EPS = 1e-4
 N_CALIB_BINS = 10
@@ -460,6 +460,99 @@ def _ledger_serving_body(
     return _narrow_scores(scores, out_dtype), new_window, new_ledger
 
 
+def _wide_serving_body(
+    window, x, valid, decay, feature_edges, score_edges, score_args,
+    wide_table, fp, has_entity, dequant_scale=None, explain_args=None,
+    *, cross_spec, explain_k=0, out_dtype=jnp.float32, model_axis=None,
+):
+    """The ONE wide (broadside) serving sequence: dequant → hashed cross
+    indices → table gather → concat → score → (explain) → drift fold.
+    Traced by ``_fused_flush_wide`` AND the 2-D shard body in
+    mesh/shardflush — the ``_ledger_serving_body`` discipline, so the
+    2-D-shard-bitwise-matches-single-device contract holds by
+    construction.
+
+    ``model_axis`` is None on a single device (full-table gather) and the
+    mesh's model-axis name inside the shard body: there ``wide_table`` is
+    this shard's column slice, the gather masks to its range, and ONE
+    ``psum`` over the model axis assembles the widened block — each cross
+    index lives on exactly one shard, so the reduce adds one real value
+    and M−1 exact zeros (bitwise the single-device gather). The drift fold
+    is masked to model-rank 0 (rows are replicated over the model axis;
+    folding them M times would overcount the merged window), which keeps
+    "per-(data,model)-shard windows merged only at scrape" exact."""
+    from fraud_detection_tpu.ops.crosses import (
+        _gather_contrib,
+        _gather_contrib_shard,
+        _raw_cross_indices,
+    )
+
+    xb = x.astype(jnp.float32)
+    if dequant_scale is not None:
+        xb = xb * dequant_scale
+    idx = _raw_cross_indices(xb, fp, spec=cross_spec)
+    if model_axis is None:
+        contrib = _gather_contrib(wide_table, idx, has_entity)
+        fold_valid = valid
+    else:
+        local = _gather_contrib_shard(wide_table, idx, has_entity, model_axis)
+        # THE one model-axis collective on the wide hot path
+        contrib = jax.lax.psum(local, model_axis)
+        fold_valid = valid * (
+            jax.lax.axis_index(model_axis) == 0
+        ).astype(valid.dtype)
+    xf = jnp.concatenate([xb, contrib], axis=1)
+    scores = _raw_score_linear(score_args, xf).astype(jnp.float32)
+    new_window = _fold_serving_batch(
+        window, xf, scores, fold_valid, decay, feature_edges, score_edges
+    )
+    if explain_k > 0:
+        ridx, rval = _topk_attributions(xf, explain_args, explain_k)
+        ridx, rval = _narrow_reasons(ridx, rval, xf.shape[1], out_dtype)
+        return _narrow_scores(scores, out_dtype), ridx, rval, new_window
+    return _narrow_scores(scores, out_dtype), new_window
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cross_spec", "explain_k", "out_dtype"),
+    donate_argnums=(0,),
+)
+def _fused_flush_wide(
+    window: DriftWindow,
+    x: jax.Array,  # (b, n_base) staged batch (wire codes on a quant wire)
+    valid: jax.Array,  # (b,) 1.0 for real rows, 0.0 for bucket padding
+    decay: jax.Array,  # () drift forgetting factor (live rows this batch)
+    feature_edges: jax.Array,  # (n_base + n_cross, bins - 1) WIDENED edges
+    score_edges: jax.Array,
+    score_args,  # (widened raw-space coef, intercept)
+    wide_table: jax.Array,  # (buckets,) the learned cross-weight table
+    fp: jax.Array,  # (b,) uint32 entity fingerprint (0 = none)
+    has_entity: jax.Array,  # (b,) f32 1.0 when the row carries an entity
+    dequant_scale=None,  # (n_base,) per-feature dequant scale (int8 wire)
+    explain_args=None,  # (widened coef, widened mean) — lantern leg
+    *,
+    cross_spec,  # static ops/crosses.CrossSpec (hashable geometry)
+    explain_k: int = 0,  # static: reason codes per row (0 = no explain leg)
+    out_dtype=jnp.float32,  # static: d2h return wire
+):
+    """The broadside flush program: hashed-cross widening, scoring,
+    (optional) top-k reason codes AND the drift fold — ONE donated device
+    dispatch per shape bucket. The wide sibling of ``_fused_flush_ledger``:
+    same widened-block shape, but the extra columns are LEARNED hashed
+    crosses gathered from ``wide_table`` instead of stateful velocity
+    aggregates — no donated table, no scatters, so the hot path stays pure
+    gather+GEMV. Null-entity rows (fp 0) leave the entire wide block
+    zeroed (every template crosses the entity) and all-padding warmups
+    leave the window bitwise unchanged. Registered in meshcheck
+    (``broadside.flush``) and the compile sentinel."""
+    return _wide_serving_body(
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        wide_table, fp, has_entity, dequant_scale, explain_args,
+        cross_spec=cross_spec, explain_k=explain_k, out_dtype=out_dtype,
+    )
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def _window_update(
     window: DriftWindow,
@@ -678,6 +771,8 @@ class DriftMonitor:
         explain_args=None,
         explain_k: int = 0,
         ledger_rows=None,
+        wide_args=None,
+        wide_rows=None,
     ):
         """Score one staged batch AND fold it into the drift window in ONE
         device dispatch (the fastlane hot path — ``_fused_flush``; the
@@ -687,11 +782,15 @@ class DriftMonitor:
         top-k reason-code leg; the ledger ``_fused_flush_ledger`` when a
         ledger is bound and ``ledger_rows`` — the ``(slot_idx, fp, ts,
         has_entity)`` device quadruple — rides along, widening the feature
-        block with the per-entity velocity aggregates). ``x`` and ``valid``
-        are already device-resident and bucket-padded; returns the device
-        score vector (padded, in the ``out_dtype`` return wire; caller
-        slices to the live rows and decodes) — or, with the explain leg,
-        the ``(scores, reason_idx, reason_val)`` device triple.
+        block with the per-entity velocity aggregates; the broadside
+        ``_fused_flush_wide`` when ``wide_args`` — the scorer's
+        ``(CrossSpec, wide_table)`` — and ``wide_rows`` — the
+        ``(fingerprint, has_entity)`` device pair — ride along, widening
+        with hashed-cross contributions). ``x`` and ``valid`` are already
+        device-resident and bucket-padded; returns the device score vector
+        (padded, in the ``out_dtype`` return wire; caller slices to the
+        live rows and decodes) — or, with the explain leg, the ``(scores,
+        reason_idx, reason_val)`` device triple.
 
         The lock covers only {read window → dispatch → store new window}:
         dispatch is asynchronous, so the critical section is microseconds
@@ -701,6 +800,11 @@ class DriftMonitor:
         output future."""
         # graftcheck: hot-path
         decay = self._decay_for(n_live)
+        if wide_args is not None and wide_rows is not None:
+            return self._wide_flush(
+                x, valid, decay, n_live, score_args, dequant_scale,
+                out_dtype, explain_args, explain_k, wide_args, wide_rows,
+            )
         if ledger_rows is not None and self.ledger is not None:
             return self._ledger_flush(
                 x, valid, decay, n_live, score_args, score_fn,
@@ -816,6 +920,45 @@ class DriftMonitor:
             self.rows_seen += n_live
         return scores
 
+    def _wide_flush(
+        self, x, valid, decay, n_live, score_args, dequant_scale,
+        out_dtype, explain_args, explain_k, wide_args, wide_rows,
+    ):
+        """Dispatch the broadside widened flush (``_fused_flush_wide``) —
+        window donated through, the cross-weight table read-only. Same
+        critical-section discipline as the stateless path."""
+        # graftcheck: hot-path
+        cross_spec, wide_table = wide_args
+        fp, has_entity = wide_rows
+        # k clamps against the WIDENED width the explain leg attributes
+        explain_k = min(int(explain_k), int(x.shape[1]) + cross_spec.n_cross)
+        explain_k = explain_k if explain_args is not None else 0
+        with self._lock:
+            out = _fused_flush_wide(
+                self.window,
+                x,
+                valid,
+                decay,
+                self._feature_edges,
+                self._score_edges,
+                score_args,
+                wide_table,
+                fp,
+                has_entity,
+                dequant_scale,
+                explain_args if explain_k > 0 else None,
+                cross_spec=cross_spec,
+                explain_k=explain_k,
+                out_dtype=out_dtype,
+            )
+            if explain_k > 0:
+                scores, eidx, eval_, self.window = out
+                self.rows_seen += n_live
+                return scores, eidx, eval_
+            scores, self.window = out
+            self.rows_seen += n_live
+        return scores
+
     def warm_fused(
         self, scorer, bucket: int, out_dtype=jnp.float32, explain_k: int = 0
     ) -> None:
@@ -836,6 +979,17 @@ class DriftMonitor:
             hx = scorer._encode_slot(slot)
             slot.valid[:] = 0.0
             ledger_rows = None
+            wide_rows = None
+            if getattr(spec, "wide", None) is not None:
+                # the wide program warms through the same all-padding
+                # discipline: fingerprint 0 everywhere zeroes the entire
+                # cross block (every template crosses the entity) and
+                # valid = 0 folds exact zeros, so the window is bitwise
+                # unchanged while the executable compiles
+                slot.ensure_ledger()
+                slot.lf[:] = 0
+                slot.lh[:] = 0.0
+                wide_rows = (jnp.asarray(slot.lf), jnp.asarray(slot.lh))
             if self.ledger is not None and getattr(spec, "ledger", None):
                 # the ledger program warms through the same all-padding
                 # discipline: has_entity = 0 everywhere scatter-adds exact
@@ -859,6 +1013,8 @@ class DriftMonitor:
                 explain_args=spec.explain_args if explain_k else None,
                 explain_k=explain_k,
                 ledger_rows=ledger_rows,
+                wide_args=getattr(spec, "wide", None),
+                wide_rows=wide_rows,
             )
             jax.block_until_ready(out)
         finally:

@@ -124,40 +124,53 @@ class ShadowScorer:
         self._score_counts = self._score_counts * decay + hist
         if champion_reasons is not None and self._explainer is not None:
             champ_idx = np.asarray(champion_reasons)
-            k = champ_idx.shape[1]
+            k = champ_idx.shape[1] if champ_idx.ndim == 2 else 0
             if k > 0 and champ_idx.shape[0] == n:
-                coef, mu = self._explainer[0], self._explainer[1]
-                nulls = (
-                    self._explainer[2] if len(self._explainer) > 2 else None
-                )
-                r = np.asarray(rows, np.float64)
-                if r.shape[1] < coef.shape[0]:
-                    # WIDENED challenger, base-width monitor rows: explain
-                    # through the challenger's null slot (its worker-
-                    # backfill view of the same row); widths that can't
-                    # reconcile skip the comparison, never the sample
-                    if (
-                        nulls is not None
-                        and r.shape[1] + nulls.shape[0] == coef.shape[0]
-                    ):
-                        r = np.concatenate(
-                            [r, np.broadcast_to(nulls, (n, nulls.shape[0]))],
-                            axis=1,
-                        )
-                    else:
-                        r = None
-                if r is not None:
-                    self._fold_reasons(r, coef, mu, champ_idx, k, n, decay)
+                phi = self._challenger_phi(rows, n)
+                if phi is not None:
+                    self._fold_reasons(phi, champ_idx, k, n, decay)
         self.batches_sampled += 1
         return True
 
-    def _fold_reasons(self, r, coef, mu, champ_idx, k, n, decay) -> None:
+    def _challenger_phi(self, rows, n: int):
+        """The challenger's per-row attribution matrix for one sampled
+        batch, or None when it cannot be produced (the comparison is then
+        skipped, never the sample). ``explainer`` is family-agnostic: a
+        CALLABLE computes φ directly (any family with ``explain_batch`` —
+        linear, wide, and the GBT forest's exact TreeSHAP, which runs on
+        the watchtower ingest thread like the challenger re-score itself,
+        never the request path); the legacy ``(coef, background_mean[,
+        null_features])`` linear triple is kept for direct construction
+        (tests, hand-built monitors)."""
+        if callable(self._explainer):
+            try:
+                phi = np.asarray(self._explainer(rows), np.float64)
+            except Exception:
+                log.debug("challenger phi failed", exc_info=True)
+                return None
+            return phi if phi.ndim == 2 and phi.shape[0] == n else None
+        coef, mu = self._explainer[0], self._explainer[1]
+        nulls = self._explainer[2] if len(self._explainer) > 2 else None
+        r = np.asarray(rows, np.float64)
+        if r.shape[1] < coef.shape[0]:
+            # WIDENED challenger, base-width monitor rows: explain through
+            # the challenger's null slot (its worker-backfill view of the
+            # same row); widths that can't reconcile skip the comparison
+            if nulls is not None and r.shape[1] + nulls.shape[0] == coef.shape[0]:
+                r = np.concatenate(
+                    [r, np.broadcast_to(nulls, (n, nulls.shape[0]))], axis=1
+                )
+            else:
+                return None
+        return coef[None, :] * (r - mu[None, :])
+
+    def _fold_reasons(self, phi, champ_idx, k, n, decay) -> None:
         """Fold one sampled batch's reason-code comparison into the decayed
         divergence window (mean 1 − Jaccard over the top-k index sets)."""
-        phi = coef[None, :] * (r - mu[None, :])
         # the challenger's top-k by signed attribution, matching
         # ops/linear_shap.topk_reasons' ranking; argsort is stable
         # so ties resolve toward the lower index like lax.top_k
+        k = min(k, phi.shape[1])
         ch_idx = np.argsort(-phi, axis=1, kind="stable")[:, :k]
         inter = np.asarray(
             [
@@ -166,7 +179,8 @@ class ShadowScorer:
             ],
             np.float64,
         )
-        jaccard = inter / (2 * k - inter)
+        denom = np.maximum(champ_idx.shape[1] + k - inter, 1.0)
+        jaccard = inter / denom
         self._reason_rows = self._reason_rows * decay + n
         self._reason_div = self._reason_div * decay + float(
             np.sum(1.0 - jaccard)

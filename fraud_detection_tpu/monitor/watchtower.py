@@ -50,34 +50,34 @@ RECOMMENDATIONS = (
 
 
 def _challenger_explainer(challenger):
-    """The challenger's raw-space linear-SHAP ``(coef, background_mean,
-    null_features)`` numpy triple for the shadow reason-code comparison —
-    ``null_features`` is the ledger null vector for a WIDENED challenger
-    (the shadow rows are base-width; the comparison explains them through
-    the challenger's null slot, exactly like its worker backfill would) or
-    None for a stateless family. Returns None entirely for families
-    without a cheap host-side explainer (the divergence gauge then just
-    stays unset)."""
+    """A family-agnostic attribution callable ``phi(rows) -> (n, d)`` for
+    the shadow reason-code comparison, built on the challenger's own
+    ``explain_batch`` — the SAME full-vector path its worker backfill
+    runs. This covers every served family: the linear/wide families'
+    vectorized raw-space linear SHAP, a LEDGER-widened challenger's
+    null-slot explanation of base-width rows, and the GBT forest's exact
+    TreeSHAP (``ops/tree_shap`` — a device call, which is fine here: the
+    comparison runs on the watchtower ingest thread behind the sampled
+    challenger re-score, never the request path; previously this returned
+    the linear coef pair only, so a GBT challenger shadowed with NO
+    Jaccard signal). Returns None for objects without ``explain_batch``
+    (the divergence gauge then just stays unset)."""
     import numpy as np
 
-    try:
-        ex = challenger.raw_explainer()
-        spec = getattr(challenger, "ledger_spec", None)
-        return (
-            np.asarray(ex.coef, np.float64),
-            np.asarray(ex.background_mean, np.float64),
-            (
-                np.asarray(spec.null_features, np.float64)
-                if spec is not None
-                else None
-            ),
-        )
-    except Exception:
+    if not hasattr(challenger, "explain_batch"):
         log.debug(
-            "challenger has no linear raw explainer — shadow reason "
-            "divergence disabled", exc_info=True,
+            "challenger has no explain_batch — shadow reason divergence "
+            "disabled"
         )
         return None
+
+    def phi(rows):
+        return np.asarray(
+            challenger.explain_batch(np.asarray(rows, np.float32))[0],
+            np.float64,
+        )
+
+    return phi
 
 
 @dataclass(frozen=True)
@@ -573,9 +573,12 @@ def build_watchtower(
         resolved = load_shadow_model()
         if resolved is not None:
             challenger, challenger_source = resolved
-            ch_names = getattr(challenger, "feature_names", None)
+            ch_names = getattr(
+                challenger, "base_feature_names",
+                getattr(challenger, "feature_names", None),
+            )
             if ch_names is not None and list(ch_names) != list(
-                model.feature_names
+                getattr(model, "base_feature_names", model.feature_names)
             ):
                 # Caught here once at startup; inside the ingest loop it
                 # would instead fail on every sampled batch while the

@@ -1,0 +1,304 @@
+"""Broadside: hashed feature crosses — the tensor-parallel wide family.
+
+Every family served so far is ~30 features wide, so the serving mesh's
+model axis was vestigial. This module gives the linear scorer a genuinely
+wide signal surface: multiply-shift hashed feature crosses derived from
+fields the wire ALREADY carries — the entity fingerprint (the ledger's
+u32, host-hashed once at the edge), the transaction amount bucket, the
+hour-of-day from the ``Time`` column, and the sign pattern of the V
+features — at ``d = WIDE_BUCKETS`` (power of two, 2¹⁴ default). The
+30-feature request block stays the wire format; the crosses materialize
+device-side inside the fused flush.
+
+The serving representation is the ledger's "widened feature block"
+discipline: each of the ``n_cross`` cross templates contributes ONE column
+— the hashed bucket's learned weight, ``contrib[:, c] = w_wide[idx_c]`` —
+so the widened block ``[x, contrib]`` feeds the EXISTING linear score
+body, the existing shared drift fold (drift monitoring covers the cross
+contributions for free), and the existing linear-SHAP explain leg (φ for a
+cross column is its contribution — reason codes can name a cross). The
+only wide-specific device math is the hash, the table gather, and — on the
+2-D mesh — exactly ONE ``psum`` over the model axis assembling the
+column-sharded partial gathers (mesh/shardflush).
+
+Hashing is pure uint32 arithmetic (multiply-shift with fixed constants —
+murmur3 finalizer over Fibonacci-mixed keys), so cross indices are
+bitwise-identical across processes, mesh shapes, and shard placements:
+the 2-D-shard-vs-single-device bitwise contract starts here. Rows without
+an entity fingerprint (legacy clients, padding) leave the ENTIRE wide
+block zeroed — every template crosses the entity, so a null entity scores
+base-only, and an all-padding warmup batch cannot touch the drift window.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger("fraud_detection_tpu.broadside")
+
+WIDE_FILE = "wide_params.npz"
+
+#: number of cross templates (the widened block gains this many columns)
+N_CROSS = 4
+
+#: names of the widened columns, in template order — feature_names of a
+#: wide model are the base schema followed by these (reason codes and the
+#: drift top-features list name crosses by them)
+CROSS_NAMES = (
+    "cross_entity_amount",
+    "cross_entity_hour",
+    "cross_entity_signs",
+    "cross_entity_amount_hour",
+)
+
+# Fixed hash constants: Fibonacci multiplier + the murmur3 finalizer pair,
+# with one odd salt per cross template. Changing ANY of these changes every
+# learned table's meaning — they are part of the artifact contract (the
+# sidecar stamps a hash_version).
+_KNUTH = np.uint32(2654435761)
+_M1 = np.uint32(0x85EBCA6B)
+_M2 = np.uint32(0xC2B2AE35)
+_SALTS = (
+    np.uint32(0x9E3779B1),
+    np.uint32(0x7F4A7C15),
+    np.uint32(0x94D049BB),
+    np.uint32(0xD6E8FEB9),
+)
+HASH_VERSION = 1
+
+
+class CrossSpec(NamedTuple):
+    """Static geometry of the wide family — a NamedTuple of ints so it is
+    hashable and rides the fused programs as a jit static argument (one
+    executable per geometry, exactly the score_fn discipline)."""
+
+    n_base: int  # width of the wire schema the crosses derive from
+    log2_buckets: int  # wide table size = 1 << log2_buckets
+    amount_col: int  # Amount column in the base row (resolved, >= 0)
+    time_col: int = 0  # Time column (seconds) for the hour-of-day key
+    n_cross: int = N_CROSS
+
+    @property
+    def buckets(self) -> int:
+        return 1 << self.log2_buckets
+
+    @property
+    def n_features(self) -> int:
+        return self.n_base + self.n_cross
+
+    @property
+    def cross_names(self) -> tuple[str, ...]:
+        return CROSS_NAMES[: self.n_cross]
+
+
+def spec_from_config(n_base: int, amount_col: int | None = None) -> CrossSpec:
+    from fraud_detection_tpu import config
+
+    buckets = config.wide_buckets()
+    if buckets < 2 or buckets & (buckets - 1):
+        raise ValueError(f"WIDE_BUCKETS must be a power of two, got {buckets}")
+    a = amount_col if amount_col is not None else config.ledger_amount_col()
+    if a < 0:
+        a += n_base
+    return CrossSpec(
+        n_base=n_base, log2_buckets=buckets.bit_length() - 1, amount_col=a
+    )
+
+
+# --------------------------------------------------------------------------
+# The traced hash + gather bodies (shared by every fused wide program)
+# --------------------------------------------------------------------------
+
+
+def _mix32(h: jax.Array) -> jax.Array:
+    """murmur3 finalizer over uint32 — wraps deterministically on device."""
+    h = h ^ (h >> 16)
+    h = h * _M1
+    h = h ^ (h >> 13)
+    h = h * _M2
+    h = h ^ (h >> 16)
+    return h
+
+
+def _raw_cross_indices(xb: jax.Array, fp: jax.Array, *, spec: CrossSpec):
+    """Per-row hashed cross indices, ``(b, n_cross)`` int32 in
+    ``[0, buckets)``. ``xb`` is the f32 base block the model actually
+    scores (dequantized on a quant wire — the histogram-shared multiply),
+    ``fp`` the uint32 entity fingerprint (0 = none; the gather masks those
+    rows regardless, so index content for them is irrelevant). Pure
+    integer mixing after the key quantization, so same rows → bitwise
+    identical indices on every process and mesh shape."""
+    fp = fp.astype(jnp.uint32)
+    amount = xb[:, spec.amount_col]
+    # log-spaced amount buckets: 8 per decade-ish, clipped to one byte
+    abucket = jnp.clip(
+        jnp.floor(jnp.log1p(jnp.abs(amount)) * 8.0), 0.0, 255.0
+    ).astype(jnp.uint32)
+    t = jnp.maximum(xb[:, spec.time_col], 0.0)
+    hour = jnp.mod(jnp.floor(t / 3600.0), 24.0).astype(jnp.uint32)
+    # sign pattern over the (up to 24) base columns that are neither the
+    # time nor the amount key — the V-feature half-space signature
+    sign_cols = tuple(
+        j for j in range(spec.n_base)
+        if j not in (spec.time_col, spec.amount_col)
+    )[:24]
+    weights = jnp.asarray(
+        [np.uint32(1) << np.uint32(k) for k in range(len(sign_cols))],
+        jnp.uint32,
+    )
+    bits = (xb[:, list(sign_cols)] > 0.0).astype(jnp.uint32)
+    # elementwise-and-reduce, not a dot: integer matmul support varies by
+    # backend, and this is 24 adds per row fused into the flush anyway
+    signs = (
+        jnp.sum(bits * weights[None, :], axis=1, dtype=jnp.uint32)
+        if len(sign_cols)
+        else jnp.zeros_like(fp)
+    )
+    fields = (
+        abucket,
+        hour,
+        signs,
+        abucket * jnp.uint32(24) + hour,
+    )[: spec.n_cross]
+    shift = jnp.uint32(32 - spec.log2_buckets)
+    cols = [
+        (_mix32((fp ^ (f * _KNUTH)) + _SALTS[c]) >> shift).astype(jnp.int32)
+        for c, f in enumerate(fields)
+    ]
+    return jnp.stack(cols, axis=1)
+
+
+def _gather_contrib(
+    wide_table: jax.Array, idx: jax.Array, has_entity: jax.Array
+) -> jax.Array:
+    """Single-device widened block: ``contrib[:, c] = w_wide[idx_c]``,
+    zeroed for entity-less rows (every template crosses the entity)."""
+    return wide_table[idx] * has_entity[:, None]
+
+
+def _gather_contrib_shard(
+    wide_local: jax.Array, idx: jax.Array, has_entity: jax.Array, model_axis
+) -> jax.Array:
+    """One model shard's partial of the widened block: its column slice's
+    weights where the index falls in-range, exact zeros elsewhere. The
+    caller ``psum``s over the model axis — each index lives on exactly one
+    shard, so the reduce adds one real value and M−1 exact zeros, and the
+    assembled block is BITWISE the single-device gather."""
+    size = wide_local.shape[0]
+    lo = (jax.lax.axis_index(model_axis) * size).astype(jnp.int32)
+    rel = idx.astype(jnp.int32) - lo
+    inb = (rel >= 0) & (rel < size)
+    g = jnp.where(inb, wide_local[jnp.clip(rel, 0, size - 1)], 0.0)
+    return g * has_entity[:, None]
+
+
+# --------------------------------------------------------------------------
+# Host helpers (training replay, gate slices, tests)
+# --------------------------------------------------------------------------
+
+
+def cross_indices(x: np.ndarray, fps: np.ndarray, spec: CrossSpec) -> np.ndarray:
+    """Host wrapper: cross indices for raw base rows + fingerprints (the
+    values serving hashes — RAW feature space, never scaled)."""
+    out = _raw_cross_indices(
+        jnp.asarray(np.asarray(x, np.float32)),
+        jnp.asarray(np.asarray(fps, np.uint32)),
+        spec=spec,
+    )
+    return np.asarray(out, np.int32)
+
+
+def widen_with_crosses(
+    x: np.ndarray, fps: np.ndarray, table: np.ndarray, spec: CrossSpec
+) -> np.ndarray:
+    """``[x, contrib]`` for offline evaluation (the gate's holdout slices)
+    — the same widened block the fused flush materializes, so offline
+    scores match what serving would produce for those rows."""
+    x = np.asarray(x, np.float32)
+    fps = np.asarray(fps, np.uint32)
+    idx = cross_indices(x, fps, spec)
+    contrib = np.asarray(table, np.float32)[idx] * (
+        (fps != 0).astype(np.float32)[:, None]
+    )
+    return np.concatenate([x, contrib], axis=1).astype(np.float32)
+
+
+def widen_scaler(scaler, n_cross: int):
+    """Extend a base-schema scaler with identity columns for the cross-
+    contribution block: contributions are raw table weights (mean 0 /
+    scale 1 — never standardized), so the widened scaler folds into the
+    widened coef without touching them."""
+    from fraud_detection_tpu.ops.scaler import ScalerParams
+
+    if scaler is None:
+        return None
+    return ScalerParams(
+        mean=np.concatenate(
+            [np.asarray(scaler.mean, np.float32), np.zeros(n_cross, np.float32)]
+        ),
+        scale=np.concatenate(
+            [np.asarray(scaler.scale, np.float32), np.ones(n_cross, np.float32)]
+        ),
+        var=np.concatenate(
+            [np.asarray(scaler.var, np.float32), np.ones(n_cross, np.float32)]
+        ),
+        n_samples=scaler.n_samples,
+    )
+
+
+def entity_fingerprints(entities, n: int) -> np.ndarray:
+    """uint32 fingerprints for a list of entity ids (None → 0, the null
+    path) — the ledger's edge hash, one keyspace across subsystems."""
+    from fraud_detection_tpu.ledger.state import entity_fingerprint
+
+    fps = np.zeros(n, np.uint32)
+    for i, e in enumerate(entities or []):
+        if i >= n:
+            break
+        if e is not None:
+            fps[i] = entity_fingerprint(e)
+    return fps
+
+
+def save_wide(directory: str, spec: CrossSpec, table: np.ndarray) -> str:
+    """Stamp ``wide_params.npz`` (geometry + learned cross-weight table)
+    beside the model — the widened coef is meaningless without it."""
+    path = os.path.join(directory, WIDE_FILE)
+    np.savez(
+        path,
+        hash_version=np.int64(HASH_VERSION),
+        n_base=np.int64(spec.n_base),
+        log2_buckets=np.int64(spec.log2_buckets),
+        amount_col=np.int64(spec.amount_col),
+        time_col=np.int64(spec.time_col),
+        n_cross=np.int64(spec.n_cross),
+        table=np.asarray(table, np.float32),
+    )
+    return path
+
+
+def load_wide(directory: str) -> tuple[CrossSpec, np.ndarray] | None:
+    path = os.path.join(directory, WIDE_FILE)
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        if int(z["hash_version"]) != HASH_VERSION:
+            raise ValueError(
+                f"wide sidecar hash_version {int(z['hash_version'])} != "
+                f"{HASH_VERSION} — the table was learned under different "
+                "cross-hash constants and cannot serve"
+            )
+        spec = CrossSpec(
+            n_base=int(z["n_base"]),
+            log2_buckets=int(z["log2_buckets"]),
+            amount_col=int(z["amount_col"]),
+            time_col=int(z["time_col"]),
+            n_cross=int(z["n_cross"]),
+        )
+        return spec, np.asarray(z["table"], np.float32)

@@ -116,6 +116,17 @@ class FusedSpec(NamedTuple):
     features are computed raw; on the int8 wire the program
     explicit-dequants the codes — the multiply is shared with the
     histogram bin, quickwire's pallas discipline).
+
+    ``wide`` (broadside) is the ``(CrossSpec, wide_table)`` pair when the
+    model family carries hashed-cross weights: the fused flush must run
+    the wide program (``monitor/drift._fused_flush_wide`` /
+    ``mesh/shardflush._sharded_flush_wide``), hashing the cross indices
+    device-side, gathering the table (column-sharded over the 2-D mesh's
+    model axis, assembled with exactly one ``psum``), and concatenating
+    the contribution block before scoring — the ledger's widened-block
+    discipline with learned hashed crosses instead of velocity state. A
+    wide spec always carries RAW-space ``score_args`` over the widened
+    width (explicit dequant on a quant wire, like the ledger).
     """
 
     score_fn: Callable
@@ -125,6 +136,7 @@ class FusedSpec(NamedTuple):
     wire: str = "float32"
     explain_args: Any = None
     ledger: Any = None
+    wide: Any = None
 
 
 #: d2h score wire formats: name → (numpy dtype, jax dtype, bytes/row).
@@ -883,3 +895,140 @@ class GBTBatchScorer(_BucketedScorer):
             wire=self.io_dtype,
             explain_args=self._resolve_explainer(),
         )
+
+
+class WideBatchScorer(_BucketedScorer):
+    """Broadside: the tensor-parallel wide family's scorer.
+
+    ``params``/``scaler`` span the WIDENED width (base + n_cross columns:
+    the base schema plus one contribution column per hashed-cross
+    template); clients still send the BASE schema, and the fused flush
+    hashes + gathers the cross contributions device-side
+    (ops/crosses — ``monitor/drift._fused_flush_wide``, or the 2-D
+    ``mesh/shardflush._sharded_flush_wide`` with the table column-sharded
+    over the model axis). The ledger's widened-family protocol throughout:
+    ``staging_features`` is the base width, a base-width batch on the
+    solo/split path scores through the null fold (zero crosses — the
+    wide contribution REQUIRES the fused flush, which is why the demotion
+    gauge ``scorer_wide_fused`` exists), and a pre-widened block (gate /
+    holdout slices built by ``ops/crosses.widen_with_crosses``) scores the
+    full widened linear directly.
+    """
+
+    family = "wide"
+
+    def __init__(
+        self,
+        params: LogisticParams,
+        scaler: ScalerParams | None,
+        cross_spec,
+        wide_table,
+        min_bucket: int = 8,
+        io_dtype: str = "float32",
+        calibration: QuantCalibration | None = None,
+        int8_sigma_range: float | None = None,
+    ):
+        folded = fold_scaler_into_linear(params, scaler)
+        self.coef = jnp.asarray(folded.coef, dtype=jnp.float32)
+        self._raw_coef = self.coef
+        self.intercept = jnp.asarray(folded.intercept, dtype=jnp.float32)
+        self.n_features = int(self.coef.shape[0])
+        self.wide_spec = cross_spec
+        if self.n_features != cross_spec.n_features:
+            raise ValueError(
+                f"wide spec widens {cross_spec.n_base} → "
+                f"{cross_spec.n_features} features but the params cover "
+                f"{self.n_features}"
+            )
+        self.n_base_features = int(cross_spec.n_base)
+        table = np.asarray(wide_table, np.float32)
+        if table.shape != (cross_spec.buckets,):
+            raise ValueError(
+                f"wide table shape {table.shape} != ({cross_spec.buckets},)"
+            )
+        self._wide_table_np = table
+        self.wide_table = jnp.asarray(table)
+        self._explain_mean = jnp.asarray(
+            scaler.mean if scaler is not None
+            else np.zeros(self.n_features, np.float32),
+            dtype=jnp.float32,
+        )
+        self.min_bucket = min_bucket
+        if io_dtype not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"io_dtype must be float32|bfloat16|int8, got {io_dtype}"
+            )
+        self.io_dtype = io_dtype
+        self.calibration: QuantCalibration | None = None
+        if io_dtype == "int8":
+            if calibration is None:
+                if scaler is None:
+                    raise ValueError(
+                        "int8 IO needs a stamped QuantCalibration or scaler "
+                        "stats for calibration"
+                    )
+                calibration = derive_calibration(scaler, int8_sigma_range)
+            # the wire carries BASE columns only; the scale is NOT folded
+            # into the weights — the wide program explicit-dequants (the
+            # multiply shared with the histogram bin), exactly the
+            # ledger-on-int8 discipline
+            calibration = QuantCalibration(
+                scale=np.asarray(
+                    calibration.scale[: self.n_base_features], np.float32
+                ),
+                sigma_range=calibration.sigma_range,
+            )
+            self._bind_calibration(calibration)
+        elif io_dtype == "bfloat16":
+            self._io_np_dtype = _np_bfloat16()
+        else:
+            self._io_np_dtype = np.float32
+        # null fold: a base-width batch (solo/split path, or a null-entity
+        # row inside the fused flush) has an all-zero cross block, so the
+        # widened coef's base slice + the unchanged intercept score it
+        base_raw = self._raw_coef[: self.n_base_features]
+        self._null_coef = (
+            base_raw * self._dequant_scale
+            if self._quant_scale is not None
+            else base_raw
+        )
+
+    def _prepare_host(self, x: np.ndarray) -> np.ndarray:
+        if x.shape[1] == self.n_features:
+            # a pre-widened block (gate/holdout slices) bypasses the wire
+            # encode: contribution columns never ship on a narrow wire
+            return x.astype(np.float32, copy=False)
+        return super()._prepare_host(x)
+
+    def _score_padded(self, x: jax.Array, out_dtype=jnp.float32) -> jax.Array:
+        if int(x.shape[1]) == self.n_base_features:
+            return _score(
+                self._null_coef, self.intercept, x, out_dtype=out_dtype
+            )
+        return _score(self._raw_coef, self.intercept, x, out_dtype=out_dtype)
+
+    def fused_spec(self) -> FusedSpec:
+        return FusedSpec(
+            _raw_score_linear,
+            (self._raw_coef, self.intercept),
+            dequant_scale=(
+                self._dequant_scale if self._quant_scale is not None else None
+            ),
+            score_codes=False,
+            wire=self.io_dtype,
+            explain_args=(self._raw_coef, self._explain_mean),
+            wide=(self.wide_spec, self.wide_table),
+        )
+
+    def table_occupancy(self, n_model_shards: int = 1) -> list[float]:
+        """Fraction of non-zero learned weights per model-axis column
+        slice — the ``wide_bucket_occupancy{model_shard}`` gauge feeding
+        the WideShardSkew alert (a degenerate hash mix concentrates the
+        learned mass on few shards). Host-side, computed once per swap."""
+        t = self._wide_table_np
+        n = max(int(n_model_shards), 1)
+        per = t.shape[0] // n
+        return [
+            float(np.mean(np.abs(t[s * per:(s + 1) * per]) > 1e-12))
+            for s in range(n)
+        ]

@@ -300,9 +300,17 @@ def create_app(
 
                 # Switchyard: MESH_FLUSH_DEVICES>1 shards the fused flush
                 # (and its drift window) over the serving mesh — one SPMD
-                # dispatch per flush spanning the data axis.
+                # dispatch per flush spanning the data axis. Broadside:
+                # MESH_MODEL_DEVICES>1 alone also builds the mesh (data
+                # axis 1) — the wide family's cross table column-shards
+                # over the model axis even without data sharding, and an
+                # operator setting only the model knob must not silently
+                # get a single-device gather.
                 mesh = None
-                if config.mesh_flush_devices() > 1:
+                if (
+                    config.mesh_flush_devices() > 1
+                    or config.mesh_model_devices() > 1
+                ):
                     from fraud_detection_tpu.mesh import serving_mesh
 
                     mesh = serving_mesh()
@@ -493,6 +501,15 @@ def create_app(
                 slot_idx, fp,
                 ledger_spec.rel_ts(event_ts or time.time()),
             )
+        elif getattr(model, "wide_spec", None) is not None and (
+            entity_id is not None
+        ):
+            # broadside: the wide family needs only the fingerprint (its
+            # crosses hash it with request fields) — same edge hash, one
+            # keyspace with the ledger's entity ids
+            from fraud_detection_tpu.ledger.state import entity_fingerprint
+
+            entity = (0, entity_fingerprint(entity_id), 0.0)
 
         timeline = (
             RequestTimeline(correlation_id=corr_id)

@@ -275,6 +275,12 @@ class _FrameDecoder:
         self.dequant = dequant
         self.d = int(scorer.staging_features)
         self.spec = getattr(scorer, "ledger_spec", None)
+        # broadside: the wide family keys its crosses on the fingerprint
+        # alone — entity columns must still ride (slot/ts fields are
+        # simply unused by the wide flush), otherwise the binary lanes
+        # would silently drop every ingest row onto the null fold while
+        # the JSON lane applies the crosses
+        self.wide = getattr(scorer, "wide_spec", None)
         # reusable scratch (lazily sized): int8 feature codes, a byte-order
         # staging block for big-endian hosts, raw entity / ts columns,
         # derived ledger columns, u8 reason indices
@@ -317,11 +323,17 @@ class _FrameDecoder:
         SAME hash/clock math as the JSON edge (vectorized): table slot via
         multiply-shift over the fingerprint, event time origin-relative.
         Returns None when the served family is stateless."""
-        if ent_buf is None or self.spec is None:
+        if ent_buf is None or (self.spec is None and self.wide is None):
             return None
         self._ensure(n)
         fp = np.frombuffer(ent_buf, "<u4", n)
         np.copyto(self._lf[:n], fp)
+        if self.spec is None:
+            # wide family: only the fingerprint keys the crosses — slot
+            # and timestamp lanes ride zeroed (unused by the wide flush)
+            self._ls[:n] = 0
+            self._lt[:n] = 0.0
+            return (self._ls[:n], self._lf[:n], self._lt[:n])
         # multiply-shift in int64 (no u32 overflow), masked back to 32 bits
         np.multiply(self._lf[:n], _MULT, out=self._ls[:n], casting="unsafe")
         np.bitwise_and(self._ls[:n], 0xFFFFFFFF, out=self._ls[:n])
@@ -426,7 +438,9 @@ def block_from_arrays(
     if not np.isfinite(rows).all():
         raise FrameError("non-finite feature values", "poison")
     entity = None
-    spec = getattr(scorer, "ledger_spec", None)
+    spec = getattr(scorer, "ledger_spec", None) or getattr(
+        scorer, "wide_spec", None
+    )
     if entity_fps is not None and spec is not None:
         fp = np.ascontiguousarray(entity_fps, np.uint32)
         if fp.shape != (n,):

@@ -143,6 +143,32 @@ scorer_explain_fused = Gauge(
     "scorer_wire_fused). Stays 1 when explanation is off or unrequested",
     registry=registry,
 )
+scorer_wide_fused = Gauge(
+    "scorer_wide_fused",
+    "1 while the served WIDE family's hashed-cross contributions ride the "
+    "fused flush (broadside); 0 when a wide champion serves through the "
+    "split/solo path — its crosses are then silently DROPPED and every "
+    "row scores base-only through the null fold (WideFlushUnfused alert "
+    "input, the wide sibling of scorer_wire_fused). Stays 1 when the "
+    "served family is not wide",
+    registry=registry,
+)
+wide_model_shards = Gauge(
+    "wide_model_shards",
+    "Model-axis size of the 2-D serving mesh the wide family's "
+    "cross-weight table column-shards over (1 = single-device gather; "
+    "broadside MESH_MODEL_DEVICES)",
+    registry=registry,
+)
+wide_bucket_occupancy = Gauge(
+    "wide_bucket_occupancy",
+    "Fraction of non-zero learned cross weights in each model-axis column "
+    "slice of the served wide table (refreshed on swap; WideShardSkew "
+    "alert input — a degenerate hash mix concentrates the learned mass "
+    "on few shards and starves the rest)",
+    ["model_shard"],
+    registry=registry,
+)
 scorer_served_family = Gauge(
     "scorer_served_family",
     "1 for the model family the micro-batcher is currently flushing "

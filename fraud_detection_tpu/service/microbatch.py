@@ -235,6 +235,14 @@ class MicroBatcher:
             )
         self._explain_fused: bool | None = None
         metrics.scorer_explain_fused.set(1)
+        # broadside: whether a served WIDE family's crosses ride the fused
+        # flush. Same latch discipline; starts at 1 (nothing demoted) so
+        # narrow-family deployments never read as a demotion. Keyed on
+        # (fused, slot version) — not just the bool — so a wide→wide
+        # promotion re-exports the NEW champion's table occupancy, and a
+        # wide→narrow swap clears a latched demotion (("off",) state).
+        self._wide_state: tuple | None = None
+        metrics.scorer_wide_fused.set(1)
         # evergreen: which model family the flushes serve — latched like
         # the fusion gauges (one string compare per flush), transitioning
         # on hot swap so the dashboard family label follows promotions
@@ -607,6 +615,59 @@ class MicroBatcher:
                 self.explain_k,
             )
 
+    def _note_wide_fused(self, fused: bool, scorer, version=None) -> None:
+        """Export + (on transition) log whether a served WIDE family's
+        hashed-cross contributions ride the fused flush. A wide champion
+        on the split/solo path scores base-only through the null fold —
+        its entire learned signal surface silently dropped — so the
+        demotion must be loud: logged once at startup/transition, latched
+        on ``scorer_wide_fused`` (the WideFlushUnfused alert input). The
+        latch is keyed on (fused, slot version) so a wide→wide promotion
+        — same fused state, new table — still refreshes the per-model-
+        shard occupancy gauges (host-side, once per swap — the
+        WideShardSkew input)."""
+        state = (fused, version)
+        if state == self._wide_state:
+            return
+        self._wide_state = state
+        metrics.scorer_wide_fused.set(1 if fused else 0)
+        if not fused:
+            log.warning(
+                "WIDE family served WITHOUT the fused flush: hashed-cross "
+                "contributions are dropped and every row scores base-only "
+                "through the null fold. scorer_wide_fused=0 exported — see "
+                "the WideFlushUnfused alert"
+            )
+            return
+        drift = getattr(self.watchtower, "drift", None)
+        n_model = int(getattr(drift, "n_model", 1) or 1)
+        metrics.wide_model_shards.set(n_model)
+        try:
+            for s, frac in enumerate(scorer.table_occupancy(n_model)):
+                metrics.wide_bucket_occupancy.labels(str(s)).set(frac)
+        except Exception:
+            log.debug("wide occupancy export failed", exc_info=True)
+        log.info(
+            "wide family rides the fused flush (%d model shard(s))", n_model
+        )
+
+    def _note_wide_off(self) -> None:
+        """The served family is not wide: ``scorer_wide_fused`` documents
+        "stays 1 when the served family is not wide", so a demotion
+        latched by a PREVIOUS wide champion must not keep paging
+        WideFlushUnfused after a wide→narrow swap. The stale per-shard
+        occupancy series are dropped and ``wide_model_shards`` zeroed so
+        WideShardSkew (guarded on shards > 1) goes quiet too."""
+        if self._wide_state == ("off",):
+            return
+        self._wide_state = ("off",)
+        metrics.scorer_wide_fused.set(1)
+        metrics.wide_model_shards.set(0)
+        try:
+            metrics.wide_bucket_occupancy.clear()
+        except Exception:
+            log.debug("wide occupancy clear failed", exc_info=True)
+
     def _explain_k_for(self, spec, scorer) -> int:
         """The explain leg's k for this flush: 0 when explanation is off or
         the spec carries no fused explain params (demotion, noted loudly),
@@ -688,6 +749,13 @@ class MicroBatcher:
             and getattr(target[1], "ledger", None) is not None
             and getattr(target[0], "ledger", None) is not None
         )
+        # broadside: the wide family's hashed-cross flush — the spec's
+        # (CrossSpec, wide_table) pair rides the dispatch, the per-row
+        # entity fingerprints stage into the slot's lf/lh lanes
+        wide_on = (
+            target is not None
+            and getattr(target[1], "wide", None) is not None
+        )
         placement = None
         if ledger_on and getattr(target[0], "n_shards", 1) > 1:
             # sharded ledger flush: rows must land in the row range of the
@@ -735,11 +803,14 @@ class MicroBatcher:
                 else:
                     hx = scorer.stage_items_placed(slot, batch, placement)
                 ledger_rows = None
+                wide_rows = None
                 n_null = 0
                 if ledger_on:
                     hx, ledger_rows, n_null = self._stage_ledger(
                         scorer, slot, batch, placement
                     )
+                elif wide_on:
+                    wide_rows = self._stage_wide(scorer, slot, batch)
                 t_padded = time.perf_counter()
                 explain_k = 0
                 if target is not None:
@@ -754,6 +825,8 @@ class MicroBatcher:
                         explain_args=spec.explain_args if explain_k else None,
                         explain_k=explain_k,
                         ledger_rows=ledger_rows,
+                        wide_args=spec.wide if wide_on else None,
+                        wide_rows=wide_rows,
                     )
                     device_calls = 1
                     if ledger_on and n_null:
@@ -840,6 +913,44 @@ class MicroBatcher:
             device_calls, monitor_rows, monitor_scores, holdover,
             monitor_reasons,
         )
+
+    def _stage_wide(self, scorer, slot, batch: list[tuple]):
+        """Fill the slot's fingerprint/has-entity lanes for the broadside
+        wide flush from the queue items' entity triples (None = no entity
+        → the null path: the entire cross block zeroes for that row).
+        Lighter than the ledger staging — no table slots, no timestamps,
+        no placement (the wide table is column-sharded over the MODEL
+        axis; any row may land on any data shard). Returns the
+        ``(fingerprint, has_entity)`` device pair."""
+        # graftcheck: hot-path — the lf/lh lanes are preallocated pool
+        # state (ensure_ledger counts first-time materialization)
+        import jax.numpy as jnp
+
+        slot.ensure_ledger()
+        slot.lf[:] = 0
+        slot.lh[:] = 0.0
+        pos: list = []
+        fvals: list = []
+        off = 0
+        for item in batch:
+            rows = item[0]
+            ent = item[3]
+            if rows.ndim == 2:
+                k = rows.shape[0]
+                if ent is not None:
+                    sl = slice(off, off + k)
+                    slot.lf[sl] = ent[1]
+                    slot.lh[sl] = ent[1] != 0
+                off += k
+                continue
+            if ent is not None:
+                pos.append(off)
+                fvals.append(ent[1])
+            off += 1
+        if pos:
+            slot.lf[pos] = fvals
+            slot.lh[pos] = 1.0
+        return jnp.asarray(slot.lf), jnp.asarray(slot.lh)
 
     def _stage_ledger(self, scorer, slot, batch: list[tuple], placement):
         """Fill the slot's ledger staging buffers from the queue items'
@@ -960,11 +1071,19 @@ class MicroBatcher:
             else:
                 scorer, source, version = self.scorer, None, None
             self._note_family(scorer)
+            if getattr(scorer, "wide_spec", None) is None:
+                # not wide (narrow, GBT, legacy): un-latch a previous wide
+                # champion's demotion and drop its stale occupancy series
+                self._note_wide_off()
             loop = asyncio.get_running_loop()
             explain_out = None
             if hasattr(scorer, "stage_rows") and hasattr(scorer, "_score_padded"):
                 target = self._fused_target(scorer)
                 fused = target is not None
+                if getattr(scorer, "wide_spec", None) is not None:
+                    # a wide champion off the fused path drops its crosses
+                    # (base-only null-fold scores) — latch that loudly
+                    self._note_wide_fused(fused, scorer, version)
                 (
                     probs, explain_out, t_flush, t_padded, t_synced,
                     t_fetched, device_calls, monitor_rows, monitor_scores,

@@ -114,13 +114,18 @@ class XaiWorker:
         if not idxs or len(idxs) != vals.shape[0] or max(idxs) >= phi.shape[0]:
             return True  # malformed/absent payload: nothing to check
         atol = self._explain_atol
-        spec = getattr(getattr(self, "model", None), "ledger_spec", None)
+        model = getattr(self, "model", None)
+        spec = getattr(model, "ledger_spec", None) or getattr(
+            model, "wide_spec", None
+        )
         if spec is not None:
-            # ledger-widened family: serve-time attributions for the K
-            # velocity columns used the LIVE entity aggregates, which this
-            # worker cannot reproduce (its backfill explains through the
-            # null slot) — compare base-schema indices only, and skip the
-            # top-1 check when a velocity feature led the serve ranking
+            # widened family (ledger velocity columns / broadside hashed
+            # crosses): serve-time attributions for the widened columns
+            # used LIVE device state (entity aggregates / the entity
+            # fingerprint's cross gather), which this worker cannot
+            # reproduce (its backfill explains through the null path) —
+            # compare base-schema indices only, and skip the top-1 check
+            # when a widened column led the serve ranking
             keep = [j for j, i in enumerate(idxs) if i < spec.n_base]
             if not keep:
                 return True

@@ -76,8 +76,17 @@ WRAP_TARGETS: dict[str, list[tuple[str, str]]] = {
     "ledger.flush": [
         ("fraud_detection_tpu.monitor.drift", "_fused_flush_ledger")
     ],
+    "broadside.flush": [
+        ("fraud_detection_tpu.monitor.drift", "_fused_flush_wide")
+    ],
     "mesh.sharded_flush": [
         ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush")
+    ],
+    "mesh.broadside_flush": [
+        ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush_wide")
+    ],
+    "mesh.wide_update": [
+        ("fraud_detection_tpu.mesh.retrain", "_wide_update_epoch")
     ],
     "mesh.ledger_flush": [
         ("fraud_detection_tpu.mesh.shardflush", "_sharded_flush_ledger")

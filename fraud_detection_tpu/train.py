@@ -100,6 +100,7 @@ def train(
     gbt_config: GBTConfig | None = None,
     checkpoint_dir: str | None = None,
     ledger: bool | None = None,
+    wide: bool | None = None,
 ) -> dict:
     """Run the full pipeline; returns a metrics dict."""
     t0 = time.time()
@@ -150,6 +151,45 @@ def train(
             len(LEDGER_FEATURE_NAMES),
         )
 
+    # ---- broadside (the wide family): hashed feature crosses ----
+    # WIDE_ENABLED=1 / --wide fits the wide family: multiply-shift hashed
+    # crosses of (entity × amount-bucket / hour / sign-pattern) at
+    # d = WIDE_BUCKETS feeding the linear scorer through learned bucket
+    # weights, trained with the 2-D (data × model) sharded update
+    # (mesh/retrain.wide_sgd_fit) and stamped as wide_params.npz beside
+    # the weights. The serving tier widens automatically on load.
+    wide_spec = None
+    wide_fps = None
+    use_wide = wide if wide is not None else config.wide_enabled()
+    if use_wide and (use_ledger or model_family != "logistic"):
+        log.warning("wide family requires the plain logistic base; off")
+        use_wide = False
+    if use_wide:
+        from fraud_detection_tpu.ledger.replay import synthesize_entities
+        from fraud_detection_tpu.ops.crosses import (
+            entity_fingerprints,
+            spec_from_config,
+        )
+
+        wide_spec = spec_from_config(x.shape[1])
+        ents, _ = synthesize_entities(
+            x, feature_names, seed, config.ledger_synth_events_per_entity()
+        )
+        wide_fps = entity_fingerprints(ents, x.shape[0])
+        if use_smote:
+            log.info("wide family: SMOTE off (crosses are discrete), "
+                     "class_weight=balanced instead")
+            use_smote = False
+        # --no-smote must not mean "neither": the ~0.2%-positive fraud CSV
+        # collapses toward the majority class under uniform weights, and
+        # the conductor's wide retrain always fits balanced — keep the
+        # offline and online objectives identical.
+        class_weight = class_weight or "balanced"
+        log.info(
+            "wide family on: %d hashed-cross buckets, %d templates",
+            wide_spec.buckets, wide_spec.n_cross,
+        )
+
     x_train, y_train = x[train_idx], y[train_idx]
     x_test, y_test = x[test_idx], y[test_idx]
 
@@ -196,8 +236,10 @@ def train(
 
         # ---- CV with SMOTE inside each fold (no leakage) ----
         cv_aucs = []
+        if use_wide:
+            run.set_tag("cv_skipped", "wide family: single 2-D sharded fit")
         for fold, (tr, va) in enumerate(
-            stratified_kfold_indices(y_train, n_folds, seed)
+            () if use_wide else stratified_kfold_indices(y_train, n_folds, seed)
         ):
             x_tr, y_tr = xs_train[tr], y_train[tr]
             try:
@@ -237,7 +279,46 @@ def train(
             if use_smote
             else (xs_train, y_train)
         )
-        if model_family == "gbt":
+        wide_table = None
+        if use_wide:
+            # the 2-D (data × model) sharded wide fit: grads psum_scatter
+            # on the data axis, the cross table column-owned on the model
+            # axis (2004.13336 in 2-D — the conductor's retrain runs the
+            # identical program on the same mesh)
+            from fraud_detection_tpu.mesh.retrain import (
+                wide_sgd_fit,
+                wide_training_mesh,
+            )
+            from fraud_detection_tpu.ops.crosses import cross_indices
+
+            idx_train = cross_indices(
+                x_train, wide_fps[train_idx], wide_spec
+            )
+            params, wide_table = wide_sgd_fit(
+                np.asarray(x_fin), idx_train,
+                (wide_fps[train_idx] != 0).astype(np.float32),
+                np.asarray(y_fin), wide_spec, epochs=20, seed=seed,
+                class_weight=class_weight,
+                mesh=wide_training_mesh(),
+            )
+            from fraud_detection_tpu.ops.crosses import widen_with_crosses
+
+            xw_test = widen_with_crosses(
+                x_test, wide_fps[test_idx], wide_table, wide_spec
+            )
+            # score the widened block exactly as serving would: scaled
+            # base columns + raw cross contributions through the widened
+            # coef (predict_proba on the wide scorer below)
+            from fraud_detection_tpu.ops.crosses import widen_scaler
+
+            wide_scaler = widen_scaler(scaler, wide_spec.n_cross)
+            feature_names = list(feature_names) + list(wide_spec.cross_names)
+            model = FraudLogisticModel(
+                params, wide_scaler, feature_names,
+                wide_spec=wide_spec, wide_table=wide_table,
+            )
+            test_scores = np.asarray(model.scorer.predict_proba(xw_test))
+        elif model_family == "gbt":
             gmodel, used_cfg = _fit_gbt(
                 x_fin, y_fin, gbt_config=gbt_config, spw=spw
             )
@@ -274,9 +355,21 @@ def train(
         # into its weights and consumes raw rows, so the drift reference must
         # bin what the microbatcher actually sees. Score reference comes from
         # the held-out test scores (the distribution a healthy model emits).
-        profile = build_baseline_profile(
-            x_train, test_scores, feature_names=feature_names
-        )
+        if use_wide:
+            # the drift baseline covers the WIDENED block (base + cross
+            # contributions) — the distribution the fused wide flush bins
+            from fraud_detection_tpu.ops.crosses import widen_with_crosses
+
+            profile = build_baseline_profile(
+                widen_with_crosses(
+                    x_train, wide_fps[train_idx], wide_table, wide_spec
+                ),
+                test_scores, feature_names=feature_names,
+            )
+        else:
+            profile = build_baseline_profile(
+                x_train, test_scores, feature_names=feature_names
+            )
         run.log_metric("monitor_profile_rows", profile.n_rows)
 
         # ---- artifacts: native + joblib interchange ----
@@ -295,6 +388,12 @@ def train(
             model = FraudGBTModel(
                 gmodel, feature_names, scaler=scaler, background=x_train[bg_idx]
             )
+            model.save(out_dir)
+            model.save(model_artifact)
+        elif use_wide:
+            # model was built above (the widened scorer scored the test
+            # slice); save() stamps wide_params.npz + the widened
+            # calibration beside the weights in both destinations
             model.save(out_dir)
             model.save(model_artifact)
         else:
@@ -377,6 +476,12 @@ def main(argv=None):
     ap.add_argument("--no-smote", action="store_true")
     ap.add_argument("--no-register", action="store_true")
     ap.add_argument(
+        "--wide", action="store_true",
+        help="fit the broadside wide family: hashed feature crosses at "
+        "d=WIDE_BUCKETS over a 2-D (data x model) mesh "
+        "(fraud_detection_tpu/ops/crosses); also WIDE_ENABLED=1",
+    )
+    ap.add_argument(
         "--ledger", action="store_true",
         help="widen the feature block with the ledger's per-entity "
         "velocity aggregates (replayed through the serving body — see "
@@ -408,6 +513,7 @@ def main(argv=None):
             model_family=args.model,
             checkpoint_dir=args.checkpoint_dir,
             ledger=True if args.ledger else None,
+            wide=True if args.wide else None,
         )
 
     if args.profile_dir:

@@ -765,7 +765,9 @@ def test_shadow_comparison_handles_widened_challenger():
 
     model, profile, spec, state, _, _ = _widened_model()
     ex = _challenger_explainer(model)
-    assert ex is not None and ex[2] is not None  # widened → null triple
+    assert callable(ex)  # family-agnostic phi over explain_batch
+    # base-width rows explain through the null slot → WIDENED phi
+    assert ex(np.zeros((2, D), np.float32)).shape[1] == spec.n_features
     sh = ShadowScorer(model.scorer, profile, sample_rate=1.0, explainer=ex)
     rng = np.random.default_rng(0)
     rows = rng.standard_normal((32, D)).astype(np.float32)  # BASE width

@@ -328,6 +328,53 @@ def test_grafana_ledger_panels_present():
         assert "watchtower_shadow_reason_divergence" in text, rel
 
 
+def test_wide_rules_file_ships():
+    """The broadside contract (ISSUE 13): wide-alerts.yml ships
+    promlint-clean with the fusion-state + shard-skew alerts."""
+    path = os.path.join(RULES_DIR, "wide-alerts.yml")
+    assert os.path.exists(path)
+    assert promlint.lint_rules_file(path) == []
+    with open(path) as f:
+        text = f.read()
+    assert "WideFlushUnfused" in text
+    assert "WideShardSkew" in text
+    assert "scorer_wide_fused == 0" in text  # state-gauge alert, like
+    # WireFormatUnfused — fires on the configured state pre-traffic
+
+
+def test_wide_alert_metrics_exist_in_registry():
+    exported = _exported_metric_names()
+    with open(os.path.join(RULES_DIR, "wide-alerts.yml")) as f:
+        text = f.read()
+    referenced = set(
+        re.findall(r"\b((?:wide|scorer_wide)_[a-z_]+)\b", text)
+    )
+    # wide_params is the artifact sidecar named in alert prose, not a metric
+    referenced -= {"wide_alerts", "wide_params"}
+    assert referenced, "wide rules reference no wide metrics?"
+    missing = {
+        name for name in referenced
+        if name not in exported
+        and name.removesuffix("_total") not in exported
+        and f"{name}_total" not in exported
+    }
+    assert not missing, f"alert rules reference unexported metrics: {missing}"
+
+
+def test_grafana_broadside_row_present():
+    """Both dashboards carry the broadside row (fusion state + per-model-
+    shard occupancy — the WideFlushUnfused / WideShardSkew inputs)."""
+    for rel in (
+        "grafana_dashboard.json",
+        os.path.join("grafana_provisioning", "dashboards", "fraud-tpu.json"),
+    ):
+        with open(os.path.join(MONITORING, rel)) as f:
+            text = f.read()
+        assert "scorer_wide_fused" in text, rel
+        assert "wide_bucket_occupancy" in text, rel
+        assert "wide_model_shards" in text, rel
+
+
 def test_ingest_rules_file_ships():
     """The hyperloop contract (ISSUE 11): ingest-alerts.yml ships
     IngestParseDominates (+ the shed/frame-error capacity pages) and is
