@@ -69,7 +69,7 @@ SECTION_BUDGETS = {
     "wide_flush": 300,
     "telemetry": 240,
     "lifecycle": 240,
-    "scenarios": 720,  # 12 scenarios since gbt_explain_under_burst joined
+    "scenarios": 780,  # 13 scenarios since slo_burn_under_shed joined
     "dp_train": 360,
     "online_load": 300,
     "online_e2e": 300,

@@ -1,0 +1,162 @@
+"""Monitoring-contract lint: alert expressions must reference live metrics.
+
+The dead-series alert class: a rule file names a metric nothing exports —
+a rename on one side, a typo on the other — and the alert silently never
+fires (an absent series is just an empty vector to PromQL, not an error).
+The registry-contract tests in ``tests/test_monitoring_configs.py`` catch
+this at test time by importing the live registry; this rule catches it at
+LINT time, purely from source: when graftcheck walks
+``service/metrics.py`` it collects every metric name registered there (AST
+only — no imports, no prometheus_client), then cross-checks every ``expr:``
+in ``monitoring/prometheus/rules/*.yml`` against that set.
+
+Token extraction is deliberately conservative: quoted strings, label
+selectors ``{...}``, range windows ``[5m]``, grouping clauses
+(``by (...)``/``on (...)``/...), and function calls are stripped first;
+what remains counts as a metric reference only when it contains an
+underscore (every metric this repo exports does; bare PromQL keywords and
+label names like ``le`` never do). Counter ``_total`` and histogram
+``_bucket``/``_sum``/``_count`` suffixes are normalized before the
+membership check, mirroring Prometheus exposition.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+from typing import Iterator
+
+from fraud_detection_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Severity,
+    register_rule,
+)
+
+#: prometheus_client constructors whose first string arg registers a name.
+_METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary", "Info", "Enum"}
+
+_EXPR_RE = re.compile(r"^\s*expr:\s*(.+?)\s*$", re.M)
+_STRING_RE = re.compile(r"'[^']*'|\"[^\"]*\"")
+_SELECTOR_RE = re.compile(r"\{[^}]*\}")
+_RANGE_RE = re.compile(r"\[[^\]]*\]")
+_GROUP_RE = re.compile(
+    r"\b(?:by|without|on|ignoring|group_left|group_right)\s*\([^)]*\)"
+)
+_FUNC_RE = re.compile(r"\b[A-Za-z_][A-Za-z0-9_:]*\s*\(")
+_TOKEN_RE = re.compile(r"\b[A-Za-z_][A-Za-z0-9_:]*\b")
+
+#: underscore-bearing PromQL builtins / modifiers a conservative extractor
+#: could still catch (none of the repo's metric names collide with these).
+_PROMQL_WORDS = {
+    "group_left", "group_right", "bool", "offset", "unless",
+}
+
+_SUFFIXES = ("_bucket", "_sum", "_count", "_total")
+
+#: sanctioned exporter modules beside the shared service registry: the
+#: store server exports its ``fraud_store_*`` gauges from a module-local
+#: CollectorRegistry (the prom-foreign-registry rule sanctions exactly
+#: this), so its registrations count toward the alert contract too.
+_EXTRA_EXPORTERS = ("netserver.py",)
+
+
+def _normalize(name: str) -> str:
+    for sfx in _SUFFIXES:
+        if name.endswith(sfx):
+            return name[: -len(sfx)]
+    return name
+
+
+def registered_metric_names(tree: ast.AST) -> set[str]:
+    """Metric names registered by ``Counter/Gauge/Histogram(...)`` calls in
+    the module's AST (first positional string argument)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        ctor = getattr(fn, "id", None) or getattr(fn, "attr", None)
+        if ctor not in _METRIC_CTORS or not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            names.add(first.value)
+    return names
+
+
+def metric_tokens(expr: str) -> set[str]:
+    """Candidate metric names referenced by one PromQL expression."""
+    s = _STRING_RE.sub(" ", expr)
+    s = _SELECTOR_RE.sub(" ", s)
+    s = _RANGE_RE.sub(" ", s)
+    s = _GROUP_RE.sub(" ", s)
+    s = _FUNC_RE.sub(" ", s)  # drops the function NAME, keeps its args
+    out: set[str] = set()
+    for tok in _TOKEN_RE.findall(s):
+        if "_" in tok and tok not in _PROMQL_WORDS:
+            out.add(tok)
+    return out
+
+
+def _rules_dir_for(path: str) -> str | None:
+    """Walk up from the analyzed file to the repo root holding
+    ``monitoring/prometheus/rules`` (tests point the rule at fixture
+    trees the same way)."""
+    d = os.path.dirname(os.path.abspath(path))
+    for _ in range(8):
+        cand = os.path.join(d, "monitoring", "prometheus", "rules")
+        if os.path.isdir(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
+@register_rule(
+    "alert-metric-registered",
+    Severity.ERROR,
+    "alert rule expressions reference only metric names registered in "
+    "service/metrics.py (the dead-series alert class, caught at lint time)",
+)
+def check_alert_metrics_registered(mod: ModuleInfo) -> Iterator[Finding]:
+    rule = check_alert_metrics_registered.rule
+    if not mod.rel_path.replace(os.sep, "/").endswith("service/metrics.py"):
+        return
+    rules_dir = _rules_dir_for(mod.path)
+    if rules_dir is None:
+        return
+    registered = registered_metric_names(mod.tree)
+    if not registered:
+        return
+    for sibling in _EXTRA_EXPORTERS:
+        path = os.path.join(os.path.dirname(os.path.abspath(mod.path)), sibling)
+        try:
+            with open(path, encoding="utf-8") as f:
+                registered |= registered_metric_names(ast.parse(f.read()))
+        except (OSError, SyntaxError):
+            continue  # fixture trees need not ship every exporter
+    for yml in sorted(glob.glob(os.path.join(rules_dir, "*.yml"))):
+        try:
+            with open(yml, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        dead: set[str] = set()
+        for m in _EXPR_RE.finditer(text):
+            for tok in metric_tokens(m.group(1)):
+                if _normalize(tok) not in registered and tok not in registered:
+                    dead.add(tok)
+        if dead:
+            yield mod.finding(
+                rule,
+                ast.Module(body=[], type_ignores=[]),
+                f"{os.path.basename(yml)} references metric(s) not "
+                f"registered in service/metrics.py: {sorted(dead)} — the "
+                "alert would silently never fire (empty vector, not an "
+                "error)",
+            )

@@ -530,6 +530,86 @@ def recompile_storm_threshold() -> int:
 
 
 # --------------------------------------------------------------------------
+# Panopticon: fleet SLO engine + live roofline gauges (telemetry/slo,
+# telemetry/roofline)
+# --------------------------------------------------------------------------
+
+def slo_enabled() -> bool:
+    """``SLO_ENABLED=0`` turns off the host-side SLO engine (per-lane /
+    per-shard availability + latency objectives over multi-window sliding
+    counters; ``slo_burn_rate`` / ``slo_error_budget_remaining`` gauges and
+    ``/slo/status``). Default on — recording one outcome is two integer
+    adds under a lock."""
+    return env_flag("SLO_ENABLED") is not False
+
+
+def slo_availability_objective(series: str | None = None) -> float:
+    """``SLO_AVAILABILITY_OBJECTIVE`` — target availability (fraction of
+    requests answered without a shed/outage/internal error) per lane and
+    per shard. A per-series override wins when set:
+    ``SLO_AVAILABILITY_OBJECTIVE_JSON`` / ``_MSGPACK`` / ``_BINARY`` /
+    ``_SHARD`` (the shard override applies to every shard). Default
+    0.999."""
+    if series is not None:
+        key = series.upper().rstrip("0123456789")
+        v = os.environ.get(f"SLO_AVAILABILITY_OBJECTIVE_{key}")
+        if v:
+            return float(v)
+    return _get_float("SLO_AVAILABILITY_OBJECTIVE", 0.999)
+
+
+def slo_latency_objective(series: str | None = None) -> float:
+    """``SLO_LATENCY_OBJECTIVE`` — target fraction of requests completing
+    under ``SLO_LATENCY_P99_MS`` (an objective of 0.99 with the threshold
+    named p99 is the classic latency-SLO shape). Same per-series override
+    scheme as the availability objective. Default 0.99."""
+    if series is not None:
+        key = series.upper().rstrip("0123456789")
+        v = os.environ.get(f"SLO_LATENCY_OBJECTIVE_{key}")
+        if v:
+            return float(v)
+    return _get_float("SLO_LATENCY_OBJECTIVE", 0.99)
+
+
+def slo_latency_threshold_s() -> float:
+    """``SLO_LATENCY_P99_MS`` — the latency bound a request must beat to
+    count as good for the latency SLO. Default 250 ms (the
+    DeviceComputeStageSlow page threshold, end-to-end)."""
+    return _get_float("SLO_LATENCY_P99_MS", 250.0) / 1000.0
+
+
+def slo_fast_burn() -> float:
+    """``SLO_FAST_BURN`` — burn-rate multiple over which the fast-burn
+    alert pages (the SRE-workbook 14.4 = a 30-day budget gone in ~2 days,
+    scaled here to the 6h budget proxy window: a budget gone within
+    ~25 min)."""
+    return _get_float("SLO_FAST_BURN", 14.4)
+
+
+def slo_slow_burn() -> float:
+    """``SLO_SLOW_BURN`` — burn-rate multiple over which the slow-burn
+    alert warns (workbook 6)."""
+    return _get_float("SLO_SLOW_BURN", 6.0)
+
+
+def roofline_enabled() -> bool:
+    """``ROOFLINE_ENABLED=0`` turns off the live roofline layer: XLA
+    ``cost_analysis()`` capture on fused-program compiles and the
+    ``device_utilization_fraction{entrypoint}`` achieved-vs-peak gauges.
+    Default on — capture only runs when an executable actually compiles,
+    and the per-flush update is a dict lookup + one gauge set."""
+    return env_flag("ROOFLINE_ENABLED") is not False
+
+
+def device_peak_flops() -> float:
+    """``DEVICE_PEAK_FLOPS`` — the peak f32 FLOP/s the utilization gauges
+    divide by. 0 (default) = measure once at warmup with a blocked matmul
+    probe (an honest achievable-peak proxy on any backend; a TPU
+    deployment should pin the datasheet number here)."""
+    return _get_float("DEVICE_PEAK_FLOPS", 0.0)
+
+
+# --------------------------------------------------------------------------
 # Switchyard: sharded serving mesh (mesh/)
 # --------------------------------------------------------------------------
 

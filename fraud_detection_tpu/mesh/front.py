@@ -19,13 +19,20 @@ Draining is first-class (``drain()`` → no new picks, in-flight completes;
 ``revive()`` re-admits): the ShardOutage runbook's safe-restart primitive,
 and what the ``replica_burst`` chaos scenario exercises under load.
 
-Metrics note: the pre-existing process-wide scorer gauges
+Metrics note (panopticon): the scorer gauges/counters
 (``scorer_queue_depth``, ``scorer_effective_wait_seconds``,
-``scorer_device_calls_per_flush``) are written by every shard's flush
-loop, so with N shards they read as whichever shard flushed last — a
-per-flush sample, not an aggregate. Per-shard visibility lives in the
-``mesh_shard_*`` series (in-flight, rows, errors, health); alert on
-those for shard-level conditions.
+``scorer_device_calls_per_flush``, ``scorer_flushes_total``) carry a
+``shard`` label written by each shard's own micro-batcher — the PR-7
+"last-shard per-flush sample" limitation is gone. A shard transitioning
+to DEAD/DRAINING drops its per-shard GAUGE series
+(``metrics.drop_shard_gauges``) so dashboards never read a dead shard's
+last sample as live; a revive re-binds them. The front also feeds the
+fleet SLO engine: every routed attempt records availability (+ latency on
+success) under ``shard<N>``, so ``slo_burn_rate{slo="availability:shard1"}``
+attributes an outage to the shard that caused it. Admission backpressure
+(AdmissionFull) is flow control, not failure — it burns neither the
+shard's error budget nor its SLO; the client-visible shed is recorded at
+the LANE level where the 429/busy frame happens.
 """
 
 from __future__ import annotations
@@ -36,6 +43,7 @@ import time
 from fraud_detection_tpu import config
 from fraud_detection_tpu.range.faults import fire
 from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.telemetry import slo
 
 log = logging.getLogger("fraud_detection_tpu.mesh")
 
@@ -107,11 +115,24 @@ class ShardHandle:
         return False
 
     def set_state(self, state: str) -> None:
+        prev = self.state
         self.state = state
         self.dead_since = time.monotonic() if state == DEAD else None
         if state != HEALTHY:
             self.probation = False
         self._g_healthy.set(1 if state == HEALTHY else 0)
+        # panopticon stale-series discipline: a dead/draining shard's
+        # per-shard scorer GAUGES drop from the registry (its last
+        # queue-depth/wait/dispatch sample must not read as live); a
+        # revive re-binds the batcher's children (the dropped ones are
+        # orphaned from the registry and would export nothing).
+        shard_label = str(getattr(self.batcher, "shard_id", self.shard_id))
+        if state in (DEAD, DRAINING):
+            metrics.drop_shard_gauges(shard_label)
+        elif state == HEALTHY and prev != HEALTHY:
+            rebind = getattr(self.batcher, "rebind_shard_gauges", None)
+            if rebind is not None:
+                rebind()
 
     def to_dict(self) -> dict:
         return {
@@ -149,11 +170,24 @@ class ShardFront:
             if reopen_after is not None
             else config.mesh_shard_reopen_s()
         )
+        # panopticon: the front OWNS shard identity — assign it by index
+        # so batchers constructed without an explicit shard_id still get
+        # distinct per-shard series (all defaulting to "0" would let one
+        # shard's stale-series drop orphan every survivor's gauges)
+        for i, b in enumerate(batchers):
+            setter = getattr(b, "set_shard_id", None)
+            if setter is not None:
+                setter(i)
         self.shards = [
             ShardHandle(i, b, max_err) for i, b in enumerate(batchers)
         ]
         metrics.mesh_shards.set(len(self.shards))
         metrics.mesh_shards_healthy.set(len(self.shards))
+        # panopticon: materialize the per-shard SLO series up front so the
+        # burn/budget gauges exist (at 0) from the first scrape
+        eng = slo.engine()
+        if eng is not None:
+            eng.declare_shards(len(self.shards))
 
     # -- MicroBatcher-compatible surface ------------------------------------
     @property
@@ -289,6 +323,7 @@ class ShardFront:
             tried.add(h.shard_id)
             h.inflight += n_rows
             h._g_inflight.set(h.inflight)
+            t_attempt = time.perf_counter()
             try:
                 # fraud-range injection point: a chaos plan fails a named
                 # shard's scoring here (the kill-a-shard drill). Disarmed
@@ -300,11 +335,13 @@ class ShardFront:
             except AdmissionFull as e:
                 # backpressure, not failure: the shard is healthy but
                 # saturated — try the others without burning its error
-                # budget, and surface the shed if all are full
+                # budget (or its SLO), and surface the shed if all are
+                # full; the client-visible shed records at the lane edge
                 last_exc = e
                 continue
             except Exception as e:
                 last_exc = e
+                slo.record_shard(h.shard_id, False)
                 if h.note_error(e):
                     self._refresh_health_gauge()
                     log.error(
@@ -314,6 +351,9 @@ class ShardFront:
                     )
                 continue
             else:
+                slo.record_shard(
+                    h.shard_id, True, time.perf_counter() - t_attempt
+                )
                 # a half-open probe resolved: shard revived
                 if h.note_ok(n_rows):
                     self._refresh_health_gauge()

@@ -58,6 +58,12 @@ The suite (``run_scenario(name)``):
                           (never OOM, never unbounded p99), every admitted
                           row is answered, and the drift window bitwise-
                           matches a closed-loop replay of the same rows
+``slo_burn_under_shed``   panopticon: a Pareto burst drives real admission
+                          sheds; the SLO engine's fast-burn condition
+                          fires within its shortest window, the error
+                          budget drops, and after recovery traffic drains
+                          the windows the condition clears without
+                          flapping
 ========================  ==================================================
 """
 
@@ -1796,6 +1802,190 @@ def scenario_ingest_storm(
 
 # -- registry ----------------------------------------------------------------
 
+def scenario_slo_burn_under_shed(seed: int = 2033) -> ScenarioResult:
+    """Panopticon: a Pareto burst drives the bounded admission queue into
+    sheds; the fleet SLO engine's fast-burn condition fires within its
+    shortest window, the error-budget gauge drops, and — after recovery
+    traffic drains the windows — the condition clears WITHOUT flapping.
+
+    The engine runs with compressed windows (real thresholds, shorter
+    spans) so the whole burn/recover cycle fits a chaos budget; the
+    admission path, shed exceptions, and recording sites are the REAL
+    serving ones (MicroBatcher._admit → AdmissionFull → the lane-edge
+    record), not a simulation of them.
+    """
+    from fraud_detection_tpu.service.microbatch import (
+        AdmissionFull,
+        MicroBatcher,
+    )
+    from fraud_detection_tpu.telemetry.slo import SLOEngine
+
+    rng = np.random.default_rng(seed)
+    rm = build_model(seed=seed)
+    # compressed multi-window ladder: same 1:12:72 shape as 5m/1h/6h
+    windows = {"5m": 0.5, "1h": 2.0, "6h": 6.0}
+    eng = SLOEngine(windows=windows, bucket_s=0.05)
+    eng.declare_lanes(("json",))
+
+    class _SlowScorer:
+        """Legacy-protocol scorer with a per-flush stall: the drain rate
+        the burst must outrun to hit the admission bound (warmup/min_bucket
+        delegate; no staging protocol → the batcher's legacy flush path)."""
+
+        def __init__(self, inner, delay_s: float):
+            self.inner = inner
+            self.delay_s = delay_s
+            self.min_bucket = inner.min_bucket
+
+        def warmup(self, top):
+            self.inner.warmup(top)
+
+        def predict_proba(self, rows):
+            time.sleep(self.delay_s)
+            return self.inner.predict_proba(rows)
+
+    flap = AlertFlapDetector(min_hold_samples=3)
+    arrivals = ArrivalProcess(rate_hz=3000.0, window_s=0.01)
+
+    async def run() -> dict:
+        batcher = MicroBatcher(
+            scorer=_SlowScorer(rm.model.scorer, 0.02),
+            max_batch=8, max_wait_ms=1.0, max_inflight=1,
+            telemetry=False, admit_max_rows=8,
+        )
+        await batcher.start()
+        out: dict = {"sheds": 0, "scored": 0, "non_finite": 0}
+        try:
+            # phase 1 — healthy floor: sequential singles, all good
+            for r in rng.standard_normal((24, D)).astype(np.float32):
+                t0 = time.perf_counter()
+                s = await batcher.score(r)
+                eng.record("json", True, time.perf_counter() - t0)
+                if not np.isfinite(s):
+                    out["non_finite"] += 1
+            out["budget_before"] = eng.snapshot()[
+                "availability:json"]["budget_remaining"]
+            out["fast_before"] = eng.fast_burn("json")
+
+            # phase 2 — Pareto burst: concurrent waves sized off the
+            # arrival process, far over the admission bound → sheds
+            first_shed_t: float | None = None
+            first_fast_t: float | None = None
+            # ten Pareto-burst waves, each offered concurrently — far
+            # over the 8-row admission bound, so the tail of every wave
+            # sheds exactly as a saturated open-loop client would see
+            waves = [
+                max(24, n) for n in arrivals.batch_sizes(480, rng)
+            ][:10]
+            for wave_n in waves:
+                rows = rng.standard_normal((wave_n, D)).astype(np.float32)
+
+                async def one(r):
+                    t0 = time.perf_counter()
+                    try:
+                        s = await batcher.score(r)
+                    except AdmissionFull:
+                        eng.record("json", False)
+                        return None
+                    eng.record("json", True, time.perf_counter() - t0)
+                    return s
+
+                scores = await asyncio.gather(*(one(r) for r in rows))
+                shed = sum(1 for s in scores if s is None)
+                out["sheds"] += shed
+                out["scored"] += sum(1 for s in scores if s is not None)
+                out["non_finite"] += sum(
+                    1 for s in scores
+                    if s is not None and not np.isfinite(s)
+                )
+                now = time.monotonic()
+                if shed and first_shed_t is None:
+                    first_shed_t = now
+                fast = eng.fast_burn("json")
+                flap.sample(slo_fast_burn=fast)
+                if fast and first_fast_t is None:
+                    first_fast_t = now
+            out["budget_after_burst"] = eng.snapshot()[
+                "availability:json"]["budget_remaining"]
+            out["first_shed_t"] = first_shed_t
+            out["first_fast_t"] = first_fast_t
+
+            # phase 3 — recovery: light good traffic until the longest
+            # window drains; the condition must clear and stay clear
+            t_end = time.monotonic() + windows["6h"] + 1.0
+            cleared_samples = 0
+            while time.monotonic() < t_end:
+                r = rng.standard_normal(D).astype(np.float32)
+                t0 = time.perf_counter()
+                s = await batcher.score(r)
+                eng.record("json", True, time.perf_counter() - t0)
+                if not np.isfinite(s):
+                    out["non_finite"] += 1
+                fast = eng.fast_burn("json")
+                flap.sample(slo_fast_burn=fast)
+                if not fast:
+                    cleared_samples += 1
+                await asyncio.sleep(0.1)
+            out["fast_after_recovery"] = eng.fast_burn("json")
+            out["cleared_samples"] = cleared_samples
+            out["budget_after_recovery"] = eng.snapshot()[
+                "availability:json"]["budget_remaining"]
+            return out
+        finally:
+            await batcher.stop()
+
+    out = asyncio.run(run())
+    result = ScenarioResult("slo_burn_under_shed")
+    result.metrics = {
+        "sheds": out["sheds"],
+        "scored": out["scored"],
+        "budget_before": out["budget_before"],
+        "budget_after_burst": out["budget_after_burst"],
+        "budget_after_recovery": out["budget_after_recovery"],
+    }
+    result.add(InvariantOutcome(
+        "burst-drives-sheds",
+        out["sheds"] > 0 and out["scored"] > 0,
+        f"{out['sheds']} sheds, {out['scored']} scored — the burst must "
+        "genuinely hit the admission bound while traffic still flows",
+    ))
+    result.add(InvariantOutcome(
+        "scores-finite",
+        out["non_finite"] == 0,
+        f"{out['non_finite']} non-finite scores among admitted rows",
+    ))
+    result.add(InvariantOutcome(
+        "fast-burn-fires-within-window",
+        out["first_shed_t"] is not None
+        and out["first_fast_t"] is not None
+        and out["first_fast_t"] - out["first_shed_t"]
+        <= windows["5m"] + 1.0,
+        "fast burn fired "
+        + (
+            f"{out['first_fast_t'] - out['first_shed_t']:.2f}s after the "
+            f"first shed (window {windows['5m']}s)"
+            if out["first_fast_t"] is not None
+            and out["first_shed_t"] is not None
+            else "never"
+        ),
+    ))
+    result.add(InvariantOutcome(
+        "budget-drops-under-burn",
+        out["budget_after_burst"] < out["budget_before"],
+        f"budget {out['budget_before']} -> {out['budget_after_burst']} "
+        "across the burst",
+    ))
+    result.add(InvariantOutcome(
+        "burn-clears-after-recovery",
+        not out["fast_after_recovery"] and out["cleared_samples"] > 0,
+        "fast-burn condition "
+        + ("cleared" if not out["fast_after_recovery"] else "still firing")
+        + f" after recovery ({out['cleared_samples']} clear samples)",
+    ))
+    result.add(flap.check())
+    return result
+
+
 SCENARIOS = {
     "burst": scenario_burst,
     "drift_onset": scenario_drift_onset,
@@ -1809,6 +1999,7 @@ SCENARIOS = {
     "gbt_explain_under_burst": scenario_gbt_explain_under_burst,
     "poison_entity_state": scenario_poison_entity_state,
     "ingest_storm": scenario_ingest_storm,
+    "slo_burn_under_shed": scenario_slo_burn_under_shed,
 }
 
 #: scenarios that need a scratch directory as their first argument

@@ -71,10 +71,11 @@ from fraud_detection_tpu.service.tracing import setup_tracing, span
 from fraud_detection_tpu.service import tracing
 from fraud_detection_tpu.telemetry import (
     FlightRecorder,
+    RecorderSet,
     RequestTimeline,
     compile_sentinel,
 )
-from fraud_detection_tpu.telemetry import devicemem
+from fraud_detection_tpu.telemetry import devicemem, roofline, slo
 
 log = logging.getLogger("fraud_detection_tpu.api")
 
@@ -260,12 +261,26 @@ def create_app(
         # any model/scorer is constructed (GBTBatchScorer binds its predict
         # fn at init); the flight recorder rides the micro-batcher.
         compile_sentinel.install()
+        # Panopticon: the fleet SLO engine — declare the lane objectives up
+        # front so their burn/budget gauge series exist from first scrape.
+        slo_engine = slo.engine()
+        if slo_engine is not None:
+            slo_engine.declare_lanes()
         cap = config.flightrecorder_capacity()
-        state["flightrecorder"] = (
-            FlightRecorder(cap)
-            if cap > 0 and config.spyglass_enabled()
-            else None
-        )
+        recording = cap > 0 and config.spyglass_enabled()
+        n_shards = config.mesh_shards()
+        if recording and n_shards > 1:
+            # per-shard rings (one lock/ring per flush loop) behind the
+            # merged /debug/flightrecorder view; every record carries the
+            # shard that ran its flush
+            shard_recorders = [FlightRecorder(cap) for _ in range(n_shards)]
+            state["flightrecorder"] = RecorderSet(shard_recorders)
+        elif recording:
+            shard_recorders = [FlightRecorder(cap)]
+            state["flightrecorder"] = shard_recorders[0]
+        else:
+            shard_recorders = []
+            state["flightrecorder"] = None
         from fraud_detection_tpu.telemetry.profiler import DeviceProfiler
 
         state["profiler"] = DeviceProfiler()
@@ -340,7 +355,6 @@ def create_app(
             # promotions land on every shard between in-flight flushes,
             # and the shared scorer means one pre-warmed bucket ladder
             # covers them all.
-            n_shards = config.mesh_shards()
             if n_shards > 1:
                 from fraud_detection_tpu.mesh import ShardFront
 
@@ -349,9 +363,15 @@ def create_app(
                         MicroBatcher(
                             slot=state["slot"],
                             watchtower=state["watchtower"],
-                            recorder=state["flightrecorder"],
+                            # each shard appends to its OWN ring; the
+                            # merged dump attributes every flush to the
+                            # shard that ran it (panopticon)
+                            recorder=(
+                                shard_recorders[i] if shard_recorders else None
+                            ),
+                            shard_id=i,
                         )
-                        for _ in range(n_shards)
+                        for i in range(n_shards)
                     ]
                 )
             else:
@@ -467,10 +487,15 @@ def create_app(
     async def predict(req: Request) -> Response:
         metrics.predictions_submitted.inc()
         corr_id = req.state["correlation_id"]
+        t_req = time.perf_counter()
         model = _model()
         if model is None or state["batcher"] is None:
             # batcher can be None with a loaded model if its startup warmup
             # raised (e.g. device compile failure) — degraded, not a 500.
+            # An unservable request burns the json lane's availability
+            # budget (panopticon): this 503 is exactly what the SLO exists
+            # to count.
+            slo.record_lane("json", False)
             raise HTTPError(503, "model not loaded")
         t_parse = time.perf_counter()
         try:
@@ -535,6 +560,7 @@ def create_app(
                 except AdmissionFull as e:
                     # bounded admission queue at capacity: shed with the
                     # 429 + Retry-After backpressure contract
+                    slo.record_lane("json", False)
                     return _admission_shed(e, _LANE_JSON_SHED)
                 except NoHealthyShards as e:
                     # every switchyard shard dead/draining: a known,
@@ -543,12 +569,21 @@ def create_app(
                     # never a generic 500. The half-open probe re-admits
                     # a rested shard within ~MESH_SHARD_REOPEN_S.
                     log.error("[%s] no healthy shards: %s", corr_id, e)
+                    slo.record_lane("json", False)
                     return _unavailable(
                         "no healthy scoring shards",
                         str(e),
                         max(int(config.mesh_shard_reopen_s()), 1),
                     )
+                except Exception:
+                    # internal scoring failure (→ 500): the WORST outage
+                    # class must burn availability budget — an SLO blind
+                    # to 500s would sleep through the incident it exists
+                    # to page on
+                    slo.record_lane("json", False)
+                    raise
             _LANE_JSON_ROWS.inc()
+            slo.record_lane("json", True, time.perf_counter() - t_req)
             if timeline is not None:
                 # re-emit the stage decomposition as child spans of this
                 # predict span (explicit timestamps from the timeline)
@@ -647,13 +682,20 @@ def create_app(
             req.headers.get("content-type", "").split(";")[0].strip().lower()
         )
         t_parse = time.perf_counter()
+        # panopticon trace propagation: a frame's trace field (or the
+        # standard HTTP traceparent header) links this lane's server span
+        # to the client's trace, exactly like the socket lane
+        trace = req.headers.get("traceparent")
+        if trace is not None and not tracing.parse_traceparent(trace):
+            trace = None
         if ctype == "application/x-fraud-frame":
             lane = "binary"
             try:
-                slot, n, entity = binlane.decode_frame_body(
+                slot, n, entity, frame_trace = binlane.decode_frame_body(
                     scorer, req.body, max_rows,
                     dequant=_ingest_scale(model),
                 )
+                trace = frame_trace or trace
             except binlane.FrameError as e:
                 metrics.ingest_frame_errors.labels(e.kind).inc()
                 raise HTTPError(422, str(e)) from e
@@ -701,13 +743,27 @@ def create_app(
                     IngestBlock(slot, n, entity), timeline
                 )
             except AdmissionFull as e:
+                slo.record_lane(lane, False)
                 return _admission_shed(e, metrics.ingest_shed.labels(lane))
             except NoHealthyShards as e:
+                slo.record_lane(lane, False)
                 return _unavailable(
                     "no healthy scoring shards", str(e),
                     max(int(config.mesh_shard_reopen_s()), 1),
                 )
+            except Exception:
+                # internal scoring failure (→ 500) burns the lane's
+                # availability budget, matching the socket lane
+                slo.record_lane(lane, False)
+                raise
             metrics.ingest_rows.labels(lane).inc(n)
+            slo.record_lane(lane, True, time.perf_counter() - t_parse)
+            if trace is not None and tracing._tracer is not None:
+                with tracing.span(
+                    "ingest.frame", traceparent=trace, lane=lane, rows=n
+                ):
+                    if timeline is not None:
+                        tracing.emit_stage_spans(timeline)
             if lane == "binary":
                 return Response(
                     binlane.encode_response_body(slot, n, ek),
@@ -970,6 +1026,29 @@ def create_app(
         except _STORE_OUTAGE_ERRORS as e:
             return _store_unavailable("lifecycle status", e)
 
+    @app.get("/slo/status")
+    async def slo_status(req: Request) -> Response:
+        """Panopticon: the fleet SLO engine's live state — per-objective
+        burn rates over the 5m/1h/6h windows, error budget remaining, the
+        declared objectives, and the roofline's per-program utilization.
+        The docs/runbooks/SLOBurnRate.md first stop when a burn alert
+        fires. ``enabled: false`` when SLO_ENABLED=0."""
+        eng = slo.engine()
+        if eng is None:
+            return Response({"enabled": False, "slos": {}})
+        snap = await asyncio.to_thread(eng.export_gauges)
+        return Response(
+            {
+                "enabled": True,
+                "latency_threshold_s": eng.latency_threshold_s,
+                "windows": eng.windows,
+                "fast_burn_threshold": config.slo_fast_burn(),
+                "slow_burn_threshold": config.slo_slow_burn(),
+                "slos": snap,
+                "roofline": roofline.snapshot(),
+            }
+        )
+
     @app.get("/debug/flightrecorder")
     async def flightrecorder(req: Request) -> Response:
         """Spyglass flight recorder dump: the last N scored requests with
@@ -981,14 +1060,16 @@ def create_app(
                 {"enabled": False, "records": [],
                  "hint": "FLIGHTRECORDER_CAPACITY=0 or SPYGLASS_ENABLED=0"}
             )
-        return Response(
-            {
-                "enabled": True,
-                "capacity": rec.capacity,
-                "total_recorded": rec.total_recorded,
-                "records": rec.dump(),
-            }
-        )
+        body = {
+            "enabled": True,
+            "capacity": rec.capacity,
+            "total_recorded": rec.total_recorded,
+            # merged view under MESH_SHARDS>1: per-shard rings, newest
+            # first, every record carrying the shard that ran its flush
+            "shards": len(getattr(rec, "recorders", (rec,))),
+            "records": rec.dump(),
+        }
+        return Response(body)
 
     @app.post("/admin/profile")
     async def admin_profile(req: Request) -> Response:
@@ -1057,6 +1138,12 @@ def create_app(
         def _telemetry_refresh():
             devicemem.refresh()
             compile_sentinel.refresh_storm_gauges()
+            # panopticon: re-derive the SLO burn/budget gauges from the
+            # sliding counters so scrapes see current rates (and a burn
+            # clears as its window drains even with no new traffic)
+            eng = slo.engine()
+            if eng is not None:
+                eng.export_gauges()
 
         try:
             await asyncio.to_thread(_telemetry_refresh)

@@ -87,6 +87,8 @@ from fraud_detection_tpu.service import metrics
 from fraud_detection_tpu.service.errors import ProtocolError
 from fraud_detection_tpu.service.microbatch import AdmissionFull, IngestBlock
 from fraud_detection_tpu.service.wire import _HDR, StalledPeerError
+from fraud_detection_tpu.service import tracing
+from fraud_detection_tpu.telemetry import slo
 from fraud_detection_tpu.telemetry.timeline import RequestTimeline
 
 log = logging.getLogger("fraud_detection_tpu.binlane")
@@ -99,6 +101,13 @@ LAYOUT_INT8 = 2
 
 FLAG_ENTITY = 0x01
 FLAG_TS = 0x02
+#: panopticon: one optional per-FRAME W3C ``traceparent`` column (a fixed
+#: 64-byte NUL-padded ascii field after the ts column) — binary-lane
+#: frames link server spans to the client's trace exactly like the JSON
+#: lane's traceparent header, so a frame's stage decomposition lands in
+#: the same distributed trace as the rest of the request's journey.
+FLAG_TRACE = 0x04
+TRACE_LEN = 64
 
 _FRAME = struct.Struct(">HBBHBxI")  # magic, version, layout, d, flags, n
 _RESP = struct.Struct(">HBBBxI")    # magic, version, status, explain_k, n
@@ -193,6 +202,7 @@ def encode_frame(
     scale: np.ndarray | None = None,
     layout: int = LAYOUT_F32,
     length_prefix: bool = True,
+    traceparent: str | None = None,
 ) -> bytes:
     """Client-side frame encoder (also the bench/test reference). ``scale``
     is required for :data:`LAYOUT_INT8` (the server's published dequant
@@ -224,17 +234,38 @@ def encode_frame(
             np.ascontiguousarray(timestamps, np.float64)
             .astype("<f8", copy=False).tobytes()
         )
+    if traceparent is not None:
+        tp = traceparent.encode("ascii")
+        if len(tp) > TRACE_LEN:
+            raise ValueError("traceparent longer than the 64-byte field")
+        flags |= FLAG_TRACE
+        cols.append(tp.ljust(TRACE_LEN, b"\0"))
     payload = _FRAME.pack(MAGIC, VERSION, layout, d, flags, n) + b"".join(cols)
     if length_prefix:
         return _HDR.pack(len(payload)) + payload
     return payload
 
 
-def _payload_sizes(layout: int, flags: int, d: int, n: int) -> tuple[int, int, int]:
+def _payload_sizes(
+    layout: int, flags: int, d: int, n: int
+) -> tuple[int, int, int, int]:
     feat = n * d * (1 if layout == LAYOUT_INT8 else 4)
     ent = n * 4 if flags & FLAG_ENTITY else 0
     ts = n * 8 if flags & FLAG_TS else 0
-    return feat, ent, ts
+    tp = TRACE_LEN if flags & FLAG_TRACE else 0
+    return feat, ent, ts, tp
+
+
+def _parse_trace_field(buf) -> str | None:
+    """The frame's 64-byte traceparent field → a validated W3C header
+    string, or None (malformed context degrades to an unlinked span, never
+    a rejected frame — tracing is observability, not correctness)."""
+    raw = bytes(buf).split(b"\0", 1)[0]
+    try:
+        tp = raw.decode("ascii").strip()
+    except UnicodeDecodeError:
+        return None
+    return tp if tracing.parse_traceparent(tp) else None
 
 
 def _check_header(
@@ -251,7 +282,7 @@ def _check_header(
         raise FrameError(
             "int8 layout not served (no quantization calibration)", "layout"
         )
-    if flags & ~(FLAG_ENTITY | FLAG_TS):
+    if flags & ~(FLAG_ENTITY | FLAG_TS | FLAG_TRACE):
         raise FrameError(f"unknown flags 0x{flags:02x}", "flags")
     if d != expect_d:
         raise FrameError(
@@ -292,6 +323,7 @@ class _FrameDecoder:
         self._lf: np.ndarray | None = None
         self._lt: np.ndarray | None = None
         self._ei8: np.ndarray | None = None
+        self._tp = bytearray(TRACE_LEN)  # traceparent field scratch
 
     def _ensure(self, n: int) -> None:
         if self._ent_raw is None or self._ent_raw.shape[0] < n:
@@ -361,19 +393,21 @@ class _FrameDecoder:
         """Decode one frame payload (a bytes/memoryview, already length-
         checked) into ``slot`` + entity columns — the shared path for
         ``/ingest/batch`` bodies and tests; the socket lane splits the
-        same steps around ``recv_into``."""
-        feat, ent, ts = _payload_sizes(layout, flags, self.d, n)
-        if len(payload) != feat + ent + ts:
+        same steps around ``recv_into``. Returns ``(entity_cols,
+        traceparent)``."""
+        feat, ent, ts, tp = _payload_sizes(layout, flags, self.d, n)
+        if len(payload) != feat + ent + ts + tp:
             raise FrameError(
                 f"payload is {len(payload)} bytes, layout wants "
-                f"{feat + ent + ts}", "size",
+                f"{feat + ent + ts + tp}", "size",
             )
         mv = memoryview(payload)
         self.features_into(slot, n, layout, mv[:feat])
         ent_buf = mv[feat:feat + ent] if ent else None
-        ts_buf = mv[feat + ent:] if ts else None
+        ts_buf = mv[feat + ent:feat + ent + ts] if ts else None
+        trace = _parse_trace_field(mv[feat + ent + ts:]) if tp else None
         self.check_finite(slot, n)
-        return self.entity_cols(n, ent_buf, ts_buf)
+        return self.entity_cols(n, ent_buf, ts_buf), trace
 
     def reasons_u8(self, slot, n: int, k: int) -> np.ndarray:
         """The slot's int32 reason indices narrowed to the wire's u8 (d ≤
@@ -387,9 +421,9 @@ class _FrameDecoder:
 def decode_frame_body(scorer, body, max_rows: int, dequant=None):
     """Decode one HTTP-lane frame body (the socket frame's payload, no
     length prefix — Content-Length covered it) into a freshly acquired
-    staging slot. Returns ``(slot, n, entity_cols)``; the CALLER releases
-    the slot back to ``scorer.staging`` after encoding its response.
-    Raises :class:`FrameError` on a malformed body (→ 422)."""
+    staging slot. Returns ``(slot, n, entity_cols, traceparent)``; the
+    CALLER releases the slot back to ``scorer.staging`` after encoding its
+    response. Raises :class:`FrameError` on a malformed body (→ 422)."""
     if len(body) < _FRAME.size:
         raise FrameError(
             f"body of {len(body)} bytes is shorter than a frame header",
@@ -404,13 +438,13 @@ def decode_frame_body(scorer, body, max_rows: int, dequant=None):
     )
     slot = scorer.staging.acquire(_bucket(n, scorer.min_bucket))
     try:
-        entity = dec.decode_payload(
+        entity, trace = dec.decode_payload(
             slot, layout, flags, n, memoryview(body)[_FRAME.size:]
         )
     except Exception:
         scorer.staging.release(slot)
         raise
-    return slot, n, entity
+    return slot, n, entity, trace
 
 
 def block_from_arrays(
@@ -770,17 +804,18 @@ class BinaryIngestServer:
         magic, version, layout, d, flags, n = _FRAME.unpack(fhdr_buf)
         scorer = dec.scorer
         slot = None
+        trace = None
         consumed = 0  # payload bytes read so far (for rejected-frame drain)
         try:
             _check_header(
                 layout, flags, d, n, version, magic,
                 dec.d, self.max_rows, dec.dequant,
             )
-            feat, ent, ts = _payload_sizes(layout, flags, d, n)
-            if length != _FRAME.size + feat + ent + ts:
+            feat, ent, ts, tp = _payload_sizes(layout, flags, d, n)
+            if length != _FRAME.size + feat + ent + ts + tp:
                 raise FrameError(
                     f"length {length} disagrees with layout "
-                    f"({_FRAME.size + feat + ent + ts})", "size",
+                    f"({_FRAME.size + feat + ent + ts + tp})", "size",
                 )
             slot = scorer.staging.acquire(_bucket(n, scorer.min_bucket))
             # ZERO-COPY PARSE: the f32 feature block is received straight
@@ -810,6 +845,11 @@ class BinaryIngestServer:
                 if not _recv_into_exact(conn, ts_buf):
                     raise ProtocolError("connection closed mid-frame")
                 consumed += ts
+            if tp:
+                if not _recv_into_exact(conn, memoryview(dec._tp)):
+                    raise ProtocolError("connection closed mid-frame")
+                consumed += tp
+                trace = _parse_trace_field(dec._tp)
             dec.check_finite(slot, n)
             entity = dec.entity_cols(n, ent_buf, ts_buf)
         except FrameError as e:
@@ -832,12 +872,17 @@ class BinaryIngestServer:
                 "peer stalled between frame header and body"
             ) from None
         self._obs_parse(time.perf_counter() - t_parse)
+        timeline = (
+            RequestTimeline() if getattr(self.batcher, "telemetry", False)
+            else None
+        )
         try:
             self._c_req.inc()
-            ek = self._admit(slot, n, entity)
+            ek = self._admit(slot, n, entity, timeline)
         except AdmissionFull as e:
             scorer.staging.release(slot)
             self._c_shed.inc()
+            slo.record_lane("binary", False)
             conn.sendall(error_frame(ST_BUSY, str(e), e.retry_after_s))
             return True
         except Exception as e:
@@ -848,6 +893,7 @@ class BinaryIngestServer:
                     config.mesh_shard_reopen_s()
                 )
             log.error("ingest frame failed: %s", e)
+            slo.record_lane("binary", False)
             conn.sendall(error_frame(status, str(e), retry))
             return True
         try:
@@ -855,6 +901,19 @@ class BinaryIngestServer:
             self._respond(conn, dec, slot, n, ek, resp_buf)
         finally:
             scorer.staging.release(slot)
+        slo.record_lane("binary", True, time.perf_counter() - t_parse)
+        if trace is not None and tracing._tracer is not None:
+            # panopticon trace propagation: the frame's server-side work
+            # lands as a span linked to the CLIENT's trace (the frame's
+            # traceparent field), with the stage decomposition as child
+            # spans — the binary lane now traces exactly like the JSON
+            # lane's /predict span. Off the response path (the client
+            # already has its scores) and free when tracing is off.
+            with tracing.span(
+                "ingest.frame", traceparent=trace, lane="binary", rows=n
+            ):
+                if timeline is not None:
+                    tracing.emit_stage_spans(timeline)
         return True
 
     _DRAIN_CHUNK = 1 << 16
@@ -869,13 +928,9 @@ class BinaryIngestServer:
                 raise ProtocolError("connection closed mid-frame")
             k -= len(mv)
 
-    def _admit(self, slot, n: int, entity) -> int:
+    def _admit(self, slot, n: int, entity, timeline=None) -> int:
         """One loop hop per frame: schedule score_block on the serving
         loop and wait for the flush to resolve it."""
-        timeline = (
-            RequestTimeline() if getattr(self.batcher, "telemetry", False)
-            else None
-        )
         block = IngestBlock(slot, n, entity)
         fut = asyncio.run_coroutine_threadsafe(
             self.batcher.score_block(block, timeline), self._loop
@@ -965,13 +1020,16 @@ class BinLaneClient:
         entity_fps: np.ndarray | None = None,
         timestamps: np.ndarray | None = None,
         layout: int = LAYOUT_F32,
+        traceparent: str | None = None,
     ):
         """Score one frame → ``(scores f32[n], reasons | None)`` where
         ``reasons`` is ``(indices u8 (n,k), values f32 (n,k))`` when the
-        lantern explain leg rode the flush."""
+        lantern explain leg rode the flush. ``traceparent`` rides the
+        frame's trace field so the server's span links to the caller's
+        trace."""
         self.sock.sendall(encode_frame(
             rows, entity_fps, timestamps,
-            scale=self.scale, layout=layout,
+            scale=self.scale, layout=layout, traceparent=traceparent,
         ))
         status, ek, n, payload = self._read_response()
         return _parse_response_payload(status, ek, n, payload)

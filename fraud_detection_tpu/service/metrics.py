@@ -108,22 +108,31 @@ microbatch_size = Histogram(
 # Fastlane: fused-flush hot path (service/microbatch + monitor/drift).
 # These names are part of the alerting contract — the
 # FlushDispatchRegression alert and the fastlane Grafana panels read them.
+# Panopticon: all four carry a ``shard`` label so MESH_SHARDS>1 no longer
+# collapses them to whichever shard flushed last (the PR-7 documented
+# limitation). Single-shard deployments write the constant shard="0", so
+# series cardinality is unchanged there. The gauges' per-shard series are
+# dropped when a shard dies/drains (drop_shard_gauges) so dashboards never
+# read a dead shard's last sample as live; the flush counter is monotone
+# and stays — rate() goes to zero on its own and a drop would read as a
+# counter reset on revive.
 scorer_device_calls_per_flush = Gauge(
     "scorer_device_calls_per_flush",
-    "Device dispatches the last flush issued (1 = fused fastlane path; "
-    "2 = split score + drift-window dispatches) — instant view for the "
-    "fastlane dashboard panel; the FlushDispatchRegression alert reads "
+    "Device dispatches this shard's last flush issued (1 = fused fastlane "
+    "path; 2 = split score + drift-window dispatches) — instant view for "
+    "the fastlane dashboard panel; the FlushDispatchRegression alert reads "
     "the scorer_flushes_total path counters instead (a last-write gauge "
     "latches on one stray split flush over idle periods)",
+    ["shard"],
     registry=registry,
 )
 scorer_flushes = Counter(
     "scorer_flushes",
-    "Micro-batch flushes by dispatch path: fused = ONE fused score+drift "
-    "dispatch; split = score dispatch + ingest-thread drift dispatch; "
-    "solo = score-only (no watchtower). FlushDispatchRegression fires on "
-    "a sustained split fraction",
-    ["path"],
+    "Micro-batch flushes by dispatch path and shard: fused = ONE fused "
+    "score+drift dispatch; split = score dispatch + ingest-thread drift "
+    "dispatch; solo = score-only (no watchtower). FlushDispatchRegression "
+    "fires on a sustained split fraction",
+    ["path", "shard"],
     registry=registry,
 )
 scorer_wire_fused = Gauge(
@@ -186,16 +195,19 @@ scorer_explained_rows = Counter(
 )
 scorer_queue_depth = Gauge(
     "scorer_queue_depth",
-    "Queue ITEMS (single requests or whole ingest frames) waiting in the "
-    "micro-batcher at the last collection cycle — row-denominated backlog "
-    "is scorer_admission_queue_rows",
+    "Queue ITEMS (single requests or whole ingest frames) waiting in this "
+    "shard's micro-batcher at the last collection cycle — row-denominated "
+    "backlog is scorer_admission_queue_rows",
+    ["shard"],
     registry=registry,
 )
 scorer_admission_queue_rows = Gauge(
     "scorer_admission_queue_rows",
-    "Rows currently admitted but not yet collected into a flush (the "
-    "hyperloop continuous-batching queue; bounded by SCORER_ADMIT_MAX_ROWS "
-    "— at the bound new admissions shed with 429/busy instead of queueing)",
+    "Rows currently admitted to this shard's batcher but not yet "
+    "collected into a flush (the hyperloop continuous-batching queue; "
+    "bounded per shard by SCORER_ADMIT_MAX_ROWS — at the bound new "
+    "admissions shed with 429/busy instead of queueing)",
+    ["shard"],
     registry=registry,
 )
 
@@ -235,10 +247,29 @@ ingest_frame_errors = Counter(
 )
 scorer_effective_wait = Gauge(
     "scorer_effective_wait_seconds",
-    "Collection deadline the micro-batcher is currently applying "
+    "Collection deadline this shard's micro-batcher is currently applying "
     "(= SCORER_MAX_WAIT_MS unless SCORER_ADAPTIVE_WAIT scales it down)",
+    ["shard"],
     registry=registry,
 )
+
+
+def drop_shard_gauges(shard: str) -> None:
+    """Drop one shard's per-shard GAUGE series on death/drain (panopticon
+    stale-series discipline): a dead shard's last queue-depth/wait/dispatch
+    sample must not read as live on dashboards. Counters stay — their rate
+    goes quiet on its own. The owning micro-batcher re-binds its children
+    on revive (``MicroBatcher.rebind_shard_gauges``)."""
+    for g in (
+        scorer_queue_depth,
+        scorer_effective_wait,
+        scorer_device_calls_per_flush,
+        scorer_admission_queue_rows,
+    ):
+        try:
+            g.remove(shard)
+        except KeyError:
+            pass  # never written for this shard yet
 
 # Ledger: the device-resident stateful feature engine (ledger/). These
 # names are the alerting contract for
@@ -432,14 +463,68 @@ device_profile_active = Gauge(
     registry=registry,
 )
 
+# Panopticon: the fleet SLO engine (telemetry/slo) + live roofline gauges
+# (telemetry/roofline). The slo_*/device_utilization names are the
+# alerting contract for monitoring/prometheus/rules/slo-alerts.yml
+# (SLOFastBurn, SLOSlowBurn, DeviceUtilizationCollapse) and the panopticon
+# dashboard row. The ``slo`` label is bounded: one series per declared
+# objective — "<kind>:<series>" where kind ∈ {availability, latency} and
+# series ∈ {json, msgpack, binary, shard<N>}.
+slo_burn_rate = Gauge(
+    "slo_burn_rate",
+    "Error-budget burn-rate multiple over each sliding window (bad-rate / "
+    "allowed-rate; 1.0 = spending budget exactly at the sustainable pace). "
+    "The multi-window multi-burn-rate alerts AND two windows so a blip "
+    "cannot page and a slow leak cannot hide",
+    ["slo", "window"],
+    registry=registry,
+)
+slo_error_budget_remaining = Gauge(
+    "slo_error_budget_remaining",
+    "Fraction of the error budget left over the longest (6h) window "
+    "(1 = untouched, 0 = spent, negative = overdrawn) — the panopticon "
+    "budget gauge /slo/status reads",
+    ["slo"],
+    registry=registry,
+)
+slo_requests = Counter(
+    "slo_requests",
+    "Requests observed by the SLO engine per series and verdict "
+    "(good|bad for availability; fast|slow for the latency objective)",
+    ["slo", "verdict"],
+    registry=registry,
+)
+device_utilization_fraction = Gauge(
+    "device_utilization_fraction",
+    "Achieved / peak FLOP-rate of each fused serving program over its "
+    "recent flushes (XLA cost_analysis flops for the dispatched bucket ÷ "
+    "measured device_compute stage time ÷ device peak) — the live roofline "
+    "signal; the bench-time CPU-floor constants made continuous. "
+    "DeviceUtilizationCollapse fires when a serving entrypoint's "
+    "utilization collapses under live traffic",
+    ["entrypoint"],
+    registry=registry,
+)
+device_peak_flops_estimate = Gauge(
+    "device_peak_flops_estimate",
+    "Peak f32 FLOP/s the utilization gauges divide by: DEVICE_PEAK_FLOPS "
+    "when pinned, else the warmup matmul probe's achieved rate",
+    registry=registry,
+)
+device_program_flops = Gauge(
+    "device_program_flops",
+    "XLA cost_analysis flops of the LAST bucket each fused entrypoint "
+    "dispatched (the roofline numerator; bytes ride the status endpoint)",
+    ["entrypoint"],
+    registry=registry,
+)
+
 # Switchyard: sharded serving mesh (mesh/). The mesh_shard_* names are the
 # alerting contract for monitoring/prometheus/rules/mesh-alerts.yml
-# (ShardDown, ShardLoadSkew) and the switchyard dashboard row.
-# NOTE: with MESH_SHARDS>1 the process-wide scorer gauges above
-# (scorer_queue_depth, scorer_effective_wait_seconds,
-# scorer_device_calls_per_flush) are written by every shard's flush loop —
-# they read as the last shard's per-flush sample, not an aggregate; use
-# the per-shard series below for shard-level conditions.
+# (ShardDown, ShardLoadSkew) and the switchyard dashboard row. The scorer
+# gauges above carry a per-shard ``shard`` label (panopticon), so shard-
+# level flush conditions read those directly; the mesh_shard_* series
+# below track routing health.
 mesh_shards = Gauge(
     "mesh_shards",
     "Replica shards configured in the switchyard serving front",

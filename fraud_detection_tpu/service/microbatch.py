@@ -85,6 +85,7 @@ from fraud_detection_tpu.ops.scorer import (
 )
 from fraud_detection_tpu.range.faults import fire
 from fraud_detection_tpu.service import metrics, tracing
+from fraud_detection_tpu.telemetry import roofline
 from fraud_detection_tpu.telemetry.timeline import STAGES, FlushInfo
 from fraud_detection_tpu.utils.profiling import annotate
 
@@ -167,6 +168,7 @@ class MicroBatcher:
         explain: bool | None = None,
         explain_k: int | None = None,
         admit_max_rows: int | None = None,
+        shard_id: int = 0,
     ):
         # Either a fixed scorer (offline tools, tests) or a lifecycle
         # ModelSlot (serving): with a slot, every flush re-reads the slot's
@@ -268,6 +270,17 @@ class MicroBatcher:
         self._carry: tuple | None = None  # block deferred to the next batch
         self._rate = 0.0  # rows/s arrival EWMA (adaptive deadline input)
         self._last_cycle: float | None = None
+        # panopticon: this batcher's switchyard shard identity — the
+        # constant "0" on single-batcher serving, so cardinality there is
+        # unchanged. Bound label children once (a labels() lookup costs
+        # ~0.6µs — per-flush money on the ≤5% telemetry budget).
+        self.shard_id = int(shard_id)
+        self._shard_label = str(self.shard_id)
+        self.rebind_shard_gauges()
+        self._c_flush = {
+            path: metrics.scorer_flushes.labels(path, self._shard_label)
+            for path in ("fused", "split", "solo")
+        }
         self._queue: asyncio.Queue[tuple] = asyncio.Queue()
         self._collector: asyncio.Task | None = None
         self._starting = False
@@ -275,6 +288,41 @@ class MicroBatcher:
             max_inflight if max_inflight is not None else config.scorer_max_inflight()
         )
         self._flushes: set[asyncio.Task] = set()
+
+    def set_shard_id(self, shard_id: int) -> None:
+        """Adopt a switchyard shard identity (the ShardFront assigns these
+        by index at construction, so fronts built from default-constructed
+        batchers still get distinct per-shard series — shared labels would
+        let one shard's death drop the series every survivor writes
+        through)."""
+        if int(shard_id) == self.shard_id:
+            return
+        self.shard_id = int(shard_id)
+        self._shard_label = str(self.shard_id)
+        self.rebind_shard_gauges()
+        self._c_flush = {
+            path: metrics.scorer_flushes.labels(path, self._shard_label)
+            for path in ("fused", "split", "solo")
+        }
+
+    def rebind_shard_gauges(self) -> None:
+        """(Re-)bind this shard's per-shard gauge children. Called at
+        construction and again by the shard front on revive — the stale-
+        series drop on death/drain (metrics.drop_shard_gauges) unhooks the
+        previously bound children from the registry, so a revived shard
+        must mint fresh ones or its samples would silently stop
+        exporting."""
+        metrics_shard = str(self.shard_id)
+        self._g_queue_depth = metrics.scorer_queue_depth.labels(metrics_shard)
+        self._g_effective_wait = metrics.scorer_effective_wait.labels(
+            metrics_shard
+        )
+        self._g_device_calls = metrics.scorer_device_calls_per_flush.labels(
+            metrics_shard
+        )
+        self._g_admission_rows = metrics.scorer_admission_queue_rows.labels(
+            metrics_shard
+        )
 
     async def start(self, warm: bool = True) -> None:
         """``warm=False`` skips the bucket-ladder warmup: the switchyard
@@ -309,6 +357,12 @@ class MicroBatcher:
                 )
                 top = _bucket(self.max_batch, scorer.min_bucket)
                 with expected_compiles():
+                    if config.roofline_enabled():
+                        # resolve the roofline's peak-FLOP denominator
+                        # once, inside the warmup executor — under the
+                        # expected mark so the probe's own matmul compile
+                        # cannot feed the storm detector
+                        roofline.ensure_peak()
                     scorer.warmup(top)
                     target = self._fused_target(scorer)
                     if target is None:
@@ -459,7 +513,7 @@ class MicroBatcher:
                 w = 0.0
             else:
                 w = self.max_wait * min(1.0, expected_rows / self.max_batch)
-        metrics.scorer_effective_wait.set(w)
+        self._g_effective_wait.set(w)
         return w
 
     async def _run(self) -> None:
@@ -478,8 +532,8 @@ class MicroBatcher:
                 n_rows = rows_of(item)
                 self._queued_rows -= n_rows
                 batch = [stamp(item)]
-                metrics.scorer_queue_depth.set(self._queue.qsize())
-                metrics.scorer_admission_queue_rows.set(self._queued_rows)
+                self._g_queue_depth.set(self._queue.qsize())
+                self._g_admission_rows.set(self._queued_rows)
                 # Collect more ROWS (items weighted by their block size)
                 # until the window closes or the batch fills. Greedy drain
                 # first: under load the queue already holds rows, and one
@@ -847,6 +901,11 @@ class MicroBatcher:
                 if telemetry:
                     jax.block_until_ready(out)
                 t_synced = time.perf_counter()
+                if telemetry:
+                    # panopticon roofline: pair the fenced device_compute
+                    # duration with the fused dispatch the sentinel noted
+                    # on this thread (one thread-local read + a gauge set)
+                    roofline.note_device_time(t_synced - t_padded)
                 if explain_k:
                     score_dev, eidx_dev, eval_dev = out
                 else:
@@ -1119,11 +1178,11 @@ class MicroBatcher:
                 monitor_reasons = None
             if explain_out is not None:
                 metrics.scorer_explained_rows.inc(n_rows)
-            metrics.scorer_device_calls_per_flush.set(device_calls)
-            metrics.scorer_flushes.labels(
+            self._g_device_calls.set(device_calls)
+            self._c_flush[
                 "fused" if fused
                 else ("split" if self.watchtower is not None else "solo")
-            ).inc()
+            ].inc()
         except Exception as e:  # resolve all waiters with the failure
             for item in batch:
                 if not item[1].done():
@@ -1140,6 +1199,7 @@ class MicroBatcher:
                 t_fetched=t_fetched, batch_size=n_rows,
                 bucket=_bucket(n_rows, scorer.min_bucket),
                 model_version=version, model_source=source, drift=drift_flag,
+                shard=self.shard_id,
             )
         # Completion fan-out by per-flush row offset (hyperloop): each item
         # resolves from its slice of the flush's results — single rows as

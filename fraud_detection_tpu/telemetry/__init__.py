@@ -39,6 +39,7 @@ from fraud_detection_tpu.telemetry.compile_sentinel import (  # noqa: F401
 )
 from fraud_detection_tpu.telemetry.flightrecorder import (  # noqa: F401
     FlightRecorder,
+    RecorderSet,
 )
 from fraud_detection_tpu.telemetry.timeline import (  # noqa: F401
     STAGES,

@@ -45,6 +45,7 @@ from collections import deque
 
 from fraud_detection_tpu import config
 from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.telemetry import roofline
 
 log = logging.getLogger("fraud_detection_tpu.telemetry")
 
@@ -265,6 +266,25 @@ def instrument(entrypoint: str, fn):
                 # inner jits compiled but our cache hit (nested wrap):
                 # re-attribute to the enclosing instrumented call
                 stack[-1][1] += compile_secs
+            # panopticon roofline: note (entrypoint, bucket) on this
+            # thread so the flush fence can pair its measured
+            # device_compute time with this dispatch (one thread-local
+            # write on the hit path). A cache MISS on a fused serving
+            # program additionally captures the fresh executable's XLA
+            # cost_analysis — under the expected mark with a dummy
+            # attribution frame pushed, so the capture's own re-compile
+            # neither feeds the storm detector nor the per-entrypoint
+            # counters.
+            roofline.note_dispatch(entrypoint, args)
+            if misses > 0 and roofline.wants_capture(entrypoint, args):
+                prev_expected = getattr(_local, "expected", False)
+                _local.expected = True
+                stack.append(["_roofline_capture", 0.0])
+                try:
+                    roofline.capture(entrypoint, fn, args, kwargs)
+                finally:
+                    stack.pop()
+                    _local.expected = prev_expected
 
     wrapped._spyglass_entrypoint = entrypoint
     wrapped.__wrapped__ = fn

@@ -31,6 +31,7 @@ FIELDS = (
     "model_version",    # registry version serving the flush (None = local)
     "model_source",
     "drift",            # watchtower drift flag at flush time
+    "shard",            # switchyard shard whose batcher ran the flush
     "stages",           # dict: the six timeline stage durations (seconds)
     "total_s",
 )
@@ -139,3 +140,42 @@ class FlightRecorder:
                     break
                 out.append(tl.to_record(fi))
         return out
+
+
+class RecorderSet:
+    """Panopticon: per-shard flight-recorder rings behind one merged view.
+
+    Under ``MESH_SHARDS>1`` each shard's micro-batcher appends to its OWN
+    ring — the hot-path append never takes a lock another shard's flush
+    loop contends on, and a dead shard's forensic record survives intact
+    however loud the survivors are. ``GET /debug/flightrecorder`` reads
+    this wrapper: per-shard dumps merged newest-first (every record
+    carries the ``shard`` that ran its flush via FlushInfo). Duck-types
+    the single-ring surface (``dump``/``capacity``/``total_recorded``) so
+    the endpoint serves either shape unchanged."""
+
+    def __init__(self, recorders: list[FlightRecorder]):
+        if not recorders:
+            raise ValueError("RecorderSet needs at least one recorder")
+        self.recorders = list(recorders)
+
+    @property
+    def capacity(self) -> int:
+        return sum(r.capacity for r in self.recorders)
+
+    @property
+    def total_recorded(self) -> int:
+        return sum(r.total_recorded for r in self.recorders)
+
+    def __len__(self) -> int:
+        return sum(len(r) for r in self.recorders)
+
+    def dump(self, limit: int | None = None) -> list[dict]:
+        """Newest-first merge of every shard's ring (stable by record
+        timestamp; each ring is already newest-first)."""
+        count = self.capacity if limit is None else max(0, min(limit, self.capacity))
+        rows: list[dict] = []
+        for r in self.recorders:
+            rows.extend(r.dump(count))
+        rows.sort(key=lambda rec: rec.get("ts", 0.0), reverse=True)
+        return rows[:count]

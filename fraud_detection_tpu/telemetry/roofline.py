@@ -1,0 +1,246 @@
+"""Panopticon: live roofline gauges for the fused serving programs.
+
+"As fast as the hardware allows" was, until this module, a bench-time
+claim: the CPU-floor constants (``GBT_EXPLAIN_CPU_FLOOR`` ≈ 0.16 vs the
+≥0.8 accelerator budget, ``STATEFUL_CPU_FLOOR``, ``WIDE_CPU_FLOOR``) are
+measured once in CI and then asserted, never observed in production. An
+accelerator deployment therefore cannot see whether, say, the exact-
+TreeSHAP explain leg saturates the chip under real traffic. This module
+turns the constants into a live signal:
+
+- **Cost capture at compile time.** The compile sentinel already wraps
+  every fused entrypoint; when a wrapped call MISSES the executable cache
+  (warmup's bucket ladder, or a legitimate new shape) the wrapper hands
+  the call here and the freshly compiled executable's XLA
+  ``cost_analysis()`` is read — flops + bytes accessed per
+  ``entrypoint × bucket`` (family/wire are already folded into the
+  entrypoint label by the sentinel's naming). Capture costs one cached
+  ``lower().compile()`` walk per compile — pennies next to the compile
+  itself — and never runs on cache hits.
+- **Per-flush division.** The micro-batcher's flush thread dispatches the
+  fused program and fences it (the ``device_compute`` stage); right after
+  the fence it calls :func:`note_device_time` with the measured duration.
+  The dispatch the sentinel recorded on the SAME thread names the
+  entrypoint and bucket, so achieved FLOP/s = flops / duration, and
+  ``device_utilization_fraction{entrypoint}`` = achieved / peak (EWMA-
+  smoothed). Steady-state cost: one thread-local read, two dict lookups,
+  one gauge set.
+- **Peak.** ``DEVICE_PEAK_FLOPS`` when the operator pins the datasheet
+  number; otherwise :func:`ensure_peak` (run once inside the warmup
+  executor) times a blocked f32 matmul and uses its achieved rate — an
+  honest achievable-peak proxy on any backend, which makes the gauge a
+  *fraction of what this device demonstrably does on its best-case
+  kernel* rather than of a number nobody measured.
+
+``device_compute`` includes the h2d upload and dispatch overhead, so the
+gauge is an end-to-end utilization (the number that bounds throughput),
+not a pure-MXU duty cycle — documented in docs/OBSERVABILITY.md. The
+DeviceUtilizationCollapse alert (slo-alerts.yml) fires when a serving
+entrypoint's utilization collapses while flushes keep flowing.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.service import metrics
+
+log = logging.getLogger("fraud_detection_tpu.telemetry")
+
+_local = threading.local()
+
+_lock = threading.Lock()
+#: (entrypoint, bucket) → {"flops": float, "bytes": float}
+_costs: dict[tuple[str, int], dict] = {}
+_peak_flops: float = 0.0
+#: entrypoint → EWMA'd utilization (mirrors the gauge for /slo/status)
+_util: dict[str, float] = {}
+_util_gauges: dict[str, object] = {}
+_flops_gauges: dict[str, object] = {}
+
+#: EWMA smoothing for the utilization gauge: heavy enough to damp
+#: per-flush host-timer noise, light enough that a collapse shows within
+#: tens of flushes.
+_EWMA_ALPHA = 0.2
+
+
+def _bucket_of(args) -> int:
+    """The padded bucket a fused-program call dispatched: the leading dim
+    of the first 2-D array argument (the staged row block in every fused
+    signature)."""
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape is not None and len(shape) >= 2:
+            return int(shape[0])
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 0
+
+
+def _cost_dict(compiled) -> dict | None:
+    """Normalize ``compiled.cost_analysis()`` across jax versions (dict, or
+    a one-element list of dicts)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        log.debug("cost_analysis unavailable on this backend", exc_info=True)
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return ca if isinstance(ca, dict) else None
+
+
+def note_dispatch(entrypoint: str, args) -> None:
+    """Called by the compile sentinel for every instrumented call: note
+    (entrypoint, bucket) on this thread so :func:`note_device_time` can
+    pair the upcoming flush fence with it. One thread-local write."""
+    if not config.roofline_enabled():
+        return
+    _local.last = (entrypoint, _bucket_of(args))
+
+
+def wants_capture(entrypoint: str, args) -> bool:
+    """Whether a cache miss on this entrypoint should pay a cost-analysis
+    capture: fused serving programs only (the ``*flush`` sentinel
+    entrypoints — the bucket ladder the ISSUE's roofline contract names),
+    once per (entrypoint, bucket). Everything else skips — capture
+    re-lowers and re-compiles the program, which is pennies at warmup for
+    the bounded ladder but not a tax every instrumented jit should pay."""
+    if not config.roofline_enabled() or not entrypoint.endswith("flush"):
+        return False
+    with _lock:
+        return (entrypoint, _bucket_of(args)) not in _costs
+
+
+def capture(entrypoint: str, fn, args, kwargs) -> None:
+    """Capture the freshly compiled executable's XLA ``cost_analysis()``
+    for (entrypoint, bucket). The sentinel calls this ONLY on a cache miss
+    of a fused entrypoint, under its expected-compiles mark with a dummy
+    attribution frame pushed — the capture's own backend compile neither
+    feeds the storm detector nor pollutes the per-entrypoint counters.
+    Must never raise into the serving path."""
+    bucket = _bucket_of(args)
+    key = (entrypoint, bucket)
+    with _lock:
+        if key in _costs:
+            return
+    try:
+        lower = getattr(fn, "lower", None)
+        if lower is None:
+            return
+        ca = _cost_dict(lower(*args, **kwargs).compile())
+        if not ca:
+            return
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+        if flops <= 0.0:
+            return
+        with _lock:
+            _costs[key] = {"flops": flops, "bytes": nbytes}
+        g = _flops_gauges.get(entrypoint)
+        if g is None:
+            g = _flops_gauges[entrypoint] = metrics.device_program_flops.labels(
+                entrypoint
+            )
+        g.set(flops)
+        log.info(
+            "roofline: %s bucket=%d costs %.3g flops, %.3g bytes",
+            entrypoint, bucket, flops, nbytes,
+        )
+    except Exception:
+        log.debug("roofline cost capture failed for %s", entrypoint,
+                  exc_info=True)
+
+
+def note_device_time(duration_s: float) -> None:
+    """Pair the flush's measured ``device_compute`` duration with the last
+    fused dispatch on this thread and refresh the utilization gauge."""
+    last = getattr(_local, "last", None)
+    if last is None or duration_s <= 0.0:
+        return
+    entrypoint, bucket = last
+    _local.last = None
+    cost = _costs.get((entrypoint, bucket))
+    peak = _peak_flops
+    if cost is None or peak <= 0.0:
+        return
+    util = cost["flops"] / duration_s / peak
+    with _lock:
+        prev = _util.get(entrypoint)
+        util = (
+            util if prev is None else prev + _EWMA_ALPHA * (util - prev)
+        )
+        _util[entrypoint] = util
+    g = _util_gauges.get(entrypoint)
+    if g is None:
+        g = _util_gauges[entrypoint] = metrics.device_utilization_fraction.labels(
+            entrypoint
+        )
+    g.set(util)
+
+
+def ensure_peak() -> float:
+    """Resolve the peak FLOP/s denominator once: the pinned
+    ``DEVICE_PEAK_FLOPS``, else a blocked f32 matmul probe (~tens of ms,
+    run inside the warmup executor — never on a request)."""
+    global _peak_flops
+    if _peak_flops > 0.0:
+        return _peak_flops
+    pinned = config.device_peak_flops()
+    if pinned > 0.0:
+        _peak_flops = pinned
+        metrics.device_peak_flops_estimate.set(pinned)
+        return pinned
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        n = 512
+        a = jnp.ones((n, n), jnp.float32)
+        f = jax.jit(lambda x: x @ x)
+        f(a).block_until_ready()  # compile + first run off the clock
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            f(a).block_until_ready()
+            dt = time.perf_counter() - t0
+            if dt > 0:
+                best = max(best, (2.0 * n ** 3) / dt)
+        if best > 0.0:
+            _peak_flops = best
+            metrics.device_peak_flops_estimate.set(best)
+            log.info("roofline: matmul-probe peak ≈ %.3g FLOP/s", best)
+    except Exception:
+        log.warning("roofline peak probe failed; utilization gauges stay "
+                    "silent", exc_info=True)
+    return _peak_flops
+
+
+def snapshot() -> dict:
+    """Roofline state for ``/slo/status``: peak, per-entrypoint smoothed
+    utilization, and the captured program costs."""
+    with _lock:
+        return {
+            "peak_flops": _peak_flops,
+            "utilization": dict(_util),
+            "programs": {
+                f"{ep}@{bucket}": dict(c)
+                for (ep, bucket), c in _costs.items()
+            },
+        }
+
+
+def _reset_for_tests() -> None:
+    global _peak_flops
+    with _lock:
+        _costs.clear()
+        _util.clear()
+    _util_gauges.clear()
+    _flops_gauges.clear()
+    _peak_flops = 0.0
+    _local.last = None

@@ -63,6 +63,7 @@ class FlushInfo:
         "model_source",
         "drift",
         "recorded_at",
+        "shard",
     )
 
     def __init__(
@@ -76,6 +77,7 @@ class FlushInfo:
         model_version: int | None = None,
         model_source: str | None = None,
         drift: bool = False,
+        shard: int = 0,
     ):
         self.t_flush_start = t_flush_start
         self.t_padded = t_padded
@@ -88,6 +90,10 @@ class FlushInfo:
         self.model_source = model_source
         self.drift = drift
         self.recorded_at = 0.0
+        # panopticon: the switchyard shard whose micro-batcher ran this
+        # flush (0 on single-batcher serving) — every flight-recorder
+        # record must attribute its flush to the shard that ran it
+        self.shard = shard
 
 
 class RequestTimeline:
@@ -173,6 +179,7 @@ class RequestTimeline:
             "model_version": fi.model_version if fi is not None else None,
             "model_source": fi.model_source if fi is not None else None,
             "drift": bool(fi.drift) if fi is not None else False,
+            "shard": fi.shard if fi is not None else 0,
             "stages": self.stages(fi),
             "total_s": self.total_seconds(fi),
         }

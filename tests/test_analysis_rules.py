@@ -251,3 +251,72 @@ def test_syntax_error_is_reported_not_raised(tmp_path):
     p.write_text("def broken(:\n")
     (f,) = analyze_file(str(p), root=str(tmp_path))
     assert f.rule_id == "syntax-error" and f.severity is Severity.ERROR
+
+
+# -- alert-metric-registered (panopticon) -----------------------------------
+
+
+def _monitoring_tree(tmp_path, expr: str) -> str:
+    """A minimal repo shape the rule dispatches on: service/metrics.py +
+    service/netserver.py exporters and one rule file with ``expr``."""
+    svc = tmp_path / "service"
+    svc.mkdir()
+    (svc / "metrics.py").write_text(
+        "from prometheus_client import Counter, Gauge, Histogram\n"
+        "c = Counter('demo_requests', 'd')\n"
+        "g = Gauge('demo_depth', 'd', ['shard'])\n"
+        "h = Histogram('demo_latency_seconds', 'd')\n"
+    )
+    (svc / "netserver.py").write_text(
+        "from prometheus_client import Gauge\n"
+        "s = Gauge('demo_store_seq', 'd')\n"
+    )
+    rules = tmp_path / "monitoring" / "prometheus" / "rules"
+    rules.mkdir(parents=True)
+    (rules / "alerts.yml").write_text(
+        "groups:\n"
+        "  - name: g\n"
+        "    rules:\n"
+        "      - alert: A\n"
+        f"        expr: {expr}\n"
+        "        labels: {severity: warning}\n"
+        "        annotations: {summary: s}\n"
+    )
+    return str(svc / "metrics.py")
+
+
+def test_alert_metric_registered_catches_dead_series(tmp_path):
+    path = _monitoring_tree(
+        tmp_path, "rate(demo_requests_total[5m]) + rate(demo_ghost_total[5m]) > 1"
+    )
+    findings = analyze_file(path, root=str(tmp_path))
+    dead = [f for f in findings if f.rule_id == "alert-metric-registered"]
+    assert len(dead) == 1, findings
+    assert "demo_ghost_total" in dead[0].message
+    assert "demo_requests" not in dead[0].message
+    assert dead[0].severity is Severity.ERROR
+
+
+def test_alert_metric_registered_accepts_live_series(tmp_path):
+    # counter _total, histogram _bucket, a labeled selector, a grouping
+    # clause with an underscore label, and the sanctioned second exporter
+    # (netserver) must all pass without findings
+    path = _monitoring_tree(
+        tmp_path,
+        'histogram_quantile(0.95, sum by (le_bin) '
+        '(rate(demo_latency_seconds_bucket{stage="a_b"}[5m]))) > 1 '
+        "and on() sum without (shard_id) (demo_depth) > 0 "
+        "and on() demo_store_seq > 0",
+    )
+    findings = analyze_file(path, root=str(tmp_path))
+    assert not [
+        f for f in findings if f.rule_id == "alert-metric-registered"
+    ], findings
+
+
+def test_alert_metric_registered_skips_other_modules(tmp_path):
+    # the rule dispatches only on service/metrics.py — an app module
+    # mentioning nothing is never cross-checked
+    p = tmp_path / "other.py"
+    p.write_text("x = 1\n")
+    assert analyze_file(str(p), root=str(tmp_path)) == []

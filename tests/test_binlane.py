@@ -110,7 +110,7 @@ def test_frame_body_roundtrip(scorer, data):
     a pooled staging slot (the /ingest/batch path)."""
     rows = data[:17]
     body = binlane.encode_frame(rows, length_prefix=False)
-    slot, n, entity = binlane.decode_frame_body(scorer, body, max_rows=128)
+    slot, n, entity, _tp = binlane.decode_frame_body(scorer, body, max_rows=128)
     try:
         assert n == 17
         assert entity is None
@@ -167,7 +167,7 @@ def test_entity_columns_match_json_edge_hash(data):
     body = binlane.encode_frame(
         data[:10], entity_fps=fps, timestamps=ts, length_prefix=False
     )
-    slot, n, entity = binlane.decode_frame_body(wscorer, body, max_rows=64)
+    slot, n, entity, _tp = binlane.decode_frame_body(wscorer, body, max_rows=64)
     try:
         assert entity is not None
         ls, lf, lt = entity
@@ -425,7 +425,7 @@ def test_block_from_arrays_matches_frame_decode(scorer, data):
     rows = data[:11]
     slot_a, n_a, ent_a = binlane.block_from_arrays(scorer, rows, max_rows=64)
     body = binlane.encode_frame(rows, length_prefix=False)
-    slot_b, n_b, ent_b = binlane.decode_frame_body(scorer, body, max_rows=64)
+    slot_b, n_b, ent_b, _tp = binlane.decode_frame_body(scorer, body, max_rows=64)
     try:
         assert n_a == n_b == 11
         assert ent_a is None and ent_b is None

@@ -487,7 +487,7 @@ def test_microbatcher_wide_single_dispatch_and_gauge(
     for i, (score, reasons) in enumerate(out):
         assert score == pytest.approx(float(expect[i]), abs=1e-6)
         assert reasons is not None and len(reasons[0]) == K
-    assert metrics.scorer_device_calls_per_flush._value.get() == 1
+    assert metrics.scorer_device_calls_per_flush.labels("0")._value.get() == 1
     assert metrics.scorer_wide_fused._value.get() == 1
     assert metrics.scorer_served_family.labels("wide")._value.get() == 1
     assert metrics.wide_model_shards._value.get() == 1

@@ -530,7 +530,7 @@ def test_microbatcher_gbt_int8_explain_single_dispatch(
         assert 0.0 <= score <= 1.0
         assert reasons is not None
         assert len(reasons[0]) == K and len(reasons[1]) == K
-    assert metrics.scorer_device_calls_per_flush._value.get() == 1
+    assert metrics.scorer_device_calls_per_flush.labels("0")._value.get() == 1
     assert metrics.scorer_wire_fused._value.get() == 1
     assert metrics.scorer_explain_fused._value.get() == 1
     assert metrics.scorer_served_family.labels("gbt")._value.get() == 1

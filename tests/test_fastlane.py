@@ -194,14 +194,14 @@ def test_steady_state_flush_is_single_dispatch(data, profile):
             await mb.stop()
         return out
 
-    fused_flushes_before = metrics.scorer_flushes.labels("fused")._value.get()
+    fused_flushes_before = metrics.scorer_flushes.labels("fused", "0")._value.get()
     try:
         out = asyncio.run(run())
     finally:
         wt.drain()
         wt.close()
     assert len(out) == 48 and all(0.0 <= p <= 1.0 for p in out)
-    assert metrics.scorer_flushes.labels("fused")._value.get() > (
+    assert metrics.scorer_flushes.labels("fused", "0")._value.get() > (
         fused_flushes_before
     )
     assert calls["fused"] >= 1
@@ -210,7 +210,7 @@ def test_steady_state_flush_is_single_dispatch(data, profile):
         "ingest thread issued the split-path window dispatch despite "
         "drift_done"
     )
-    assert metrics.scorer_device_calls_per_flush._value.get() == 1
+    assert metrics.scorer_device_calls_per_flush.labels("0")._value.get() == 1
     # the drift evidence actually landed (scored rows, not just dispatches)
     assert wt.drift.rows_seen == 48
 
@@ -231,15 +231,15 @@ def test_split_path_reports_two_device_calls(data, profile):
         await mb.stop()
         return out
 
-    split_flushes_before = metrics.scorer_flushes.labels("split")._value.get()
+    split_flushes_before = metrics.scorer_flushes.labels("split", "0")._value.get()
     try:
         out = asyncio.run(run())
     finally:
         wt.drain()
         wt.close()
     assert len(out) == 16
-    assert metrics.scorer_device_calls_per_flush._value.get() == 2
-    assert metrics.scorer_flushes.labels("split")._value.get() > (
+    assert metrics.scorer_device_calls_per_flush.labels("0")._value.get() == 2
+    assert metrics.scorer_flushes.labels("split", "0")._value.get() > (
         split_flushes_before
     )
     assert wt.drift.rows_seen == 16  # split ingest still folded the batch
@@ -376,7 +376,7 @@ def test_adaptive_collector_end_to_end(data):
 
     out = asyncio.run(run())
     assert len(out) == 6
-    assert 0.0 <= metrics.scorer_effective_wait._value.get() <= 0.005
+    assert 0.0 <= metrics.scorer_effective_wait.labels("0")._value.get() <= 0.005
 
 
 # -- hot swap between in-flight pipelined flushes ---------------------------

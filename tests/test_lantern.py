@@ -446,7 +446,7 @@ def test_microbatcher_explain_single_dispatch(data, scaler, profile):
         assert list(order) == idxs
         np.testing.assert_allclose(phi[order], vals, rtol=1e-6, atol=1e-6)
         assert all(0 <= j < len(names) for j in idxs)
-    assert metrics.scorer_device_calls_per_flush._value.get() == 1
+    assert metrics.scorer_device_calls_per_flush.labels("0")._value.get() == 1
     assert metrics.scorer_explain_fused._value.get() == 1
     assert metrics.scorer_explained_rows._value.get() - explained_before == 48
 
@@ -521,7 +521,7 @@ def test_demotion_is_logged_and_latched(data, scaler, profile, caplog):
     assert all(r is None for _, r in out), "demoted family shipped reasons?"
     assert all(0.0 <= s <= 1.0 for s, _ in out)
     assert metrics.scorer_explain_fused._value.get() == 0
-    assert metrics.scorer_device_calls_per_flush._value.get() == 1, (
+    assert metrics.scorer_device_calls_per_flush.labels("0")._value.get() == 1, (
         "scores must STAY fused when only the explain leg demotes"
     )
     assert any(

@@ -281,7 +281,7 @@ def test_mesh_flush_is_single_dispatch_through_microbatcher(data, profile):
     assert calls["sharded"] >= 1
     assert calls["split_score"] == 0
     assert calls["split_update"] == 0
-    assert metrics.scorer_device_calls_per_flush._value.get() == 1
+    assert metrics.scorer_device_calls_per_flush.labels("0")._value.get() == 1
     assert wt.drift.rows_seen == 48
 
 

@@ -531,3 +531,79 @@ def test_promlint_cli_exit_codes(tmp_path, capsys, structural_lint):
     (bad_dir / "prometheus" / "rules").mkdir(parents=True)
     (bad_dir / "alert_rules.yml").write_text("groups:\n  - rules: []\n")
     assert promlint.main([str(bad_dir)]) == 1
+
+
+def test_slo_rules_file_ships():
+    """The panopticon contract (ISSUE 14): slo-alerts.yml ships
+    promlint-clean with the multi-window multi-burn-rate pages and the
+    roofline collapse alert."""
+    path = os.path.join(RULES_DIR, "slo-alerts.yml")
+    assert os.path.exists(path)
+    assert promlint.lint_rules_file(path) == []
+    with open(path) as f:
+        text = f.read()
+    assert "SLOFastBurn" in text
+    assert "SLOSlowBurn" in text
+    assert "DeviceUtilizationCollapse" in text
+    # multi-window: both burn alerts AND two windows of the same slo
+    assert 'window="5m"' in text and 'window="1h"' in text
+    assert "ignoring(window)" in text
+    assert "SLOBurnRate.md" in text  # runbook link
+
+
+def test_slo_alert_metrics_exist_in_registry():
+    """Every slo_*/device_* metric the panopticon rules reference must be
+    exported by service/metrics.py — same drift-proofing contract as the
+    other rule files."""
+    exported = _exported_metric_names()
+    with open(os.path.join(RULES_DIR, "slo-alerts.yml")) as f:
+        text = f.read()
+    referenced = set(
+        re.findall(
+            r"\b(slo_[a-z_]+|device_utilization_[a-z_]+|"
+            r"device_program_[a-z_]+|device_peak_[a-z_]+|"
+            r"scorer_flushes[a-z_]*)\b",
+            text,
+        )
+    )
+    referenced -= {"slo_alerts"}  # the file's own name
+    assert referenced, "slo rules reference no panopticon metrics?"
+    missing = {
+        name for name in referenced
+        if name not in exported
+        and name.removesuffix("_total") not in exported
+        and f"{name}_total" not in exported
+    }
+    assert not missing, f"alert rules reference unexported metrics: {missing}"
+
+
+def test_grafana_panopticon_row_present():
+    """Both dashboards carry the panopticon row (burn rate, budget
+    remaining, roofline utilization, per-shard flushes)."""
+    for rel in (
+        "grafana_dashboard.json",
+        os.path.join("grafana_provisioning", "dashboards", "fraud-tpu.json"),
+    ):
+        with open(os.path.join(MONITORING, rel)) as f:
+            text = f.read()
+        assert "slo_burn_rate" in text, rel
+        assert "slo_error_budget_remaining" in text, rel
+        assert "device_utilization_fraction" in text, rel
+        assert "scorer_flushes_total" in text, rel
+
+
+def test_graftcheck_alert_metric_rule_clean_on_repo():
+    """The panopticon lint gate: every committed rule file's exprs
+    reference only metrics registered in service/metrics.py (or the
+    sanctioned netserver exporter) — the dead-series alert class caught
+    at lint time, run here exactly as graftcheck runs it."""
+    from fraud_detection_tpu.analysis.core import analyze_file, get_rule
+
+    findings = analyze_file(
+        os.path.join(
+            REPO_ROOT, "fraud_detection_tpu", "service", "metrics.py"
+        ),
+        root=REPO_ROOT,
+        rules=[get_rule("alert-metric-registered")],
+    )
+    assert findings == [], [f.message for f in findings]

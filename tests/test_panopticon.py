@@ -1,0 +1,615 @@
+"""Panopticon acceptance tests (ISSUE 14): the fleet SLO engine, per-shard
+deep observability, binary-lane trace propagation, live roofline gauges,
+and the bench-trajectory gate.
+
+The acceptance spine:
+
+- with a 2-shard front and mixed single-row + ingest-block traffic, every
+  flush in the merged flight-recorder dump carries the shard that ran it,
+  and the per-shard scorer series exist for both shards;
+- ``slo_burn_rate`` / ``slo_error_budget_remaining`` series exist per lane
+  and MOVE under injected 503s;
+- a binary-lane frame carrying a W3C traceparent produces a server span
+  linked to the client's trace, with the stage decomposition as children;
+- ``device_utilization_fraction`` exports a finite nonzero value for a
+  warmed fused entrypoint under live traffic;
+- the graftcheck alert-metric rule and the trajectory regression gate do
+  what the CI steps claim.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.monitor.baseline import build_baseline_profile
+from fraud_detection_tpu.monitor.watchtower import Thresholds, Watchtower
+from fraud_detection_tpu.ops.logistic import LogisticParams
+from fraud_detection_tpu.ops.scaler import scaler_fit
+from fraud_detection_tpu.ops.scorer import BatchScorer
+from fraud_detection_tpu.service import binlane, metrics, tracing
+from fraud_detection_tpu.service.binlane import BinaryIngestServer, BinLaneClient
+from fraud_detection_tpu.service.microbatch import IngestBlock, MicroBatcher
+from fraud_detection_tpu.mesh.front import ShardFront
+from fraud_detection_tpu.telemetry import compile_sentinel, roofline, slo
+from fraud_detection_tpu.telemetry.flightrecorder import (
+    FlightRecorder,
+    RecorderSet,
+)
+
+D = 30
+THR = Thresholds(psi=0.2, ks=0.15, ece=0.1, disagree=0.05, min_rows=64)
+
+TRACEPARENT = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    return (rng.standard_normal((1024, D)) * 1.2).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def scorer(data):
+    rng = np.random.default_rng(0)
+    return BatchScorer(
+        LogisticParams(
+            coef=rng.standard_normal(D).astype(np.float32) * 0.3,
+            intercept=np.float32(-1.0),
+        ),
+        scaler_fit(data),
+    )
+
+
+@pytest.fixture(scope="module")
+def profile(data, scorer):
+    return build_baseline_profile(
+        data, scorer.predict_proba(data),
+        feature_names=[f"f{i}" for i in range(D)],
+    )
+
+
+@pytest.fixture()
+def fresh_slo(monkeypatch):
+    slo._reset_for_tests()
+    yield
+    slo._reset_for_tests()
+
+
+# -- SLO engine units -------------------------------------------------------
+
+
+def test_slo_burn_rate_math_and_windows(fresh_slo):
+    """Burn = (bad/total)/(1-objective), per window; old evidence drains
+    out of short windows while remaining in long ones."""
+    clock = {"t": 1000.0}
+    eng = slo.SLOEngine(
+        windows={"5m": 300.0, "1h": 3600.0, "6h": 21600.0},
+        bucket_s=10.0,
+        now_fn=lambda: clock["t"],
+    )
+    # 90 good + 10 bad at t0 → error rate 0.1; objective 0.999 →
+    # burn 0.1/0.001 = 100 on every window
+    for i in range(100):
+        eng.record("json", i % 10 != 0)
+    snap = eng.snapshot()["availability:json"]
+    assert snap["objective"] == pytest.approx(0.999)
+    assert snap["burn_rate"]["5m"] == pytest.approx(100.0)
+    assert snap["burn_rate"]["6h"] == pytest.approx(100.0)
+    assert snap["budget_remaining"] == pytest.approx(-99.0)
+    # 10 minutes later the 5m window has drained, the 6h one has not
+    clock["t"] += 600.0
+    for _ in range(50):
+        eng.record("json", True)
+    snap = eng.snapshot()["availability:json"]
+    assert snap["burn_rate"]["5m"] == 0.0
+    assert snap["burn_rate"]["6h"] > 0.0
+
+
+def test_slo_latency_objective_counts_slow_requests(fresh_slo):
+    eng = slo.SLOEngine(latency_threshold_s=0.1)
+    for _ in range(90):
+        eng.record("binary", True, 0.01)
+    for _ in range(10):
+        eng.record("binary", True, 0.5)  # over threshold: slow, not bad
+    snap = eng.snapshot()
+    assert snap["availability:binary"]["burn_rate"]["5m"] == 0.0
+    lat = snap["latency:binary"]
+    # 10% slow against a 0.99 objective → burn 10
+    assert lat["burn_rate"]["5m"] == pytest.approx(10.0)
+    # a FAILED request burns availability only — never double-bills latency
+    eng.record("binary", False, 9.9)
+    assert (
+        eng.snapshot()["latency:binary"]["window_bad"] == lat["window_bad"]
+    )
+
+
+def test_slo_fast_burn_condition_and_objective_override(
+    fresh_slo, monkeypatch
+):
+    monkeypatch.setenv("SLO_AVAILABILITY_OBJECTIVE_JSON", "0.9")
+    eng = slo.SLOEngine()
+    for _ in range(10):
+        eng.record("json", False)
+    snap = eng.snapshot()["availability:json"]
+    # per-lane override applied: all-bad traffic burns at 1/(1-0.9) = 10
+    assert snap["objective"] == pytest.approx(0.9)
+    assert snap["burn_rate"]["5m"] == pytest.approx(10.0)
+    assert not eng.fast_burn("json")  # 10 < 14.4
+    monkeypatch.setenv("SLO_FAST_BURN", "5")
+    assert eng.fast_burn("json")
+
+
+def test_slo_gauges_exist_per_lane_from_declaration(fresh_slo):
+    eng = slo.SLOEngine()
+    eng.declare_lanes()
+    eng.export_gauges()
+    text = metrics.render().decode()
+    for lane in ("json", "msgpack", "binary"):
+        assert f'slo_burn_rate{{slo="availability:{lane}",window="5m"}}' in text
+        assert f'slo_error_budget_remaining{{slo="availability:{lane}"}}' in text
+        assert f'slo_burn_rate{{slo="latency:{lane}",window="6h"}}' in text
+
+
+# -- injected 503s move the lane SLO (service level) ------------------------
+
+
+def test_injected_503s_move_the_json_lane_slo(
+    fresh_slo, tmp_path, monkeypatch
+):
+    """A model-less deployment answers 503 on /predict; the availability
+    burn for the json lane must rise and the error budget must drop —
+    exactly the question the SLO engine exists to answer."""
+    from fraud_detection_tpu.service.app import create_app
+    from fraud_detection_tpu.service.http import TestClient
+
+    monkeypatch.setenv("REQUIRE_REGISTRY_MODEL", "1")
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file:{tmp_path}/mlruns")
+    app = create_app(
+        database_url=f"sqlite:///{tmp_path}/fraud.db",
+        broker_url=f"sqlite:///{tmp_path}/taskq.db",
+    )
+    with TestClient(app) as client:
+        r = client.get("/slo/status")
+        assert r.status_code == 200
+        body = r.json()
+        assert body["enabled"] is True
+        assert body["slos"]["availability:json"]["burn_rate"]["5m"] == 0.0
+        budget_before = body["slos"]["availability:json"]["budget_remaining"]
+        for _ in range(5):
+            resp = client.post(
+                "/predict", json={"features": [0.1] * D}
+            )
+            assert resp.status_code == 503
+        body = client.get("/slo/status").json()
+        avail = body["slos"]["availability:json"]
+        assert avail["burn_rate"]["5m"] > 0.0
+        assert avail["budget_remaining"] < budget_before
+        # the gauges moved too (scrape surface)
+        text = client.get("/metrics").body.decode()
+        assert 'slo_burn_rate{slo="availability:json",window="5m"}' in text
+        for line in text.splitlines():
+            if line.startswith(
+                'slo_burn_rate{slo="availability:json",window="5m"}'
+            ):
+                assert float(line.rsplit(" ", 1)[1]) > 0.0
+
+
+# -- per-shard attribution: recorder rings + labeled series -----------------
+
+
+def _front(scorer, profile, recorders, wt=None, **kw):
+    batchers = [
+        MicroBatcher(
+            scorer=scorer, watchtower=wt, max_batch=64, max_wait_ms=1.0,
+            telemetry=True, recorder=recorders[i], shard_id=i, **kw,
+        )
+        for i in range(len(recorders))
+    ]
+    return ShardFront(batchers)
+
+
+def test_merged_flightrecorder_attributes_every_flush_to_its_shard(
+    fresh_slo, scorer, profile, data
+):
+    """MESH_SHARDS=2 + mixed single-row and ingest-block traffic: every
+    record in the merged dump carries its shard id, both shards appear,
+    and the rings stay bounded."""
+    recorders = [FlightRecorder(64), FlightRecorder(64)]
+    merged = RecorderSet(recorders)
+    front = _front(scorer, profile, recorders)
+
+    async def run():
+        await front.start()
+        try:
+            from fraud_detection_tpu.telemetry import RequestTimeline
+
+            # single rows CONCURRENTLY so least-in-flight routing spreads
+            # them over both shards (awaited-sequential traffic would pin
+            # the tie-broken first shard)
+            await asyncio.gather(
+                *(
+                    front.score(data[i], timeline=RequestTimeline(f"c{i}"))
+                    for i in range(40)
+                )
+            )
+            # ingest blocks (the binary-lane shape) — one item, one future
+            for k in range(6):
+                slot = scorer.staging.acquire(64)
+                try:
+                    n = 16
+                    np.copyto(slot.f32[:n], data[100 + 16 * k:100 + 16 * (k + 1)])
+                    await front.score_block(
+                        IngestBlock(slot, n),
+                        timeline=RequestTimeline(f"frame{k}"),
+                    )
+                finally:
+                    scorer.staging.release(slot)
+        finally:
+            await front.stop()
+
+    asyncio.run(run())
+    dump = merged.dump()
+    assert dump, "merged dump is empty"
+    shards_seen = {rec["shard"] for rec in dump}
+    assert shards_seen <= {0, 1}
+    assert len(shards_seen) == 2, (
+        f"both shards must have run flushes, saw {shards_seen}"
+    )
+    # per-shard rings stay bounded
+    assert len(recorders[0]) <= 64 and len(recorders[1]) <= 64
+    assert merged.capacity == 128
+    # newest-first merge
+    ts = [rec["ts"] for rec in dump]
+    assert ts == sorted(ts, reverse=True)
+    # the per-shard flush counters carry both shard labels
+    text = metrics.render().decode()
+    assert 'scorer_flushes_total{path="solo",shard="0"}' in text
+    assert 'scorer_flushes_total{path="solo",shard="1"}' in text
+    # the front fed the per-shard SLO series
+    eng = slo.engine()
+    snap = eng.snapshot()
+    assert snap["availability:shard0"]["total_good"] > 0
+    assert snap["availability:shard1"]["total_good"] > 0
+
+
+def test_shard_death_drops_gauge_series_and_revive_rebinds(
+    fresh_slo, scorer, profile, data
+):
+    """The stale-series discipline: draining a shard removes its per-shard
+    GAUGE series from the scrape; reviving it re-binds them on the next
+    flush. The monotone flush counter survives throughout."""
+    recorders = [FlightRecorder(16), FlightRecorder(16)]
+    front = _front(scorer, profile, recorders)
+
+    async def drive(n0=8):
+        for i in range(n0):
+            await front.score(data[i])
+
+    async def run():
+        await front.start()
+        try:
+            await drive()
+            assert 'scorer_queue_depth{shard="0"}' in metrics.render().decode()
+            front.drain(0)
+            text = metrics.render().decode()
+            assert 'scorer_queue_depth{shard="0"}' not in text
+            assert 'scorer_device_calls_per_flush{shard="0"}' not in text
+            assert 'scorer_effective_wait_seconds{shard="0"}' not in text
+            # the other shard's series and shard 0's counter survive
+            assert 'scorer_queue_depth{shard="1"}' in text
+            assert 'scorer_flushes_total{path="solo",shard="0"}' in text
+            front.revive(0)
+            front.drain(1)  # force traffic onto shard 0
+            await drive()
+            text = metrics.render().decode()
+            assert 'scorer_queue_depth{shard="0"}' in text
+        finally:
+            front.revive(1)
+            await front.stop()
+
+    asyncio.run(run())
+
+
+# -- binary-lane trace propagation ------------------------------------------
+
+
+class _StubSpan:
+    def __init__(self, name, span_id, start_time=None):
+        self.name = name
+        self.attributes = {}
+        self._ctx = type(
+            "Ctx", (), {"trace_id": 0xABC, "span_id": span_id, "trace_flags": 1}
+        )()
+
+    def set_attribute(self, k, v):
+        self.attributes[k] = v
+
+    def get_span_context(self):
+        return self._ctx
+
+    def end(self, end_time=None):
+        pass
+
+
+class _StubTracer:
+    def __init__(self):
+        self.spans = []
+        self._n = 0
+
+    def _new(self, name, start_time=None):
+        self._n += 1
+        s = _StubSpan(name, self._n, start_time)
+        self.spans.append(s)
+        return s
+
+    def start_as_current_span(self, name, **kw):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            yield self._new(name)
+
+        return cm()
+
+    def start_span(self, name, start_time=None, **kw):
+        return self._new(name, start_time)
+
+
+def test_frame_traceparent_roundtrip_and_malformed_degrades(scorer, data):
+    body = binlane.encode_frame(
+        data[:5], length_prefix=False, traceparent=TRACEPARENT
+    )
+    slot, n, entity, tp = binlane.decode_frame_body(scorer, body, max_rows=64)
+    try:
+        assert n == 5 and tp == TRACEPARENT
+    finally:
+        scorer.staging.release(slot)
+    # malformed context degrades to None — never a rejected frame
+    bad = bytearray(
+        binlane.encode_frame(
+            data[:5], length_prefix=False, traceparent=TRACEPARENT
+        )
+    )
+    bad[-binlane.TRACE_LEN:] = b"not-a-traceparent".ljust(
+        binlane.TRACE_LEN, b"\0"
+    )
+    slot, n, entity, tp = binlane.decode_frame_body(
+        scorer, bytes(bad), max_rows=64
+    )
+    try:
+        assert n == 5 and tp is None
+    finally:
+        scorer.staging.release(slot)
+
+
+def test_binlane_frame_with_traceparent_links_server_spans(
+    fresh_slo, scorer, data, monkeypatch
+):
+    """A socket-lane frame carrying a traceparent produces an
+    ``ingest.frame`` span linked to the client's trace with the stage
+    decomposition as child spans — the binary lane traces like /predict."""
+    stub = _StubTracer()
+    monkeypatch.setattr(tracing, "_tracer", stub)
+    monkeypatch.setattr(tracing, "_initialized", True)
+
+    class _LoopThread:
+        def __init__(self):
+            self.loop = asyncio.new_event_loop()
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            asyncio.set_event_loop(self.loop)
+            self.loop.run_forever()
+
+        def call(self, coro, timeout=60.0):
+            return asyncio.run_coroutine_threadsafe(
+                coro, self.loop
+            ).result(timeout)
+
+        def close(self):
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self._t.join(timeout=5.0)
+
+    lt = _LoopThread()
+    mb = MicroBatcher(
+        scorer=scorer, max_batch=128, max_wait_ms=1.0, telemetry=True
+    )
+    lt.call(mb.start())
+    srv = BinaryIngestServer(
+        mb, scorer_fn=lambda: scorer, host="127.0.0.1", port=0,
+        max_rows=128, stall_timeout=2.0,
+    )
+    srv.start(lt.loop)
+    try:
+        with BinLaneClient("127.0.0.1", srv.port) as cli:
+            scores, _ = cli.score_batch(data[:8], traceparent=TRACEPARENT)
+            assert scores.shape == (8,)
+        # the span is emitted after the response is written — wait for it
+        deadline = time.monotonic() + 5.0
+        frame_spans = []
+        while time.monotonic() < deadline:
+            frame_spans = [s for s in stub.spans if s.name == "ingest.frame"]
+            if frame_spans:
+                break
+            time.sleep(0.02)
+        assert frame_spans, "no ingest.frame span emitted"
+        span = frame_spans[0]
+        assert span.attributes["trace.parent"] == TRACEPARENT
+        assert span.attributes["lane"] == "binary"
+        assert span.attributes["rows"] == 8
+        stage_spans = [s for s in stub.spans if s.name.startswith("stage:")]
+        assert {s.name for s in stage_spans} >= {
+            "stage:device_compute", "stage:respond"
+        }
+        # the lane's SLO series moved on the good side
+        snap = slo.engine().snapshot()["availability:binary"]
+        assert snap["total_good"] >= 1 and snap["total_bad"] == 0
+    finally:
+        srv.stop()
+        lt.call(mb.stop())
+        lt.close()
+
+
+# -- roofline ---------------------------------------------------------------
+
+
+def test_roofline_capture_and_utilization_unit(monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    roofline._reset_for_tests()
+    monkeypatch.setenv("DEVICE_PEAK_FLOPS", "1e9")
+    assert roofline.ensure_peak() == pytest.approx(1e9)
+
+    f = jax.jit(lambda x: (x @ x.T).sum(axis=1))
+    wrapped = compile_sentinel.instrument("unit.flush", f)
+    x = jnp.ones((64, 16), jnp.float32)
+    with compile_sentinel.expected_compiles():
+        wrapped(x)  # miss → cost capture for (unit.flush, 64)
+    snap = roofline.snapshot()
+    assert snap["programs"].get("unit.flush@64", {}).get("flops", 0) > 0
+    # pair a measured duration with the dispatch this thread just made
+    wrapped(x)
+    roofline.note_device_time(0.01)
+    util = metrics.device_utilization_fraction.labels(
+        "unit.flush"
+    )._value.get()
+    assert np.isfinite(util) and util > 0.0
+    roofline._reset_for_tests()
+
+
+def test_roofline_exports_utilization_for_warmed_fused_flush(
+    fresh_slo, scorer, profile, data
+):
+    """The acceptance bar: under live fused traffic the warmed entrypoint
+    exports a finite nonzero device_utilization_fraction."""
+    roofline._reset_for_tests()
+    wrapped = compile_sentinel.install()
+    # earlier tests may have warmed the fused executables: clear the jit
+    # cache so this test's flushes MISS and the sentinel captures costs,
+    # exactly as a fresh process (sentinel installs before any model) does
+    from fraud_detection_tpu.monitor import drift as drift_mod
+
+    fn = drift_mod._fused_flush
+    getattr(fn, "__wrapped__", fn).clear_cache()
+    wt = Watchtower(profile, thresholds=THR)
+    try:
+
+        async def run():
+            mb = MicroBatcher(
+                scorer=scorer, watchtower=wt, max_batch=64,
+                max_wait_ms=1.0, telemetry=True, fused=True,
+            )
+            await mb.start()
+            try:
+                await asyncio.gather(
+                    *(mb.score(data[i]) for i in range(96))
+                )
+            finally:
+                await mb.stop()
+
+        asyncio.run(run())
+        util = metrics.device_utilization_fraction.labels(
+            "fastlane.flush"
+        )._value.get()
+        assert np.isfinite(util) and util > 0.0, (
+            "warmed fused entrypoint must export a live utilization"
+        )
+        snap = roofline.snapshot()
+        assert snap["peak_flops"] > 0
+        assert any(
+            k.startswith("fastlane.flush@") for k in snap["programs"]
+        )
+    finally:
+        wt.drain()
+        wt.close()
+        compile_sentinel.uninstall()
+        roofline._reset_for_tests()
+
+
+# -- bench trajectory -------------------------------------------------------
+
+
+def _bench_file(tmp_path, name, **keys):
+    p = tmp_path / name
+    p.write_text(json.dumps(keys))
+    return str(p)
+
+
+def test_trajectory_gates_same_host_regressions(tmp_path):
+    from fraud_detection_tpu.analysis import trajectory
+
+    traj = str(tmp_path / "BENCH_TRAJECTORY.json")
+    b1 = _bench_file(
+        tmp_path, "b1.json",
+        microbatch_flush_speedup=1.5, telemetry_overhead_frac=0.03,
+        online_binary_rows_per_sec=100000.0,
+    )
+    entry, reg = trajectory.append([b1], traj)
+    assert reg == [] and entry["compared_to"] is None
+    # within tolerance: clean
+    b2 = _bench_file(
+        tmp_path, "b2.json",
+        microbatch_flush_speedup=1.4, telemetry_overhead_frac=0.035,
+        online_binary_rows_per_sec=95000.0,
+    )
+    _, reg = trajectory.append([b2], traj)
+    assert reg == []
+    # >15% drop on a higher-is-better headline: gated
+    b3 = _bench_file(
+        tmp_path, "b3.json",
+        microbatch_flush_speedup=1.0, telemetry_overhead_frac=0.03,
+        online_binary_rows_per_sec=95000.0,
+    )
+    _, reg = trajectory.append([b3], traj)
+    assert any("fused_speedup" in r for r in reg)
+    entries = json.load(open(traj))
+    assert len(entries) == 3
+    assert entries[-1]["regressions"]
+
+
+def test_trajectory_overhead_slack_and_host_mismatch(tmp_path, monkeypatch):
+    from fraud_detection_tpu.analysis import trajectory
+
+    traj = str(tmp_path / "t.json")
+    b1 = _bench_file(tmp_path, "b1.json", telemetry_overhead_frac=0.001)
+    trajectory.append([b1], traj)
+    # 10x relative jump but within the absolute slack: NOT a regression
+    b2 = _bench_file(tmp_path, "b2.json", telemetry_overhead_frac=0.01)
+    _, reg = trajectory.append([b2], traj)
+    assert reg == []
+    # a different host never gates — it seeds its own baseline
+    monkeypatch.setattr(
+        trajectory, "host_fingerprint", lambda: "other-host"
+    )
+    b3 = _bench_file(tmp_path, "b3.json", telemetry_overhead_frac=0.9)
+    entry, reg = trajectory.append([b3], traj)
+    assert reg == [] and entry["compared_to"] is None
+
+
+def test_trajectory_cli_exit_codes(tmp_path):
+    from fraud_detection_tpu.analysis import trajectory
+
+    traj = str(tmp_path / "t.json")
+    good = _bench_file(tmp_path, "g.json", microbatch_flush_speedup=1.5)
+    assert trajectory.main([good, "--trajectory", traj]) == 0
+    bad = _bench_file(tmp_path, "b.json", microbatch_flush_speedup=0.5)
+    assert trajectory.main([bad, "--trajectory", traj]) == 1
+
+
+def test_committed_trajectory_is_valid():
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    entries = json.load(open(os.path.join(repo, "BENCH_TRAJECTORY.json")))
+    assert isinstance(entries, list) and entries
+    for e in entries:
+        assert "host" in e and "headlines" in e and "ts" in e

@@ -468,7 +468,7 @@ def test_microbatcher_int8_wire_single_dispatch(data, scaler, profile):
     assert calls["split_update"] == 0, (
         "int8 wire demoted to the split flush — quickwire regression"
     )
-    assert metrics.scorer_device_calls_per_flush._value.get() == 1
+    assert metrics.scorer_device_calls_per_flush.labels("0")._value.get() == 1
     assert metrics.scorer_wire_fused._value.get() == 1
     assert wt.drift.rows_seen == 48
 
@@ -551,7 +551,7 @@ def test_demotion_is_logged_and_exported(data, profile, caplog):
         r for r in caplog.records if "opts out of the fused flush" in r.message
     ]
     assert len(demotions) == 1, "demotion must log exactly once at startup"
-    assert metrics.scorer_device_calls_per_flush._value.get() == 2
+    assert metrics.scorer_device_calls_per_flush.labels("0")._value.get() == 2
 
 
 # -- calibration lifecycle (stamp + hot-swap rebind) ---------------------------

@@ -442,6 +442,17 @@ def test_scenario_replica_burst():
 
 
 @pytest.mark.slow
+def test_scenario_slo_burn_under_shed():
+    """Panopticon (ISSUE 14): a Pareto burst drives real admission sheds —
+    the SLO engine's fast-burn condition fires within its shortest window,
+    the error budget drops, and the condition clears without flapping once
+    recovery traffic drains the windows."""
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario("slo_burn_under_shed").raise_if_failed()
+
+
+@pytest.mark.slow
 def test_scenario_ingest_storm():
     """Hyperloop (ISSUE 11): the binary lane under an open-loop Pareto
     storm with a mid-burst shard drain — bounded sheds with Retry-After,

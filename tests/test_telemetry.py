@@ -181,7 +181,7 @@ def test_timeline_incomplete_stages_read_zero():
 def test_flightrecorder_ring_wraps_and_dumps_newest_first():
     rec = FlightRecorder(capacity=4)
     for i in range(10):
-        rec.record((float(i), f"c{i}", 1, 8, None, None, False, {}, 0.0))
+        rec.record((float(i), f"c{i}", 1, 8, None, None, False, 0, {}, 0.0))
     assert len(rec) == 4
     assert rec.total_recorded == 10
     dump = rec.dump()
@@ -189,7 +189,7 @@ def test_flightrecorder_ring_wraps_and_dumps_newest_first():
     assert rec.dump(limit=2)[0]["ts"] == 9.0
     assert set(dump[0]) == {
         "ts", "correlation_id", "batch_size", "bucket", "model_version",
-        "model_source", "drift", "stages", "total_s",
+        "model_source", "drift", "shard", "stages", "total_s",
     }
 
 
