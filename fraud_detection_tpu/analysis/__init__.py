@@ -35,6 +35,7 @@ from fraud_detection_tpu.analysis.core import (  # noqa: F401
 )
 
 # Importing the rule modules populates the registry.
+from fraud_detection_tpu.analysis import rules_artifacts  # noqa: F401,E402
 from fraud_detection_tpu.analysis import rules_jax  # noqa: F401,E402
 from fraud_detection_tpu.analysis import rules_monitoring  # noqa: F401,E402
 from fraud_detection_tpu.analysis import rules_perf  # noqa: F401,E402

@@ -1,0 +1,130 @@
+"""Artifact-durability lint: every trusted ``.npz`` must land atomically.
+
+The loaders trust whatever bytes sit at ``model.npz`` /
+``quant_calibration.npz`` / ``ledger_state.npz`` / ``monitor_profile.npz``
+— a crash mid-``np.savez`` leaves a torn archive at the trusted name and
+the next process start serves garbage (or dies in ``np.load``). The
+lifeboat work (ISSUE 15) centralized the fix in
+:mod:`fraud_detection_tpu.ckpt.atomic` (tmp → fsync → rename → dir fsync);
+this rule is the mechanical guard that keeps bare writes from regrowing:
+
+- any ``np.savez``/``np.savez_compressed`` call outside ``ckpt/atomic.py``
+  is an ERROR (``atomic_savez`` is the drop-in replacement; serializing to
+  an in-memory buffer belongs in ``ckpt/atomic.savez_bytes``);
+- ``open(..., "wb")`` / ``"ab"`` of a path naming a ``.npz`` artifact
+  (string literal, f-string suffix, ``os.path.join`` tail, or a
+  module-level ``*_FILE`` constant) is an ERROR for the same reason.
+
+Reviewed exceptions carry the standard
+``# graftcheck: ignore[artifact-nonatomic-write]`` tag.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fraud_detection_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Severity,
+    dotted_name,
+    register_rule,
+)
+
+#: the one sanctioned home of a bare np.savez (the helper itself)
+_ATOMIC_HELPER_SUFFIX = "ckpt/atomic.py"
+
+_SAVEZ_FNS = {"savez", "savez_compressed"}
+_NP_MODULES = {"np", "numpy", "jnp", "onp"}
+
+_WRITE_MODES = {"wb", "ab", "wb+", "ab+", "w+b", "a+b"}
+
+
+def _module_str_consts(mod: ModuleInfo) -> dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings — resolves the common
+    ``LEDGER_FILE = "ledger_state.npz"`` indirection."""
+    out: dict[str, str] = {}
+    for node in mod.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _npz_suffix(node: ast.AST, consts: dict[str, str]) -> bool:
+    """Does this path expression *provably* end with ``.npz``? Conservative:
+    unresolvable expressions are not flagged (no false positives on
+    arbitrary variables)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.endswith(".npz")
+    if isinstance(node, ast.Name):
+        return consts.get(node.id, "").endswith(".npz")
+    if isinstance(node, ast.JoinedStr) and node.values:
+        last = node.values[-1]
+        return (
+            isinstance(last, ast.Constant)
+            and isinstance(last.value, str)
+            and last.value.endswith(".npz")
+        )
+    if isinstance(node, ast.Call):
+        callee = dotted_name(node.func)
+        if callee in ("os.path.join", "posixpath.join", "Path") and node.args:
+            return _npz_suffix(node.args[-1], consts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _npz_suffix(node.right, consts)
+    return False
+
+
+@register_rule(
+    "artifact-nonatomic-write",
+    Severity.ERROR,
+    "bare np.savez / open('...npz', 'wb') write of a trusted artifact — a "
+    "crash mid-write leaves a torn file at the name every loader trusts; "
+    "use ckpt/atomic.atomic_savez (tmp + fsync + rename + dir fsync)",
+)
+def check_artifact_nonatomic_write(mod: ModuleInfo) -> Iterator[Finding]:
+    rule = check_artifact_nonatomic_write.rule
+    if mod.rel_path.replace("\\", "/").endswith(_ATOMIC_HELPER_SUFFIX):
+        return
+    consts = _module_str_consts(mod)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        if callee is None:
+            continue
+        parts = callee.split(".")
+        if (
+            len(parts) == 2
+            and parts[0] in _NP_MODULES
+            and parts[1] in _SAVEZ_FNS
+        ):
+            yield mod.finding(
+                rule, node,
+                f"{callee}(...) writes the archive in place — a crash "
+                "mid-write leaves a torn file at the trusted name; use "
+                "ckpt/atomic.atomic_savez (or savez_bytes + "
+                "atomic_write_bytes for framed containers)",
+            )
+            continue
+        if callee == "open" and len(node.args) >= 2:
+            mode = node.args[1]
+            if not (
+                isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and mode.value in _WRITE_MODES
+            ):
+                continue
+            if _npz_suffix(node.args[0], consts):
+                yield mod.finding(
+                    rule, node,
+                    "open(..., 'wb') of a .npz artifact bypasses the "
+                    "atomic write discipline — route the bytes through "
+                    "ckpt/atomic.atomic_write_bytes",
+                )

@@ -46,6 +46,12 @@ HEADLINES: dict[str, tuple[str, str, float]] = {
     "online_json_rows_per_sec": ("online_json_rows_per_sec", "higher", 0.0),
     "telemetry_overhead_frac": ("telemetry_overhead_frac", "lower", 0.01),
     "explain_cost_ratio": ("explain_cost_ratio", "higher", 0.0),
+    "recovery_replay_rows_per_sec": (
+        "recovery_replay_rows_per_sec", "higher", 0.0,
+    ),
+    "recovery_snapshot_overhead_frac": (
+        "recovery_snapshot_overhead_frac", "lower", 0.01,
+    ),
 }
 
 

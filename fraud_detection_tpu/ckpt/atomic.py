@@ -1,0 +1,94 @@
+"""Crash-consistent artifact writes: the ONE tmp→fsync→rename helper.
+
+Every ``.npz`` artifact the system trusts at load time — ``model.npz``,
+``quant_calibration.npz``, ``ledger_state.npz``, ``wide_params.npz``,
+``monitor_profile.npz``, the SGD epoch checkpoints — was previously written
+with a bare ``np.savez(path)``: a crash (OOM-kill, power, disk-full) mid-
+write leaves a TORN file at the final name, and every loader in the repo
+trusts whatever bytes sit there. The lifeboat durability work (ISSUE 15)
+makes torn-artifact handling a first-class contract, and this module is the
+write side of it:
+
+- bytes land in a temp file **in the same directory** (same filesystem, so
+  the rename is atomic),
+- the temp file is flushed and ``fsync``-ed (data durable before the name
+  flips),
+- ``os.replace`` swaps it in (readers see the old bytes or the new bytes,
+  never a mixture),
+- the **directory** is fsynced afterwards (the rename itself durable —
+  without it a power cut can roll the directory entry back to the old
+  file even though the data blocks were synced).
+
+The graftcheck rule ``artifact-nonatomic-write`` (ERROR) flags any bare
+``np.savez``/``np.savez_compressed`` outside this module, so the eight
+call sites this helper replaced can't silently regrow.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tempfile
+
+import numpy as np
+
+
+def fsync_dir(directory: str) -> None:
+    """Best-effort directory fsync — makes a just-completed rename durable.
+    Platforms/filesystems that refuse O_RDONLY dir fds (some network
+    mounts) degrade to the rename-only guarantee."""
+    try:
+        fd = os.open(directory or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # graftcheck: ignore[silent-except] — best-effort on fs without dir fsync
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> str:
+    """Write ``data`` to ``path`` crash-consistently: tmp file beside the
+    target, fsync, atomic rename, directory fsync. A reader concurrent
+    with (or interrupted by) the write sees either the complete old file
+    or the complete new one."""
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(
+        dir=directory, prefix=os.path.basename(path) + ".", suffix=".tmp"
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # a crash-simulating BaseException (range ReplicaKilled) or a real
+        # failure: never leave the temp file to be mistaken for an artifact
+        try:
+            os.unlink(tmp)
+        except OSError:  # graftcheck: ignore[silent-except] — tmp already renamed/gone
+            pass
+        raise
+    fsync_dir(directory)
+    return path
+
+
+def atomic_savez(path: str, **arrays) -> str:
+    """``np.savez`` with the atomic-write discipline. The archive is
+    serialized in memory first (artifacts here are small — model weights,
+    histograms, the hashed entity table), then lands via
+    :func:`atomic_write_bytes`, so a crash mid-stamp can never leave a
+    torn ``.npz`` at the trusted name."""
+    return atomic_write_bytes(path, savez_bytes(**arrays))
+
+
+def savez_bytes(**arrays) -> bytes:
+    """Serialize an npz archive to bytes — for callers that embed the
+    archive inside a larger CRC-framed container (the lifeboat snapshot)
+    and land THAT via :func:`atomic_write_bytes`."""
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()

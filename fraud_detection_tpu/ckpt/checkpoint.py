@@ -22,6 +22,7 @@ import os
 
 import numpy as np
 
+from fraud_detection_tpu.ckpt.atomic import atomic_savez
 from fraud_detection_tpu.ops.logistic import LogisticParams
 from fraud_detection_tpu.ops.scaler import ScalerParams
 
@@ -57,7 +58,7 @@ def save_artifacts(
             scaler_var=np.asarray(scaler.var, np.float64),
             scaler_n=np.asarray(scaler.n_samples, np.float64),
         )
-    np.savez(os.path.join(directory, NATIVE_FILE), **state)
+    atomic_savez(os.path.join(directory, NATIVE_FILE), **state)
     with open(os.path.join(directory, FEATURES_FILE), "w") as f:
         json.dump(list(feature_names), f)
     return directory
@@ -110,7 +111,7 @@ def save_gbt_artifacts(
     }
     if background is not None:
         state["gbt_background"] = np.asarray(background, np.float32)
-    np.savez(os.path.join(directory, NATIVE_FILE), **state)
+    atomic_savez(os.path.join(directory, NATIVE_FILE), **state)
     with open(os.path.join(directory, FEATURES_FILE), "w") as f:
         json.dump(list(feature_names), f)
     return directory

@@ -29,9 +29,10 @@ from __future__ import annotations
 import json
 import os
 import re
-import tempfile
 
 import numpy as np
+
+from fraud_detection_tpu.ckpt.atomic import atomic_savez
 
 _FILE_RE = re.compile(r"^sgd_epoch_(\d{5})\.npz$")
 
@@ -63,15 +64,10 @@ class SGDCheckpointer:
         if fingerprint is not None:
             state["fingerprint"] = np.array(json.dumps(fingerprint))
         path = os.path.join(self.directory, f"sgd_epoch_{epoch:05d}.npz")
-        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez(f, **state)
-            os.replace(tmp, path)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        # previously a hand-rolled mkstemp+replace WITHOUT fsync: a power
+        # cut could still surface a torn checkpoint. The shared helper adds
+        # the data + directory fsyncs (ckpt/atomic).
+        atomic_savez(path, **state)
         self._prune()
         return path
 

@@ -380,6 +380,45 @@ def ledger_synth_events_per_entity() -> int:
 
 
 # --------------------------------------------------------------------------
+# Lifeboat: crash-consistent durability + warm restart (lifeboat/)
+# --------------------------------------------------------------------------
+
+def lifeboat_dir() -> str:
+    """``LIFEBOAT_DIR`` — directory for snapshot generations + entity
+    journals. Empty (the default) disables the durability layer: the
+    ledger then lives only on device and a crash erases everything since
+    the train-time stamp (the pre-lifeboat behavior)."""
+    return _get("LIFEBOAT_DIR", "")
+
+
+def lifeboat_snapshot_s() -> float:
+    """``LIFEBOAT_SNAPSHOT_S`` — seconds between async snapshot
+    generations (the d2h fetch of the donated table + drift windows rides
+    between flushes; no extra device dispatches). Default 300."""
+    return _get_float("LIFEBOAT_SNAPSHOT_S", 300.0)
+
+
+def lifeboat_snapshot_flushes() -> int:
+    """``LIFEBOAT_SNAPSHOT_FLUSHES`` — additionally snapshot after this
+    many journaled flushes (0 = time-based only, the default). Bounds the
+    journal-tail replay length under sustained heavy traffic."""
+    return _get_int("LIFEBOAT_SNAPSHOT_FLUSHES", 0)
+
+
+def lifeboat_keep() -> int:
+    """``LIFEBOAT_KEEP`` — snapshot generations retained; a torn newest
+    file falls back one generation, so keep ≥ 2. Default 3."""
+    return max(_get_int("LIFEBOAT_KEEP", 3), 1)
+
+
+def lifeboat_fsync_s() -> float:
+    """``LIFEBOAT_FSYNC_S`` — journal fsync cadence: rows appended within
+    this window are the crash-loss bound (``lifeboat_journal_lag_rows``).
+    0 fsyncs every append (zero loss, an fsync per flush). Default 0.5."""
+    return _get_float("LIFEBOAT_FSYNC_S", 0.5)
+
+
+# --------------------------------------------------------------------------
 # Broadside: the tensor-parallel wide family (ops/crosses, mesh 2-D)
 # --------------------------------------------------------------------------
 

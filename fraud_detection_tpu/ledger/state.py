@@ -211,9 +211,11 @@ def device_state(state: LedgerState | None, slots: int) -> LedgerState:
 def save_ledger(directory: str, spec: LedgerSpec, state: LedgerState) -> str:
     """Stamp ``ledger_state.npz`` (spec + table snapshot) beside the model
     artifacts — the thing ``ModelReloader`` rebinds on hot swap."""
+    from fraud_detection_tpu.ckpt.atomic import atomic_savez
+
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, LEDGER_FILE)
-    np.savez(
+    atomic_savez(
         path,
         n_base=np.int64(spec.n_base),
         slots=np.int64(spec.slots),

@@ -1,0 +1,35 @@
+"""lifeboat: crash-consistent durability + warm restart for device-resident
+serving state (ISSUE 15).
+
+The ledger's per-entity velocity table and the drift windows live ONLY in
+donated device pytrees — a crash erases every aggregate accumulated since
+the train-time stamp. This package is the durability layer: CRC-stamped
+generational snapshots (:mod:`.snapshot`), a write-ahead entity journal
+(:mod:`.journal`), the traced-body replay that rebuilds state on restart
+(:mod:`.recovery`), and the :class:`~.boat.Lifeboat` manager that wires
+them into the serving process. See docs/runbooks/DisasterRecovery.md.
+"""
+
+from fraud_detection_tpu.lifeboat.boat import IDLE, READY, RECOVERING, Lifeboat  # noqa: F401
+from fraud_detection_tpu.lifeboat.journal import (  # noqa: F401
+    Journal,
+    JournalTail,
+    list_journals,
+    read_journal_file,
+    read_tail,
+)
+from fraud_detection_tpu.lifeboat.recovery import (  # noqa: F401
+    RecoveryReport,
+    recover,
+    replay_records,
+    replay_rows,
+)
+from fraud_detection_tpu.lifeboat.snapshot import (  # noqa: F401
+    Snapshot,
+    TornSnapshot,
+    list_snapshots,
+    load_latest,
+    load_snapshot,
+    spec_hash,
+    write_snapshot,
+)

@@ -1,0 +1,317 @@
+"""The lifeboat: crash-consistent durability for device-resident state.
+
+One :class:`Lifeboat` per serving process owns three jobs:
+
+1. **Journal** (write-ahead, on the flush path): the micro-batcher calls
+   :meth:`journal_staged` under :attr:`flush_lock` immediately before the
+   fused stateful dispatch, appending the flush's entity triples (fp, ts,
+   wire-consumed amount) as one CRC-framed record. The lock couples the
+   journal's sequence numbers to dispatch order, so a snapshot cut is
+   always consistent: every flush with ``seq ≤ snapshot_seq`` has been
+   dispatched into the table the snapshot reads.
+2. **Async snapshotter** (maintenance thread, off the hot path): every
+   ``LIFEBOAT_SNAPSHOT_S`` seconds (or ``LIFEBOAT_SNAPSHOT_FLUSHES``
+   flushes), fetch the donated ledger table + drift windows between
+   flushes (a d2h materialization of the live pytrees — zero extra device
+   dispatches), rotate the journal at the captured sequence number, and
+   land a CRC-stamped generation via the atomic writer, retaining
+   ``LIFEBOAT_KEEP`` generations. The same thread drives the journal's
+   fsync cadence (``LIFEBOAT_FSYNC_S``) and refreshes the snapshot-age
+   gauge.
+3. **Warm restart** (:meth:`recover`): load the newest valid generation
+   (falling back per torn file), replay the journal tail through the SAME
+   traced ledger body — one dispatch per journaled flush, the serving
+   segmentation (see :func:`~.recovery.replay_records` for why that is
+   what makes the result bitwise) — bind the recovered table + windows
+   into the drift monitor (same shapes/dtypes — zero new compiles), and flip
+   :attr:`state` ``recovering → ready``. The serving edges 503 with
+   ``Retry-After`` while ``recovering`` so traffic can't fold into a table
+   about to be replaced.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import numpy as np
+
+from fraud_detection_tpu import config
+from fraud_detection_tpu.ledger.state import LedgerSpec
+from fraud_detection_tpu.lifeboat import journal as journal_mod
+from fraud_detection_tpu.lifeboat import recovery as recovery_mod
+from fraud_detection_tpu.lifeboat import snapshot as snapshot_mod
+from fraud_detection_tpu.range.faults import fire
+from fraud_detection_tpu.service import metrics
+
+log = logging.getLogger("fraud_detection_tpu.lifeboat")
+
+IDLE = "idle"
+RECOVERING = "recovering"
+READY = "ready"
+
+#: maintenance-thread tick — the resolution of the fsync cadence and the
+#: snapshot-age gauge, far below any sane LIFEBOAT_SNAPSHOT_S
+_TICK_S = 0.2
+
+
+class Lifeboat:
+    def __init__(
+        self,
+        directory: str,
+        spec: LedgerSpec,
+        drift=None,
+        slot=None,
+        snapshot_s: float | None = None,
+        snapshot_flushes: int | None = None,
+        keep: int | None = None,
+        fsync_s: float | None = None,
+    ):
+        self.directory = directory
+        self.spec = spec
+        self.drift = drift
+        self.slot = slot  # lifecycle ModelSlot (snapshot version stamp)
+        self.snapshot_s = (
+            snapshot_s if snapshot_s is not None else config.lifeboat_snapshot_s()
+        )
+        self.snapshot_flushes = (
+            snapshot_flushes
+            if snapshot_flushes is not None
+            else config.lifeboat_snapshot_flushes()
+        )
+        self.keep = keep if keep is not None else config.lifeboat_keep()
+        self.fsync_s = (
+            fsync_s if fsync_s is not None else config.lifeboat_fsync_s()
+        )
+        self.spec_hash = snapshot_mod.spec_hash(spec)
+        self.state = IDLE
+        #: couples {journal append → fused dispatch} on the flush path and
+        #: {table+window read → seq capture → rotate} on the snapshot path:
+        #: both sides hold it, so a snapshot cut can never split a flush
+        #: from its journal record
+        self.flush_lock = threading.Lock()
+        self.journal: journal_mod.Journal | None = None
+        self.last_report: recovery_mod.RecoveryReport | None = None
+        self._flushes_since_snapshot = 0
+        self._last_snapshot_t = time.time()
+        self._snapshot_requested = threading.Event()
+        self._last_fsync_t = time.time()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        metrics.lifeboat_journal_lag_rows.set(0)
+
+    # -- warm restart ------------------------------------------------------
+    def recover(self) -> recovery_mod.RecoveryReport:
+        """Run the warm restart and bind the result. Idempotent per
+        process start; flips ``state`` recovering → ready (ready even on a
+        refused/empty recovery — the process then serves the train-time
+        stamp, which is the documented fallback, and journaling starts
+        fresh either way)."""
+        self.state = RECOVERING
+        t0 = time.perf_counter()
+        try:
+            rep = recovery_mod.recover(self.directory, self.spec)
+            self.last_report = rep
+            if rep.restored and rep.state is not None and self.drift is not None:
+                # same shapes/dtypes as the table already bound → the warmed
+                # fused executables keep serving with ZERO new compiles
+                self.drift.bind_ledger(self.spec, rep.state)
+                if rep.window is not None and hasattr(
+                    self.drift, "restore_window"
+                ):
+                    self.drift.restore_window(
+                        rep.window,
+                        shard_window=rep.shard_window,
+                        rows_seen=rep.rows_seen or None,
+                    )
+            metrics.lifeboat_replayed_rows.inc(rep.replayed_rows)
+            if rep.torn_rows:
+                metrics.lifeboat_torn_tail_rows.inc(rep.torn_rows)
+            metrics.lifeboat_recovery_duration.set(rep.duration_s)
+            # snapshot age continues from the generation we restored — a
+            # process that restarts every few minutes without snapshotting
+            # must still trip SnapshotStale
+            if rep.snapshot_created_at:
+                self._last_snapshot_t = rep.snapshot_created_at
+            self.journal = journal_mod.Journal(
+                self.directory,
+                self.spec_hash,
+                base_seq=rep.resume_seq,
+                fsync_s=self.fsync_s,
+            )
+            return rep
+        finally:
+            self.state = READY
+            metrics.lifeboat_recovery_duration.set(time.perf_counter() - t0)
+            metrics.lifeboat_snapshot_age.set(
+                max(0.0, time.time() - self._last_snapshot_t)
+            )
+
+    # -- the flush-path hook ----------------------------------------------
+    def journal_staged(self, slot, hx, dequant_scale, n_rows: int) -> None:
+        """Append one staged flush's entity triples. Called by the
+        micro-batcher UNDER :attr:`flush_lock`, immediately before the
+        fused dispatch. ``hx`` is the wire-encoded batch the program will
+        consume; the journaled amount is computed from it exactly as the
+        traced body will (dequantized codes on the int8 wire, upcast on
+        bf16), so replay folds the same floats serving folded."""
+        journal = self.journal
+        if journal is None or self.state != READY:
+            return
+        self._flushes_since_snapshot += 1
+        lh = slot.lh
+        mask = lh != 0
+        n = int(mask.sum())
+        if not n:
+            return
+        fp = slot.lf[mask]
+        ts = slot.lt[mask]
+        # mask BEFORE the f32 upcast: the copy is n rows, not the bucket
+        # (this hook is on the flush hot path — the bench recovery gate
+        # prices it at ≤5% of the fused flush loop)
+        col = np.asarray(hx)[: lh.shape[0], self.spec.amount_col]
+        amt = col[mask].astype(np.float32)
+        if dequant_scale is not None:
+            scale = np.asarray(dequant_scale, np.float32).reshape(-1)
+            amt = amt * scale[self.spec.amount_col]
+        seq = journal.append(fp, ts, amt)
+        metrics.lifeboat_journal_lag_rows.set(journal.pending_rows)
+        # range injection point: crash_warm_restart kills here — AFTER the
+        # record is durable (fsync-per-append in the scenario), BEFORE the
+        # dispatch lands, pinning journal-ahead consistency
+        fire("lifeboat.journal", seq=seq, rows=n)
+
+    # -- snapshotting ------------------------------------------------------
+    def take_snapshot(self) -> str | None:
+        """Capture a consistent {table, windows, seq} cut and land one
+        generation. The lock is held only for the d2h materialization +
+        journal rotation; serialization and the atomic file write run
+        outside it."""
+        drift = self.drift
+        journal = self.journal
+        if drift is None or journal is None:
+            return None
+        with self.flush_lock:
+            table = drift.ledger_snapshot()
+            if table is None:
+                return None
+            window = (
+                drift.window_snapshot()
+                if hasattr(drift, "window_snapshot")
+                else None
+            )
+            shard_window = (
+                drift.shard_window_snapshot()
+                if hasattr(drift, "shard_window_snapshot")
+                else None
+            )
+            rows_seen = int(getattr(drift, "rows_seen", 0))
+            seq = journal.seq
+            # everything ≤ seq is in the table we just read; make it
+            # durable and start the next inter-snapshot journal interval
+            journal.rotate(seq)
+            self._flushes_since_snapshot = 0
+        # range injection point: kill_mid_snapshot fires here — the
+        # generation file has NOT landed yet, so a kill leaves the previous
+        # generation + a rotated journal, exactly what fallback replays
+        fire("lifeboat.snapshot", seq=seq)
+        path = snapshot_mod.write_snapshot(
+            self.directory,
+            seq,
+            self.spec,
+            table,
+            window=window,
+            shard_window=shard_window,
+            slot_version=getattr(self.slot, "version", None),
+            rows_seen=rows_seen,
+        )
+        self._last_snapshot_t = time.time()
+        metrics.lifeboat_snapshot_age.set(0.0)
+        metrics.lifeboat_journal_lag_rows.set(journal.pending_rows)
+        snapshot_mod.prune_snapshots(self.directory, self.keep)
+        kept = snapshot_mod.list_snapshots(self.directory)
+        if kept:
+            journal_mod.prune_journals(self.directory, kept[0][0])
+        log.info(
+            "lifeboat: snapshot generation %d landed (%s)", seq, path
+        )
+        return path
+
+    def request_snapshot(self) -> None:
+        """Ask the maintenance thread for an immediate snapshot — the
+        shard-front revive hook (a revive follows an outage; capture a
+        durable point now rather than a full interval later)."""
+        self._snapshot_requested.set()
+
+    # -- maintenance thread ------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="lifeboat", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(_TICK_S):
+            try:
+                now = time.time()
+                metrics.lifeboat_snapshot_age.set(
+                    max(0.0, now - self._last_snapshot_t)
+                )
+                journal = self.journal
+                if (
+                    journal is not None
+                    and self.fsync_s > 0
+                    and journal.pending_rows
+                    and now - self._last_fsync_t >= self.fsync_s
+                ):
+                    journal.sync()
+                    self._last_fsync_t = now
+                    metrics.lifeboat_journal_lag_rows.set(0)
+                due = (
+                    self._snapshot_requested.is_set()
+                    or (now - self._last_snapshot_t) >= self.snapshot_s
+                    or (
+                        self.snapshot_flushes > 0
+                        and self._flushes_since_snapshot
+                        >= self.snapshot_flushes
+                    )
+                )
+                if due and self.state == READY:
+                    self._snapshot_requested.clear()
+                    self.take_snapshot()
+            except Exception:
+                log.exception("lifeboat maintenance tick failed")
+
+    def close(self, final_snapshot: bool = False) -> None:
+        """Stop the maintenance thread; sync (and optionally snapshot) so
+        a clean shutdown loses nothing."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_snapshot and self.state == READY:
+            try:
+                self.take_snapshot()
+            except Exception:
+                log.exception("lifeboat final snapshot failed")
+        if self.journal is not None:
+            self.journal.close()
+
+    # -- status ------------------------------------------------------------
+    def status(self) -> dict:
+        journal = self.journal
+        return {
+            "state": self.state,
+            "directory": self.directory,
+            "snapshot_age_s": max(0.0, time.time() - self._last_snapshot_t),
+            "journal_seq": journal.seq if journal else 0,
+            "journal_lag_rows": journal.pending_rows if journal else 0,
+            "generations": [s for s, _ in snapshot_mod.list_snapshots(self.directory)],
+            "last_recovery": (
+                self.last_report.to_dict() if self.last_report else None
+            ),
+        }

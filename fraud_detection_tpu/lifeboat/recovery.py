@@ -1,0 +1,273 @@
+"""Warm restart: snapshot + journal tail → the recovered entity table.
+
+The replay discipline is the SERVING one, exactly: journal records fold in
+sequence (= dispatch) order, **one jitted dispatch per record**, through a
+trace of the SAME ``ledger/features._ledger_read_update`` body the fused
+serving flush dispatches. The per-record framing matters as much as the
+shared body: the traced fold decays each dispatch's slots to a
+per-dispatch anchor, so it is order-insensitive *within* a dispatch but
+segmentation-sensitive *across* dispatches — replaying a flattened tail in
+arbitrary fixed-size chunks lands ulp-level off the table the serving
+process computed. One body + one segmentation means recovery **cannot**
+skew from serving, and the chaos invariant pins the recovered table
+bitwise against both an independent replay of the same snapshot + journal
+bytes and a clean uninterrupted serve of the identical traffic.
+
+Refusal is loud: a snapshot whose spec hash does not match the served
+model's :class:`~fraud_detection_tpu.ledger.state.LedgerSpec` is rejected
+(the caller keeps serving from the train-time stamp), never reinterpreted
+through mismatched hash geometry.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from fraud_detection_tpu.ledger.replay import REPLAY_BATCH
+from fraud_detection_tpu.ledger.state import (
+    LedgerSpec,
+    LedgerState,
+    _MULT,
+    device_state,
+)
+from fraud_detection_tpu.lifeboat import journal as journal_mod
+from fraud_detection_tpu.lifeboat import snapshot as snapshot_mod
+from fraud_detection_tpu.monitor.drift import DriftWindow
+from fraud_detection_tpu.range.faults import fire
+
+log = logging.getLogger("fraud_detection_tpu.lifeboat")
+
+
+def slots_for(fp: np.ndarray, log2_slots: int) -> np.ndarray:
+    """Vectorized multiply-shift slot hash — the array twin of
+    ``ledger.state.entity_slot``, bit-identical per element."""
+    prod = (fp.astype(np.uint64) * np.uint64(_MULT)) & np.uint64(0xFFFFFFFF)
+    return (prod >> np.uint64(32 - log2_slots)).astype(np.int32)
+
+
+def replay_rows(
+    spec: LedgerSpec,
+    state: LedgerState | None,
+    fp: np.ndarray,
+    ts: np.ndarray,
+    amount: np.ndarray,
+    batch: int = REPLAY_BATCH,
+) -> LedgerState:
+    """Fold loose journal triples onto ``state`` through the traced body,
+    in timestamp order (stable sort — same-ts rows keep input order), in
+    fixed-size batches. Deterministic (two replays of the same bytes are
+    bitwise-identical), but NOT the recovery discipline: warm restart uses
+    :func:`replay_records`, whose per-record segmentation is what makes
+    recovery bitwise-equal to serving. This generic form serves tooling
+    that has rows without flush framing."""
+    import jax.numpy as jnp
+
+    n = int(fp.shape[0])
+    dev = device_state(state, spec.slots)
+    if n == 0:
+        return LedgerState(*(np.asarray(leaf) for leaf in dev))
+    order = np.argsort(np.asarray(ts, np.float32), kind="stable")
+    fp_o = np.ascontiguousarray(np.asarray(fp, np.uint32)[order])
+    ts_o = np.ascontiguousarray(np.asarray(ts, np.float32)[order])
+    amt_o = np.ascontiguousarray(np.asarray(amount, np.float32)[order])
+    slots_o = slots_for(fp_o, spec.log2_slots)
+    has_o = (fp_o != 0).astype(np.float32)
+
+    step = _jitted_step()
+    null = jnp.asarray(spec.null_features)
+    hl = jnp.float32(spec.halflife_s)
+    for lo in range(0, n, batch):
+        hi = min(lo + batch, n)
+        pad = batch - (hi - lo)
+        sl = np.pad(slots_o[lo:hi], (0, pad))
+        fb = np.pad(fp_o[lo:hi], (0, pad))
+        tb = np.pad(ts_o[lo:hi], (0, pad))
+        ab = np.pad(amt_o[lo:hi], (0, pad))
+        hb = np.pad(has_o[lo:hi], (0, pad))
+        _feats, dev = step(
+            dev,
+            jnp.asarray(sl), jnp.asarray(fb), jnp.asarray(tb),
+            jnp.asarray(ab), jnp.asarray(hb), null, hl,
+        )
+    return LedgerState(*(np.asarray(leaf) for leaf in dev))
+
+
+#: one process-wide jitted trace of the body — a fresh ``jax.jit`` wrapper
+#: per replay would carry a fresh executable cache and recompile every
+#: warm restart (recovery is off the hot path, but a shard-revive storm
+#: recovering N tables must not pay N compiles of the same shapes)
+_STEP = None
+
+
+def _jitted_step():
+    global _STEP
+    if _STEP is None:
+        import jax
+
+        from fraud_detection_tpu.ledger.features import _ledger_read_update
+
+        _STEP = jax.jit(_ledger_read_update)
+    return _STEP
+
+
+def _bucket(n: int, floor: int = REPLAY_BATCH) -> int:
+    """Replay dispatch shape for an ``n``-row record: the smallest
+    power-of-two bucket ≥ max(n, floor). Bucketing keeps the jitted step's
+    compile count at a handful of shapes across arbitrarily mixed record
+    sizes; padding rows carry ``has_entity=0`` and the traced body leaves
+    every slot bitwise unchanged for them."""
+    b = max(int(floor), 1)
+    while b < n:
+        b <<= 1
+    return b
+
+
+def replay_records(
+    spec: LedgerSpec,
+    state: LedgerState | None,
+    records,
+    batch_floor: int = REPLAY_BATCH,
+) -> LedgerState:
+    """Fold journal records onto ``state`` with the serving segmentation:
+    one dispatch per record, records in sequence order, rows in journal
+    (= staging) order. This is THE recovery replay — bitwise-equal to the
+    table an uninterrupted serve of the same flushes carries."""
+    import jax.numpy as jnp
+
+    dev = device_state(state, spec.slots)
+    step = _jitted_step()
+    null = jnp.asarray(spec.null_features)
+    hl = jnp.float32(spec.halflife_s)
+    for _seq, fp, ts, amt in records:
+        n = int(fp.shape[0])
+        if n == 0:
+            continue
+        fp_c = np.ascontiguousarray(fp, np.uint32)
+        b = _bucket(n, batch_floor)
+        pad = b - n
+        sl = np.pad(slots_for(fp_c, spec.log2_slots), (0, pad))
+        fb = np.pad(fp_c, (0, pad))
+        tb = np.pad(np.ascontiguousarray(ts, np.float32), (0, pad))
+        ab = np.pad(np.ascontiguousarray(amt, np.float32), (0, pad))
+        hb = np.pad((fp_c != 0).astype(np.float32), (0, pad))
+        _feats, dev = step(
+            dev,
+            jnp.asarray(sl), jnp.asarray(fb), jnp.asarray(tb),
+            jnp.asarray(ab), jnp.asarray(hb), null, hl,
+        )
+    return LedgerState(*(np.asarray(leaf) for leaf in dev))
+
+
+@dataclass
+class RecoveryReport:
+    """What a warm restart did — the ``/health`` + metrics + runbook
+    evidence."""
+
+    ok: bool = True
+    restored: bool = False  # a snapshot (or tail) actually bound
+    refused_reason: str | None = None
+    snapshot_seq: int = 0
+    snapshot_path: str | None = None
+    snapshot_created_at: float = 0.0
+    slot_version: int | None = None
+    generations_skipped: int = 0
+    replayed_rows: int = 0
+    torn_rows: int = 0
+    corrupt_mid_file: int = 0
+    resume_seq: int = 0  # the journal continues from here
+    duration_s: float = 0.0
+    rows_seen: int = 0
+    state: LedgerState | None = None
+    window: DriftWindow | None = None
+    shard_window: DriftWindow | None = None
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "restored": self.restored,
+            "refused_reason": self.refused_reason,
+            "snapshot_seq": self.snapshot_seq,
+            "generations_skipped": self.generations_skipped,
+            "replayed_rows": self.replayed_rows,
+            "torn_rows": self.torn_rows,
+            "corrupt_mid_file": self.corrupt_mid_file,
+            "resume_seq": self.resume_seq,
+            "duration_s": round(self.duration_s, 6),
+        }
+
+
+def recover(directory: str, spec: LedgerSpec) -> RecoveryReport:
+    """Load the newest CRC-valid generation (falling back per torn file),
+    replay the journal tail through the traced body, and return the
+    recovered state — pure of any serving wiring so the chaos harness and
+    the bench drive it exactly as the app does."""
+    t0 = time.perf_counter()
+    rep = RecoveryReport()
+    # range injection point: the crash_warm_restart scenario stalls here
+    # to pin the `/health` 503-while-recovering contract
+    fire("lifeboat.recover", directory=directory)
+    snap, skipped = snapshot_mod.load_latest(directory)
+    rep.generations_skipped = skipped
+    expect = snapshot_mod.spec_hash(spec)
+    if snap is not None and snap.spec_hash != expect:
+        # refuse loudly: replaying a snapshot through mismatched hash
+        # geometry silently scrambles every entity — the caller serves
+        # from the train-time stamp instead
+        rep.ok = False
+        rep.refused_reason = (
+            f"snapshot {snap.path} was taken under LedgerSpec hash "
+            f"{snap.spec_hash}, served model expects {expect} — refusing; "
+            "serving from the train-time stamp"
+        )
+        log.error("lifeboat: %s", rep.refused_reason)
+        # resume journaling PAST everything on disk: restarting at seq 0
+        # would land every new-spec generation BELOW the stale snapshot's
+        # seq, so load_latest would refuse forever and pruning would
+        # preferentially delete the valid new-spec generations — the
+        # durability layer silently bricked. Sequencing past the stale
+        # file lets the next snapshot supersede it and rotation age it out.
+        old_tail = journal_mod.read_tail(directory, 0)
+        rep.resume_seq = max(snap.seq, old_tail.max_seq)
+        rep.duration_s = time.perf_counter() - t0
+        return rep
+    if snap is None:
+        # no (valid) snapshot: replay whatever journal exists from a fresh
+        # table — a process that crashed before its first snapshot still
+        # recovers its journaled rows (hash-checked per journal header:
+        # records written under a different LedgerSpec are refused, the
+        # snapshot discipline applied to the journal side)
+        tail = journal_mod.read_tail(directory, 0, expect_hash=expect)
+        rep.torn_rows = tail.torn_rows
+        rep.corrupt_mid_file = tail.corrupt_mid_file
+        rep.resume_seq = tail.max_seq
+        if tail.fp.shape[0]:
+            rep.state = replay_records(spec, None, tail.records)
+            rep.replayed_rows = int(tail.fp.shape[0])
+            rep.restored = True
+        rep.duration_s = time.perf_counter() - t0
+        return rep
+    tail = journal_mod.read_tail(directory, snap.seq, expect_hash=expect)
+    rep.snapshot_seq = snap.seq
+    rep.snapshot_path = snap.path
+    rep.snapshot_created_at = snap.created_at
+    rep.slot_version = snap.slot_version
+    rep.rows_seen = snap.rows_seen
+    rep.torn_rows = tail.torn_rows
+    rep.corrupt_mid_file = tail.corrupt_mid_file
+    rep.resume_seq = max(tail.max_seq, snap.seq)
+    rep.state = replay_records(spec, snap.ledger, tail.records)
+    rep.replayed_rows = int(tail.fp.shape[0])
+    rep.window = snap.window
+    rep.shard_window = snap.shard_window
+    rep.restored = True
+    rep.duration_s = time.perf_counter() - t0
+    log.info(
+        "lifeboat: warm restart from seq %d (%d generation(s) skipped), "
+        "replayed %d journaled row(s) in %.3fs, %d torn row(s) lost",
+        snap.seq, skipped, rep.replayed_rows, rep.duration_s, rep.torn_rows,
+    )
+    return rep

@@ -1,0 +1,291 @@
+"""Versioned, CRC-stamped snapshots of device-resident serving state.
+
+One snapshot file (``lifeboat-{seq:012d}.snap``) captures everything a
+warm restart needs to rebuild the donated pytrees: the ledger's hashed
+entity table, the drift window (and the mesh tier's per-shard windows when
+present), the :class:`~fraud_detection_tpu.ledger.state.LedgerSpec`
+geometry it was built against, and the bookkeeping that anchors the
+journal replay — the **flush sequence number** the table covers, the model
+slot version serving it, and the spec hash a loader must match.
+
+Layout (little-endian, every section CRC-guarded so truncation at ANY
+boundary is detected, never trusted)::
+
+    magic "LBS1" | version u16 | header_len u32 | header JSON
+    | header_crc u32 | payload (npz bytes) | payload_crc u32
+
+The header JSON carries ``{seq, slot_version, spec_hash, created_at,
+rows_seen, payload_len}``; the payload is a plain ``np.savez`` archive of
+the arrays. Files land via the shared atomic helper (``ckpt/atomic``:
+tmp → fsync → rename → dir fsync), and K generations are retained — a
+torn newest file (crash mid-write on a filesystem without the rename
+guarantee, or plain disk corruption) falls back one generation instead of
+taking recovery down.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import os
+import re
+import struct
+import time
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from fraud_detection_tpu.ckpt.atomic import atomic_write_bytes, savez_bytes
+from fraud_detection_tpu.ledger.state import LedgerSpec, LedgerState
+from fraud_detection_tpu.monitor.drift import DriftWindow
+
+log = logging.getLogger("fraud_detection_tpu.lifeboat")
+
+MAGIC = b"LBS1"
+VERSION = 1
+
+SNAPSHOT_RE = re.compile(r"^lifeboat-(\d{12})\.snap$")
+
+#: sanity bound on the declared header length — a torn length field must
+#: not make the reader allocate gigabytes
+_MAX_HEADER = 1 << 20
+
+
+class TornSnapshot(Exception):
+    """The file is truncated, CRC-corrupt, or structurally invalid —
+    recovery must fall back a generation, never trust partial bytes."""
+
+
+def spec_hash(spec: LedgerSpec) -> str:
+    """Stable 16-hex-char identity of the ledger geometry a snapshot was
+    taken under. A snapshot from a DIFFERENT spec (resized table, new decay
+    horizon, different clock origin) must be refused loudly — replaying it
+    through mismatched geometry would silently scramble every entity's
+    aggregates."""
+    null = np.asarray(spec.null_features, np.float32).tobytes()
+    key = (
+        f"{spec.n_base}|{spec.slots}|{spec.halflife_s!r}|{spec.amount_col}"
+        f"|{spec.ts_origin!r}|".encode() + null
+    )
+    return hashlib.sha256(key).hexdigest()[:16]
+
+
+def snapshot_path(directory: str, seq: int) -> str:
+    return os.path.join(directory, f"lifeboat-{seq:012d}.snap")
+
+
+@dataclass
+class Snapshot:
+    """A loaded, CRC-valid snapshot."""
+
+    seq: int
+    slot_version: int | None
+    spec_hash: str
+    created_at: float
+    rows_seen: int
+    spec: LedgerSpec
+    ledger: LedgerState
+    window: DriftWindow | None
+    shard_window: DriftWindow | None
+    path: str
+
+
+def _pack_payload(
+    spec: LedgerSpec,
+    ledger: LedgerState,
+    window: DriftWindow | None,
+    shard_window: DriftWindow | None,
+) -> bytes:
+    arrays: dict[str, np.ndarray] = {
+        "spec_n_base": np.int64(spec.n_base),
+        "spec_slots": np.int64(spec.slots),
+        "spec_halflife_s": np.float64(spec.halflife_s),
+        "spec_amount_col": np.int64(spec.amount_col),
+        "spec_ts_origin": np.float64(spec.ts_origin),
+        "spec_null_features": np.asarray(spec.null_features, np.float32),
+        "acc": np.asarray(ledger.acc, np.float32),
+        "last_ts": np.asarray(ledger.last_ts, np.float32),
+        "fingerprint": np.asarray(ledger.fingerprint, np.uint32),
+        "collisions": np.asarray(ledger.collisions, np.float32),
+        "evictions": np.asarray(ledger.evictions, np.float32),
+    }
+    if window is not None:
+        for name, leaf in zip(DriftWindow._fields, window):
+            arrays[f"win_{name}"] = np.asarray(leaf, np.float32)
+    if shard_window is not None:
+        for name, leaf in zip(DriftWindow._fields, shard_window):
+            arrays[f"sw_{name}"] = np.asarray(leaf, np.float32)
+    return savez_bytes(**arrays)
+
+
+def _unpack_window(z, prefix: str) -> DriftWindow | None:
+    first = f"{prefix}{DriftWindow._fields[0]}"
+    if first not in z:
+        return None
+    return DriftWindow(
+        *(np.asarray(z[f"{prefix}{name}"]) for name in DriftWindow._fields)
+    )
+
+
+def write_snapshot(
+    directory: str,
+    seq: int,
+    spec: LedgerSpec,
+    ledger: LedgerState,
+    window: DriftWindow | None = None,
+    shard_window: DriftWindow | None = None,
+    slot_version: int | None = None,
+    rows_seen: int = 0,
+    created_at: float | None = None,
+) -> str:
+    """Serialize and atomically land one generation. Returns the path."""
+    payload = _pack_payload(spec, ledger, window, shard_window)
+    header = json.dumps(
+        {
+            "seq": int(seq),
+            "slot_version": slot_version,
+            "spec_hash": spec_hash(spec),
+            "created_at": float(created_at if created_at is not None else time.time()),
+            "rows_seen": int(rows_seen),
+            "payload_len": len(payload),
+        },
+        sort_keys=True,
+    ).encode()
+    blob = b"".join(
+        (
+            MAGIC,
+            struct.pack("<H", VERSION),
+            struct.pack("<I", len(header)),
+            header,
+            struct.pack("<I", zlib.crc32(header)),
+            payload,
+            struct.pack("<I", zlib.crc32(payload)),
+        )
+    )
+    os.makedirs(directory, exist_ok=True)
+    return atomic_write_bytes(snapshot_path(directory, seq), blob)
+
+
+def load_snapshot(path: str) -> Snapshot:
+    """Parse + CRC-validate one snapshot file. Raises :class:`TornSnapshot`
+    on ANY truncation or corruption — a partial table must never bind."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        raise TornSnapshot(f"unreadable snapshot {path}: {e}") from e
+    if len(blob) < len(MAGIC) + 2 + 4:
+        raise TornSnapshot(f"{path}: truncated before the header ({len(blob)} bytes)")
+    if blob[:4] != MAGIC:
+        raise TornSnapshot(f"{path}: bad magic {blob[:4]!r}")
+    (version,) = struct.unpack_from("<H", blob, 4)
+    if version != VERSION:
+        raise TornSnapshot(f"{path}: unsupported snapshot version {version}")
+    (header_len,) = struct.unpack_from("<I", blob, 6)
+    if header_len > _MAX_HEADER:
+        raise TornSnapshot(f"{path}: implausible header length {header_len}")
+    off = 10
+    if len(blob) < off + header_len + 4:
+        raise TornSnapshot(f"{path}: truncated inside the header")
+    header_bytes = blob[off : off + header_len]
+    off += header_len
+    (header_crc,) = struct.unpack_from("<I", blob, off)
+    off += 4
+    if zlib.crc32(header_bytes) != header_crc:
+        raise TornSnapshot(f"{path}: header CRC mismatch")
+    try:
+        header = json.loads(header_bytes)
+        payload_len = int(header["payload_len"])
+    except (ValueError, KeyError, TypeError) as e:
+        raise TornSnapshot(f"{path}: unparseable header: {e}") from e
+    if len(blob) < off + payload_len + 4:
+        raise TornSnapshot(f"{path}: truncated inside the payload")
+    payload = blob[off : off + payload_len]
+    off += payload_len
+    (payload_crc,) = struct.unpack_from("<I", blob, off)
+    if zlib.crc32(payload) != payload_crc:
+        raise TornSnapshot(f"{path}: payload CRC mismatch")
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+            spec = LedgerSpec(
+                n_base=int(z["spec_n_base"]),
+                slots=int(z["spec_slots"]),
+                halflife_s=float(z["spec_halflife_s"]),
+                amount_col=int(z["spec_amount_col"]),
+                ts_origin=float(z["spec_ts_origin"]),
+                null_features=np.asarray(z["spec_null_features"], np.float32),
+            )
+            ledger = LedgerState(
+                acc=np.asarray(z["acc"], np.float32),
+                last_ts=np.asarray(z["last_ts"], np.float32),
+                fingerprint=np.asarray(z["fingerprint"], np.uint32),
+                collisions=np.asarray(z["collisions"], np.float32),
+                evictions=np.asarray(z["evictions"], np.float32),
+            )
+            window = _unpack_window(z, "win_")
+            shard_window = _unpack_window(z, "sw_")
+    except (ValueError, KeyError, OSError) as e:
+        # CRC passed but the archive is malformed — treat as torn: the
+        # loader's job is a binary trust decision, not forensics
+        raise TornSnapshot(f"{path}: corrupt payload archive: {e}") from e
+    return Snapshot(
+        seq=int(header["seq"]),
+        slot_version=header.get("slot_version"),
+        spec_hash=str(header["spec_hash"]),
+        created_at=float(header.get("created_at", 0.0)),
+        rows_seen=int(header.get("rows_seen", 0)),
+        spec=spec,
+        ledger=ledger,
+        window=window,
+        shard_window=shard_window,
+        path=path,
+    )
+
+
+def list_snapshots(directory: str) -> list[tuple[int, str]]:
+    """(seq, path) pairs, oldest → newest."""
+    out: list[tuple[int, str]] = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        m = SNAPSHOT_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort()
+    return out
+
+
+def load_latest(directory: str) -> tuple[Snapshot | None, int]:
+    """Newest CRC-valid snapshot, falling back a generation per torn file.
+    Returns ``(snapshot_or_None, generations_skipped)``."""
+    skipped = 0
+    for seq, path in reversed(list_snapshots(directory)):
+        try:
+            return load_snapshot(path), skipped
+        except TornSnapshot as e:
+            skipped += 1
+            log.error(
+                "lifeboat: snapshot generation %d is torn (%s) — falling "
+                "back a generation",
+                seq,
+                e,
+            )
+    return None, skipped
+
+
+def prune_snapshots(directory: str, keep: int) -> list[int]:
+    """Drop all but the newest ``keep`` generations; returns pruned seqs."""
+    snaps = list_snapshots(directory)
+    pruned: list[int] = []
+    for seq, path in snaps[: max(0, len(snaps) - max(keep, 1))]:
+        try:
+            os.unlink(path)
+            pruned.append(seq)
+        except OSError:  # graftcheck: ignore[silent-except] — already gone
+            pass
+    return pruned

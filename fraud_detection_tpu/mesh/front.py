@@ -154,7 +154,14 @@ class ShardFront:
         batchers,
         max_consecutive_errors: int | None = None,
         reopen_after: float | None = None,
+        on_revive=None,
     ):
+        # ``on_revive(shard_id)`` fires when a shard rejoins the rotation
+        # (operator revive, or a half-open probe resolving). The lifeboat
+        # wires a snapshot request here: a revive follows an outage, and
+        # capturing a durable generation NOW beats waiting out a full
+        # snapshot interval with freshly-recovered capacity at risk.
+        self._on_revive = on_revive
         if not batchers:
             raise ValueError("ShardFront needs at least one shard batcher")
         max_err = (
@@ -360,6 +367,7 @@ class ShardFront:
                     log.warning(
                         "shard %d revived by half-open probe", h.shard_id
                     )
+                    self._notify_revive(h.shard_id)
                 return out
             finally:
                 h.inflight -= n_rows
@@ -407,6 +415,15 @@ class ShardFront:
         h.set_state(HEALTHY)
         self._refresh_health_gauge()
         log.warning("shard %d revived", shard_id)
+        self._notify_revive(shard_id)
+
+    def _notify_revive(self, shard_id: int) -> None:
+        if self._on_revive is None:
+            return
+        try:
+            self._on_revive(shard_id)
+        except Exception:
+            log.debug("on_revive hook failed", exc_info=True)
 
     def status(self) -> dict:
         healthy = self._healthy()

@@ -846,6 +846,43 @@ class MeshDriftMonitor(DriftMonitor):
     def _window_for_stats(self) -> DriftWindow:
         return _merge_total(self.shard_window, self.window)
 
+    # -- lifeboat: the per-shard windows are durable state too -------------
+    def shard_window_snapshot(self) -> DriftWindow:
+        """Host copy of the per-shard windows (leading shard axis),
+        materialized under the lock — the lifeboat snapshot carries them
+        so a warm restart restores per-shard drift evidence exactly, not a
+        merged approximation."""
+        with self._lock:
+            return DriftWindow(
+                *(np.asarray(leaf) for leaf in self.shard_window)
+            )
+
+    def _restore_windows_locked(self, window, shard_window) -> bool:
+        ok = super()._restore_windows_locked(window, shard_window)
+        if shard_window is None:
+            # snapshot from a single-device run: base window restored,
+            # per-shard evidence starts cold — degraded, not broken
+            return ok
+        shapes = tuple(np.shape(np.asarray(leaf)) for leaf in shard_window)
+        want = tuple(tuple(leaf.shape) for leaf in self.shard_window)
+        if shapes != want:
+            import logging
+
+            logging.getLogger("fraud_detection_tpu.lifeboat").warning(
+                "per-shard window restore skipped: snapshot shard shapes "
+                "%s != live %s (mesh geometry changed since the snapshot)",
+                shapes, want,
+            )
+            return ok
+        sharding = NamedSharding(self.mesh, _canonical_row_spec(self.mesh))
+        self.shard_window = DriftWindow(
+            *(
+                jax.device_put(np.asarray(leaf, np.float32), sharding)
+                for leaf in shard_window
+            )
+        )
+        return ok
+
     def _placed_wide_table(self, wide_table):
         """The cross-weight table laid out with the model-axis sharding
         the wide shard_map expects, cached per table identity — without

@@ -184,9 +184,11 @@ def build_baseline_profile(
 
 def save_profile(directory: str, profile: BaselineProfile) -> str:
     """Write ``monitor_profile.npz`` beside the model artifacts."""
+    from fraud_detection_tpu.ckpt.atomic import atomic_savez
+
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, PROFILE_FILE)
-    np.savez(
+    atomic_savez(
         path,
         feature_edges=profile.feature_edges,
         feature_counts=profile.feature_counts,

@@ -1093,6 +1093,51 @@ class DriftMonitor:
         under the lock."""
         return self.window
 
+    # -- lifeboat: durable window snapshot/restore -------------------------
+    def window_snapshot(self) -> DriftWindow:
+        """Host copy of the live window, materialized under the lock (the
+        next flush donates these buffers) — the lifeboat snapshot input."""
+        with self._lock:
+            return DriftWindow(*(np.asarray(leaf) for leaf in self.window))
+
+    def shard_window_snapshot(self) -> DriftWindow | None:
+        """Per-shard windows (leading shard axis) — None off the mesh; the
+        mesh subclass overrides."""
+        return None
+
+    def restore_window(
+        self, window: DriftWindow, shard_window=None, rows_seen=None
+    ) -> bool:
+        """Rebind a snapshotted window into the live pytree (warm restart).
+        Shapes/dtypes must match the live window exactly — the restored
+        buffers feed the SAME warmed fused executables, so a matching
+        restore costs zero recompiles; a mismatched one (different
+        profile geometry) is skipped loudly rather than crashing the next
+        flush."""
+        with self._lock:
+            ok = self._restore_windows_locked(window, shard_window)
+            if ok and rows_seen is not None:
+                self.rows_seen = int(rows_seen)
+        return ok
+
+    def _restore_windows_locked(self, window, shard_window) -> bool:
+        cur = self.window
+        shapes = tuple(np.shape(np.asarray(leaf)) for leaf in window)
+        want = tuple(tuple(leaf.shape) for leaf in cur)
+        if shapes != want:
+            import logging
+
+            logging.getLogger("fraud_detection_tpu.lifeboat").warning(
+                "drift window restore skipped: snapshot shapes %s != live "
+                "%s (profile geometry changed since the snapshot)",
+                shapes, want,
+            )
+            return False
+        self.window = DriftWindow(
+            *(jnp.asarray(np.asarray(leaf, np.float32)) for leaf in window)
+        )
+        return True
+
     def stats(self) -> dict:
         """Host-synced snapshot (small arrays; called at status/scrape time,
         never on the per-batch path)."""

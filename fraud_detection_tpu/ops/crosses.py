@@ -269,8 +269,10 @@ def entity_fingerprints(entities, n: int) -> np.ndarray:
 def save_wide(directory: str, spec: CrossSpec, table: np.ndarray) -> str:
     """Stamp ``wide_params.npz`` (geometry + learned cross-weight table)
     beside the model — the widened coef is meaningless without it."""
+    from fraud_detection_tpu.ckpt.atomic import atomic_savez
+
     path = os.path.join(directory, WIDE_FILE)
-    np.savez(
+    atomic_savez(
         path,
         hash_version=np.int64(HASH_VERSION),
         n_base=np.int64(spec.n_base),

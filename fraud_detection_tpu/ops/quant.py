@@ -82,9 +82,11 @@ def derive_calibration(
 
 def save_calibration(directory: str, cal: QuantCalibration) -> str:
     """Write ``quant_calibration.npz`` beside the model artifacts."""
+    from fraud_detection_tpu.ckpt.atomic import atomic_savez
+
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, CALIBRATION_FILE)
-    np.savez(
+    atomic_savez(
         path,
         scale=np.asarray(cal.scale, np.float32),
         sigma_range=np.float64(cal.sigma_range),

@@ -18,6 +18,7 @@ import jax
 import numpy as np
 
 from fraud_detection_tpu import config
+from fraud_detection_tpu.ckpt.atomic import atomic_savez
 from fraud_detection_tpu.ckpt.checkpoint import export_scaler_artifacts
 from fraud_detection_tpu.data.loader import load_creditcard_csv, stratified_split
 from fraud_detection_tpu.ops.scaler import scaler_fit, scaler_transform
@@ -43,7 +44,7 @@ def preprocess(
     x_res, y_res = smote(xs_train, y[train_idx], jax.random.key(seed))
 
     os.makedirs(os.path.dirname(out_npz) or ".", exist_ok=True)
-    np.savez(
+    atomic_savez(
         out_npz,
         X_res=np.asarray(x_res),
         y_res=np.asarray(y_res),

@@ -91,6 +91,9 @@ from fraud_detection_tpu.service.errors import StoreError
 
 _STORE_OUTAGE_ERRORS = (sqlite3.Error, StoreError, OSError)
 STORE_RETRY_AFTER_S = 10  # ≥ the net client's exhausted retry budget
+# Lifeboat warm restart: journal replay is seconds at the bench's measured
+# rows/s for any sane snapshot cadence — one short client backoff covers it
+LIFEBOAT_RETRY_AFTER_S = 5
 
 # Hyperloop per-lane edge accounting + stage stamps, bound once (a
 # Counter.labels() lookup costs ~0.6µs — real money at lane rates).
@@ -190,6 +193,7 @@ def create_app(
         "flightrecorder": None,
         "profiler": None,
         "binlane": None,
+        "lifeboat": None,
         "started_at": None,
     }
     app.state = state  # exposed for tests/embedding
@@ -220,6 +224,22 @@ def create_app(
         # state["model"] only seeds it at startup.
         slot = state["slot"]
         return slot.model if slot is not None else state["model"]
+
+    def _recovering_response() -> Response | None:
+        """The lifeboat warm-restart gate: while journal replay is
+        rebuilding the entity table, readiness (and scoring — rows folded
+        now would land in a table about to be replaced) answers 503 +
+        Retry-After instead of serving against soon-to-be-clobbered
+        state."""
+        boat = state.get("lifeboat")
+        if boat is not None and boat.state == "recovering":
+            return _unavailable(
+                "recovering",
+                "lifeboat warm restart in progress — replaying the entity "
+                "journal through the traced ledger body",
+                LIFEBOAT_RETRY_AFTER_S,
+            )
+        return None
 
     def _ingest_scale(model):
         """The int8-layout dequant scale for the LIVE model, cached per
@@ -349,6 +369,49 @@ def create_app(
             metrics.lifecycle_active_model_version.set(
                 state["slot"].version or 0
             )
+            # Lifeboat (LIFEBOAT_DIR set + a ledger-widened champion):
+            # crash-consistent durability for the device-resident entity
+            # table + drift windows. Recovery runs on its own thread —
+            # /health and scoring answer 503 "recovering" + Retry-After
+            # until the journal replay binds the recovered table, then the
+            # maintenance thread starts snapshotting.
+            boat = None
+            lb_dir = config.lifeboat_dir()
+            ledger_spec = getattr(model, "ledger_spec", None)
+            drift = getattr(state["watchtower"], "drift", None)
+            if lb_dir and ledger_spec is not None and drift is not None:
+                try:
+                    import threading
+
+                    from fraud_detection_tpu.lifeboat import Lifeboat
+
+                    boat = Lifeboat(
+                        lb_dir, ledger_spec, drift=drift, slot=state["slot"]
+                    )
+                    boat.state = "recovering"  # gate before the thread runs
+                    state["lifeboat"] = boat
+
+                    def _warm_restart() -> None:
+                        try:
+                            boat.recover()
+                        except Exception:
+                            log.exception("lifeboat warm restart failed")
+                            boat.state = "ready"  # serve the train-time stamp
+                        boat.start()
+
+                    threading.Thread(
+                        target=_warm_restart, name="lifeboat-recover",
+                        daemon=True,
+                    ).start()
+                except Exception as e:
+                    state["lifeboat"] = boat = None
+                    log.error("lifeboat startup failed: %s", e)
+            elif lb_dir:
+                log.warning(
+                    "LIFEBOAT_DIR set but the served model carries no "
+                    "ledger (or monitoring is down) — durability layer "
+                    "disabled"
+                )
             # Switchyard front: MESH_SHARDS>1 runs that many replica
             # batchers behind the router (health tracking + draining; a
             # dead shard sheds load). All shards share the ModelSlot, so
@@ -370,15 +433,24 @@ def create_app(
                                 shard_recorders[i] if shard_recorders else None
                             ),
                             shard_id=i,
+                            lifeboat=boat,
                         )
                         for i in range(n_shards)
-                    ]
+                    ],
+                    # a revive follows an outage — capture a durable
+                    # generation now instead of waiting out the interval
+                    on_revive=(
+                        (lambda _shard: boat.request_snapshot())
+                        if boat is not None
+                        else None
+                    ),
                 )
             else:
                 batcher = MicroBatcher(
                     slot=state["slot"],
                     watchtower=state["watchtower"],
                     recorder=state["flightrecorder"],
+                    lifeboat=boat,
                 )
             await batcher.start()  # warms the bucket ladder; can raise
             state["batcher"] = batcher
@@ -398,10 +470,21 @@ def create_app(
                         BinaryIngestServer,
                     )
 
+                    def _lane_unavailable():
+                        lb = state.get("lifeboat")
+                        if lb is not None and lb.state == "recovering":
+                            return (
+                                "lifeboat warm restart in progress — "
+                                "entity journal replaying; retry shortly",
+                                float(LIFEBOAT_RETRY_AFTER_S),
+                            )
+                        return None
+
                     lane = BinaryIngestServer(
                         batcher,
                         scorer_fn=lambda: state["slot"].model.scorer,
                         model_fn=lambda: state["slot"].model,
+                        unavailable_fn=_lane_unavailable,
                     )
                     lane.start(asyncio.get_running_loop())
                     state["binlane"] = lane
@@ -429,6 +512,13 @@ def create_app(
             state["reloader"].stop()
         if state["batcher"]:
             await state["batcher"].stop()
+        if state.get("lifeboat"):
+            # AFTER the batcher drains: an in-flight flush still journals
+            # under the flush lock, so closing the boat first would race
+            # the journal file out from under it. Final sync here means a
+            # clean shutdown loses zero rows.
+            await asyncio.to_thread(state["lifeboat"].close)
+            state["lifeboat"] = None
         if state["watchtower"]:
             state["watchtower"].close()
         if state["lifecycle_store"]:
@@ -459,6 +549,11 @@ def create_app(
 
     @app.get("/health")
     async def health(req: Request) -> Response:
+        # Lifeboat warm restart in progress: readiness is gated — load
+        # balancers must not admit traffic into a table mid-replay
+        recovering = _recovering_response()
+        if recovering is not None:
+            return recovering
         # Pings run concurrently off-loop; the net clients' ping() is a
         # single-attempt probe on its own connection, so a store outage
         # yields a fast 503 instead of a probe-timeout hang behind the
@@ -488,6 +583,12 @@ def create_app(
         metrics.predictions_submitted.inc()
         corr_id = req.state["correlation_id"]
         t_req = time.perf_counter()
+        recovering = _recovering_response()
+        if recovering is not None:
+            # a capacity-shaped outage, not an error: flow control does
+            # not burn the lane's availability budget (the AdmissionFull
+            # precedent) — the process is seconds from ready
+            return recovering
         model = _model()
         if model is None or state["batcher"] is None:
             # batcher can be None with a loaded model if its startup warmup
@@ -665,6 +766,9 @@ def create_app(
         ``/predict`` scores for identical f32 rows."""
         from fraud_detection_tpu.service import binlane
 
+        recovering = _recovering_response()
+        if recovering is not None:
+            return recovering
         model = _model()
         batcher = state["batcher"]
         if model is None or batcher is None:
@@ -1048,6 +1152,19 @@ def create_app(
                 "roofline": roofline.snapshot(),
             }
         )
+
+    @app.get("/lifeboat/status")
+    async def lifeboat_status(req: Request) -> Response:
+        """Durability-layer state: recovery report, snapshot generations on
+        disk, journal sequence + fsync lag — the
+        docs/runbooks/DisasterRecovery.md first stop. ``enabled: false``
+        when LIFEBOAT_DIR is unset or the served family is stateless."""
+        boat = state.get("lifeboat")
+        if boat is None:
+            return Response({"enabled": False, "state": "disabled"})
+        body = {"enabled": True}
+        body.update(await asyncio.to_thread(boat.status))
+        return Response(body)
 
     @app.get("/debug/flightrecorder")
     async def flightrecorder(req: Request) -> Response:

@@ -593,11 +593,18 @@ class BinaryIngestServer:
         stall_timeout: float | None = None,
         dequant_scale: np.ndarray | None = None,
         model_fn=None,
+        unavailable_fn=None,
     ):
         self.batcher = batcher
         self.scorer_fn = scorer_fn
         self.model = model
         self.model_fn = model_fn
+        # ``unavailable_fn() -> (message, retry_after_s) | None``: a
+        # process-level not-ready gate (the lifeboat's ``recovering``
+        # state). The HTTP lanes 503 through _recovering_response; this
+        # lane must refuse the same window — rows folded into a table
+        # about to be replaced by journal replay are lost unrecoverably.
+        self.unavailable_fn = unavailable_fn
         self.host = host if host is not None else config.ingest_host()
         self.port = port if port is not None else config.ingest_port()
         # clamp to the batcher's flush ceiling: a frame the header check
@@ -746,6 +753,20 @@ class BinaryIngestServer:
                         f"[{_FRAME.size}, {self.max_frame}]",
                     ))
                     return  # the stream position can't be trusted
+                unavailable = (
+                    self.unavailable_fn() if self.unavailable_fn else None
+                )
+                if unavailable is not None:
+                    # not ready (lifeboat recovering): drain the frame so
+                    # the stream stays at a boundary, answer UNAVAILABLE
+                    # with Retry-After, keep the connection — readiness is
+                    # seconds away and reconnect storms help nobody
+                    msg, retry_after = unavailable
+                    self._drain(conn, length)
+                    conn.sendall(
+                        error_frame(ST_UNAVAILABLE, msg, retry_after)
+                    )
+                    continue
                 scorer = self.scorer_fn()
                 if scorer is not dec.scorer:  # hot swap: rebind the schema
                     scale = self._dequant_for(scorer)

@@ -311,6 +311,45 @@ ledger_null_entity_rows = Counter(
     registry=registry,
 )
 
+# Lifeboat: crash-consistent durability + warm restart for device-resident
+# state (lifeboat/). The alerting contract for
+# monitoring/prometheus/rules/lifeboat-alerts.yml (SnapshotStale,
+# JournalLagGrowing) and the lifeboat dashboard row
+# (docs/runbooks/DisasterRecovery.md).
+lifeboat_snapshot_age = Gauge(
+    "lifeboat_snapshot_age_seconds",
+    "Seconds since the last durable snapshot generation landed (refreshed "
+    "by the lifeboat maintenance thread) — recovery staleness is bounded "
+    "by this plus the journal fsync cadence; the SnapshotStale alert input",
+    registry=registry,
+)
+lifeboat_journal_lag_rows = Gauge(
+    "lifeboat_journal_lag_rows",
+    "Entity rows appended to the journal but not yet fsynced — exactly the "
+    "rows a crash right now would lose (bounded by LIFEBOAT_FSYNC_S); the "
+    "JournalLagGrowing alert input",
+    registry=registry,
+)
+lifeboat_recovery_duration = Gauge(
+    "lifeboat_recovery_duration_seconds",
+    "Wall time of the last warm restart (snapshot load + journal replay "
+    "through the traced ledger body)",
+    registry=registry,
+)
+lifeboat_replayed_rows = Counter(
+    "lifeboat_replayed_rows",
+    "Journal rows replayed through the traced ledger body during warm "
+    "restarts",
+    registry=registry,
+)
+lifeboat_torn_tail_rows = Counter(
+    "lifeboat_torn_tail_rows",
+    "Journal rows lost to CRC-failed/truncated records (the torn tail a "
+    "crash legitimately leaves, or — logged loudly — mid-file disk "
+    "damage); the recovery's bounded-loss accounting",
+    registry=registry,
+)
+
 # Watchtower: online drift / quality / shadow monitoring (monitor/).
 # These names are part of the alerting contract —
 # monitoring/prometheus/rules/watchtower-alerts.yml and the Grafana drift

@@ -169,6 +169,7 @@ class MicroBatcher:
         explain_k: int | None = None,
         admit_max_rows: int | None = None,
         shard_id: int = 0,
+        lifeboat=None,
     ):
         # Either a fixed scorer (offline tools, tests) or a lifecycle
         # ModelSlot (serving): with a slot, every flush re-reads the slot's
@@ -266,6 +267,11 @@ class MicroBatcher:
             else config.scorer_admit_max_rows()
         )
         self.admit_retry_after = config.scorer_admit_retry_after_s()
+        # lifeboat (crash-consistent durability): when set and the served
+        # family is ledger-widened, every stateful flush write-ahead
+        # journals its entity triples under the boat's flush lock before
+        # the fused dispatch (see lifeboat/boat.py)
+        self.lifeboat = lifeboat
         self._queued_rows = 0
         self._carry: tuple | None = None  # block deferred to the next batch
         self._rate = 0.0  # rows/s arrival EWMA (adaptive deadline input)
@@ -870,18 +876,36 @@ class MicroBatcher:
                 if target is not None:
                     drift, spec = target
                     explain_k = self._explain_k_for(spec, scorer)
-                    out = drift.fused_flush(
-                        jnp.asarray(hx), jnp.asarray(slot.valid), n,
-                        spec.score_args, spec.score_fn,
-                        dequant_scale=spec.dequant_scale,
-                        score_codes=spec.score_codes,
-                        out_dtype=self._out_jdtype,
-                        explain_args=spec.explain_args if explain_k else None,
-                        explain_k=explain_k,
-                        ledger_rows=ledger_rows,
-                        wide_args=spec.wide if wide_on else None,
-                        wide_rows=wide_rows,
-                    )
+
+                    def _dispatch():
+                        return drift.fused_flush(
+                            jnp.asarray(hx), jnp.asarray(slot.valid), n,
+                            spec.score_args, spec.score_fn,
+                            dequant_scale=spec.dequant_scale,
+                            score_codes=spec.score_codes,
+                            out_dtype=self._out_jdtype,
+                            explain_args=(
+                                spec.explain_args if explain_k else None
+                            ),
+                            explain_k=explain_k,
+                            ledger_rows=ledger_rows,
+                            wide_args=spec.wide if wide_on else None,
+                            wide_rows=wide_rows,
+                        )
+
+                    boat = self.lifeboat
+                    if ledger_on and boat is not None:
+                        # lifeboat write-ahead: journal record + fused
+                        # dispatch are one atom under the flush lock, so
+                        # a snapshot cut can never see a dispatched flush
+                        # whose triples aren't in the journal
+                        with boat.flush_lock:
+                            boat.journal_staged(
+                                slot, hx, spec.dequant_scale, n
+                            )
+                            out = _dispatch()
+                    else:
+                        out = _dispatch()
                     device_calls = 1
                     if ledger_on and n_null:
                         metrics.ledger_null_entity_rows.inc(n_null)

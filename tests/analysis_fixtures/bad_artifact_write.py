@@ -1,0 +1,33 @@
+"""Fixture: non-atomic artifact writes the artifact-nonatomic-write rule
+must flag — every shape the repo's eight pre-lifeboat sites used."""
+
+import os
+
+import numpy as np
+
+STATE_FILE = "ledger_state.npz"
+
+
+def save_direct(path, coef):
+    np.savez(path, coef=coef)  # BAD: torn file at the trusted name
+
+
+def save_compressed(directory, table):
+    np.savez_compressed(  # BAD: same hazard, compressed spelling
+        os.path.join(directory, "wide_params.npz"), table=table
+    )
+
+
+def save_bytes(directory, blob):
+    with open(os.path.join(directory, "model.npz"), "wb") as f:  # BAD
+        f.write(blob)
+
+
+def save_via_const(directory, blob):
+    with open(os.path.join(directory, STATE_FILE), "wb") as f:  # BAD
+        f.write(blob)
+
+
+def save_fstring(run_id, blob):
+    with open(f"ckpt-{run_id}.npz", "wb") as f:  # BAD
+        f.write(blob)

@@ -1,0 +1,31 @@
+"""Fixture: the sanctioned artifact-write shapes — the atomic helper, npz
+READS, and non-artifact binary writes must all stay clean."""
+
+import os
+
+import numpy as np
+
+from fraud_detection_tpu.ckpt.atomic import atomic_savez, atomic_write_bytes
+
+
+def save_atomic(directory, coef):
+    atomic_savez(os.path.join(directory, "model.npz"), coef=coef)
+
+
+def save_framed(path, blob):
+    atomic_write_bytes(path, blob)  # CRC-framed container (lifeboat)
+
+
+def load_is_fine(path):
+    with np.load(path, allow_pickle=False) as z:  # reads are not writes
+        return np.asarray(z["coef"])
+
+
+def read_npz_bytes(directory):
+    with open(os.path.join(directory, "model.npz"), "rb") as f:  # read mode
+        return f.read()
+
+
+def write_other_binary(path, blob):
+    with open(path + ".log", "wb") as f:  # not a trusted .npz artifact
+        f.write(blob)

@@ -102,6 +102,28 @@ def test_service_rules_true_positives():
     assert counts["thread-nondaemon-nojoin"] == 1, findings
 
 
+def test_artifact_nonatomic_write_true_positives():
+    """Lifeboat guard (ISSUE 15): bare np.savez / open('...npz','wb')
+    writes of trusted artifacts — every shape the eight pre-lifeboat call
+    sites used — must flag, so torn-file hazards can't regrow after
+    ckpt/atomic centralized the tmp→fsync→rename discipline."""
+    counts, findings = rule_counts("bad_artifact_write.py")
+    assert counts["artifact-nonatomic-write"] == 5, findings
+    msgs = [
+        f.message for f in findings
+        if f.rule_id == "artifact-nonatomic-write"
+    ]
+    assert any("np.savez(" in m for m in msgs), msgs
+    assert any("np.savez_compressed" in m for m in msgs), msgs
+    # the open('...npz','wb') shapes: join tail, module const, f-string
+    assert sum("open(..., 'wb')" in m for m in msgs) == 3, msgs
+    assert all(
+        f.severity is Severity.ERROR
+        for f in findings
+        if f.rule_id == "artifact-nonatomic-write"
+    )
+
+
 def test_retry_no_backoff_true_positives():
     counts, findings = rule_counts("bad_retry_backoff.py")
     assert counts["retry-no-backoff"] == 3, findings
@@ -128,6 +150,7 @@ def test_retry_no_backoff_true_positives():
         "good_hot_path_json.py",
         "good_decode_alloc.py",
         "good_retry_backoff.py",
+        "good_artifact_write.py",
     ],
 )
 def test_good_fixtures_are_clean(good):

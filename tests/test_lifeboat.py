@@ -1,0 +1,616 @@
+"""Lifeboat (ISSUE 15) unit coverage: the torn-file contracts.
+
+The chaos scenarios (tests/test_range.py, ``-m slow``) pin the end-to-end
+recovery invariants against the live serving stack; these tests pin the
+file-format trust decisions in isolation — a snapshot truncated at EVERY
+section boundary is detected (never partially trusted), a CRC-corrupt
+journal record mid-file is skipped while every later record still
+replays, zero-length files degrade cleanly, and a snapshot from a
+mismatched :class:`LedgerSpec` is refused loudly (the caller serves from
+the train-time stamp, never through wrong hash geometry).
+"""
+
+import os
+import struct
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.ledger.state import LedgerSpec, LedgerState, entity_slot
+from fraud_detection_tpu.lifeboat import (
+    Journal,
+    Lifeboat,
+    TornSnapshot,
+    list_journals,
+    list_snapshots,
+    load_latest,
+    load_snapshot,
+    read_journal_file,
+    read_tail,
+    recover,
+    replay_records,
+    spec_hash,
+    write_snapshot,
+)
+from fraud_detection_tpu.lifeboat.journal import journal_path
+from fraud_detection_tpu.lifeboat.recovery import slots_for
+from fraud_detection_tpu.lifeboat.snapshot import (
+    MAGIC,
+    prune_snapshots,
+    snapshot_path,
+)
+
+D = 30
+SLOTS = 64
+
+
+def _spec(**overrides) -> LedgerSpec:
+    kw = dict(
+        n_base=D,
+        slots=SLOTS,
+        halflife_s=900.0,
+        amount_col=-1,
+        ts_origin=100.0,
+        null_features=np.arange(4, dtype=np.float32),
+    )
+    kw.update(overrides)
+    return LedgerSpec(**kw)
+
+
+def _table(seed: int = 3) -> LedgerState:
+    rng = np.random.default_rng(seed)
+    return LedgerState(
+        acc=rng.standard_normal((SLOTS, 3)).astype(np.float32),
+        last_ts=rng.uniform(0, 1e4, SLOTS).astype(np.float32),
+        fingerprint=rng.integers(0, 2**32, SLOTS, dtype=np.uint64).astype(
+            np.uint32
+        ),
+        collisions=np.zeros(SLOTS, np.float32),
+        evictions=np.zeros(SLOTS, np.float32),
+    )
+
+
+def _tables_equal(a, b) -> bool:
+    return all(
+        np.asarray(x).tobytes() == np.asarray(y).tobytes()
+        for x, y in zip(a, b)
+    )
+
+
+def _triples(seed: int, n: int):
+    rng = np.random.default_rng(seed)
+    fp = rng.integers(1, 2**32, n, dtype=np.uint64).astype(np.uint32)
+    ts = rng.uniform(10.0, 500.0, n).astype(np.float32)
+    amt = rng.uniform(0.0, 200.0, n).astype(np.float32)
+    return fp, ts, amt
+
+
+# -- snapshot format --------------------------------------------------------
+
+
+def test_snapshot_roundtrip(tmp_path):
+    spec, table = _spec(), _table()
+    path = write_snapshot(
+        str(tmp_path), 7, spec, table, slot_version=3, rows_seen=420
+    )
+    snap = load_snapshot(path)
+    assert snap.seq == 7
+    assert snap.slot_version == 3
+    assert snap.rows_seen == 420
+    assert snap.spec_hash == spec_hash(spec)
+    for field in ("n_base", "slots", "halflife_s", "amount_col", "ts_origin"):
+        assert getattr(snap.spec, field) == getattr(spec, field)
+    assert np.array_equal(snap.spec.null_features, spec.null_features)
+    assert _tables_equal(snap.ledger, table)
+    assert snap.window is None and snap.shard_window is None
+
+
+def test_snapshot_truncated_at_every_section_boundary(tmp_path):
+    """Layout: magic(4) | version(2) | header_len(4) | header(H) |
+    header_crc(4) | payload(P) | payload_crc(4). A prefix cut at ANY
+    boundary — and strictly inside every section — must raise
+    TornSnapshot, never load partial state."""
+    spec, table = _spec(), _table()
+    path = write_snapshot(str(tmp_path), 1, spec, table)
+    blob = open(path, "rb").read()
+    (header_len,) = struct.unpack_from("<I", blob, 6)
+    p_start = 10 + header_len + 4
+    payload_len = len(blob) - p_start - 4
+    boundaries = sorted(
+        {
+            0,  # zero-length file
+            2,  # mid-magic
+            4,  # after magic / mid-version
+            5,
+            6,  # after version / mid-header_len
+            8,
+            10,  # after header_len / inside header
+            10 + header_len // 2,
+            10 + header_len,  # mid header_crc
+            10 + header_len + 2,
+            p_start,  # payload completely missing
+            p_start + payload_len // 2,  # mid-payload
+            p_start + payload_len,  # mid payload_crc
+            len(blob) - 1,
+        }
+    )
+    for cut in boundaries:
+        torn = tmp_path / "torn" / f"lifeboat-{cut:012d}.snap"
+        torn.parent.mkdir(exist_ok=True)
+        torn.write_bytes(blob[:cut])
+        with pytest.raises(TornSnapshot):
+            load_snapshot(str(torn))
+    # the untruncated file still loads — the boundaries above are real
+    assert load_snapshot(path).seq == 1
+
+
+def test_snapshot_corruption_and_bad_framing(tmp_path):
+    spec, table = _spec(), _table()
+    path = write_snapshot(str(tmp_path), 1, spec, table)
+    blob = bytearray(open(path, "rb").read())
+    (header_len,) = struct.unpack_from("<I", blob, 6)
+
+    def _expect_torn(mutated: bytes):
+        p = tmp_path / "x.snap"
+        p.write_bytes(mutated)
+        with pytest.raises(TornSnapshot):
+            load_snapshot(str(p))
+
+    # flipped byte inside the header JSON
+    h = bytearray(blob)
+    h[12] ^= 0xFF
+    _expect_torn(bytes(h))
+    # flipped byte inside the payload
+    p = bytearray(blob)
+    p[10 + header_len + 4 + 5] ^= 0xFF
+    _expect_torn(bytes(p))
+    # wrong magic / unsupported version
+    _expect_torn(b"XXXX" + bytes(blob[4:]))
+    v = bytearray(blob)
+    struct.pack_into("<H", v, 4, 99)
+    _expect_torn(bytes(v))
+    # implausible header length must not drive a giant allocation
+    g = bytearray(blob)
+    struct.pack_into("<I", g, 6, 1 << 30)
+    _expect_torn(bytes(g))
+
+
+def test_load_latest_generation_fallback(tmp_path):
+    spec = _spec()
+    tables = [_table(seed) for seed in (1, 2, 3)]
+    for seq, table in enumerate(tables, start=1):
+        write_snapshot(str(tmp_path), seq, spec, table)
+    # newest torn -> generation 2 loads, one skip counted
+    newest = snapshot_path(str(tmp_path), 3)
+    blob = open(newest, "rb").read()
+    open(newest, "wb").write(blob[: len(blob) // 2])
+    snap, skipped = load_latest(str(tmp_path))
+    assert snap.seq == 2 and skipped == 1
+    assert _tables_equal(snap.ledger, tables[1])
+    # every generation torn -> no snapshot, all skips counted
+    for seq in (1, 2):
+        p = snapshot_path(str(tmp_path), seq)
+        open(p, "wb").write(open(p, "rb").read()[:9])
+    snap, skipped = load_latest(str(tmp_path))
+    assert snap is None and skipped == 3
+
+
+def test_zero_length_files_degrade_cleanly(tmp_path):
+    open(snapshot_path(str(tmp_path), 5), "wb").close()
+    open(journal_path(str(tmp_path), 0), "wb").close()
+    snap, skipped = load_latest(str(tmp_path))
+    assert snap is None and skipped == 1
+    records, torn, mid, header_ok, header_hash = read_journal_file(
+        journal_path(str(tmp_path), 0)
+    )
+    assert records == [] and torn == 0 and mid == 0 and not header_ok
+    rep = recover(str(tmp_path), _spec())
+    assert rep.ok and not rep.restored and rep.state is None
+
+
+def test_prune_snapshots_keeps_newest_k(tmp_path):
+    spec, table = _spec(), _table()
+    for seq in range(1, 6):
+        write_snapshot(str(tmp_path), seq, spec, table)
+    pruned = prune_snapshots(str(tmp_path), keep=3)
+    assert pruned == [1, 2]
+    assert [s for s, _ in list_snapshots(str(tmp_path))] == [3, 4, 5]
+
+
+def test_spec_hash_covers_every_geometry_field():
+    base = _spec()
+    variants = [
+        _spec(slots=128),
+        _spec(halflife_s=60.0),
+        _spec(ts_origin=0.0),
+        _spec(amount_col=0),
+        _spec(null_features=np.zeros(4, np.float32)),
+    ]
+    hashes = {spec_hash(s) for s in [base] + variants}
+    assert len(hashes) == len(variants) + 1
+    assert spec_hash(base) == spec_hash(_spec())
+
+
+# -- journal format ---------------------------------------------------------
+
+
+def test_journal_roundtrip_rotation_and_prune(tmp_path):
+    spec_h = spec_hash(_spec())
+    j = Journal(str(tmp_path), spec_h, base_seq=0, fsync_s=0.0)
+    batches = [_triples(seed, 16 + seed) for seed in range(3)]
+    for fp, ts, amt in batches[:2]:
+        j.append(fp, ts, amt)
+    assert j.pending_rows == 0  # fsync-per-append policy
+    j.rotate(2)  # snapshot boundary at seq 2
+    j.append(*batches[2])
+    j.close()
+    assert [b for b, _ in list_journals(str(tmp_path))] == [0, 2]
+    # full tail: every triple back bitwise, per-flush framing preserved
+    tail = read_tail(str(tmp_path), 0)
+    assert tail.n_records == 3 and tail.torn_rows == 0
+    assert [r[0] for r in tail.records] == [1, 2, 3]
+    for (seq, fp, ts, amt), (efp, ets, eamt) in zip(tail.records, batches):
+        assert np.array_equal(fp, efp)
+        assert np.array_equal(ts, ets)
+        assert np.array_equal(amt, eamt)
+    # a snapshot at seq 2 replays only the rotated file's record
+    tail2 = read_tail(str(tmp_path), 2)
+    assert tail2.n_records == 1 and tail2.records[0][0] == 3
+    # journals before the oldest retained snapshot's seq are pruned
+    from fraud_detection_tpu.lifeboat.journal import prune_journals
+
+    assert prune_journals(str(tmp_path), 2) == [0]
+    assert [b for b, _ in list_journals(str(tmp_path))] == [2]
+
+
+def test_journal_fsync_policy_bounds_lag(tmp_path):
+    j = Journal(str(tmp_path), "a" * 16, base_seq=0, fsync_s=5.0)
+    fp, ts, amt = _triples(1, 32)
+    j.append(fp, ts, amt)
+    assert j.pending_rows == 32  # the crash-loss bound until the cadence
+    j.sync()
+    assert j.pending_rows == 0
+    j.close()
+
+
+def test_journal_misaligned_arrays_rejected(tmp_path):
+    j = Journal(str(tmp_path), "a" * 16, fsync_s=0.0)
+    fp, ts, amt = _triples(1, 8)
+    with pytest.raises(ValueError):
+        j.append(fp, ts[:4], amt)
+    j.close()
+
+
+def test_journal_torn_tail_drops_exactly_the_final_record(tmp_path):
+    j = Journal(str(tmp_path), "b" * 16, fsync_s=0.0)
+    batches = [_triples(seed, 16) for seed in range(3)]
+    for fp, ts, amt in batches:
+        j.append(fp, ts, amt)
+    j.close()
+    path = journal_path(str(tmp_path), 0)
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:-6])  # tear the last record's CRC
+    records, torn, mid, header_ok, header_hash = read_journal_file(path)
+    assert header_ok and mid == 0
+    assert [r[0] for r in records] == [1, 2]  # the first two survive
+    assert torn == 16  # exactly the final flush, counted
+
+
+def test_journal_corrupt_record_mid_file_resyncs(tmp_path):
+    """Disk damage (not a crash shape): a CRC-corrupt record with VALID
+    records after it — the reader must count it as mid-file corruption
+    and still replay every later record."""
+    j = Journal(str(tmp_path), "c" * 16, fsync_s=0.0)
+    batches = [_triples(seed, 16) for seed in range(4)]
+    offsets = []
+    for fp, ts, amt in batches:
+        offsets.append(os.path.getsize(journal_path(str(tmp_path), 0)))
+        j.append(fp, ts, amt)
+    j.close()
+    path = journal_path(str(tmp_path), 0)
+    blob = bytearray(open(path, "rb").read())
+    blob[offsets[1] + 20] ^= 0xFF  # inside record 2's payload
+    open(path, "wb").write(bytes(blob))
+    records, torn, mid, header_ok, header_hash = read_journal_file(path)
+    assert header_ok
+    assert [r[0] for r in records] == [1, 3, 4]
+    assert torn == 16 and mid >= 1
+    # the surviving records are byte-exact
+    assert np.array_equal(records[1][1], batches[2][0])
+    assert np.array_equal(records[2][3], batches[3][2])
+
+
+def test_journal_bad_header_still_resyncs_records(tmp_path):
+    j = Journal(str(tmp_path), "d" * 16, fsync_s=0.0)
+    fp, ts, amt = _triples(5, 12)
+    j.append(fp, ts, amt)
+    j.close()
+    path = journal_path(str(tmp_path), 0)
+    blob = bytearray(open(path, "rb").read())
+    blob[0] ^= 0xFF  # tear the file header magic
+    open(path, "wb").write(bytes(blob))
+    records, torn, mid, header_ok, header_hash = read_journal_file(path)
+    assert not header_ok
+    assert len(records) == 1 and np.array_equal(records[0][1], fp)
+
+
+def test_slots_for_matches_scalar_hash():
+    fp = _triples(9, 256)[0]
+    vec = slots_for(fp, 6)
+    assert np.array_equal(
+        vec, np.asarray([entity_slot(int(f), 6) for f in fp], np.int32)
+    )
+
+
+# -- recovery ---------------------------------------------------------------
+
+
+def test_recover_refuses_mismatched_spec_hash(tmp_path):
+    spec_a = _spec()
+    write_snapshot(str(tmp_path), 4, spec_a, _table())
+    spec_b = _spec(halflife_s=60.0)  # resized decay horizon
+    rep = recover(str(tmp_path), spec_b)
+    assert not rep.ok and not rep.restored and rep.state is None
+    assert "refusing" in rep.refused_reason
+    assert spec_hash(spec_a) in rep.refused_reason
+    assert spec_hash(spec_b) in rep.refused_reason
+    # the same bytes ARE acceptable to the matching spec
+    rep2 = recover(str(tmp_path), spec_a)
+    assert rep2.ok and rep2.restored and rep2.snapshot_seq == 4
+
+
+def test_refusal_resumes_sequencing_past_the_stale_generation(tmp_path):
+    """A spec change over a reused LIFEBOAT_DIR must not brick the layer:
+    restarting the journal at seq 0 would land every new-spec generation
+    BELOW the stale snapshot's seq, so load_latest would refuse forever
+    and pruning would delete the valid generations first. The refusal
+    resumes sequencing past everything on disk instead, so the next
+    new-spec snapshot supersedes the stale file."""
+    spec_old = _spec()
+    write_snapshot(str(tmp_path), 500, spec_old, _table())
+    spec_new = _spec(slots=128)
+    table_new = LedgerState(
+        acc=np.zeros((128, 3), np.float32),
+        last_ts=np.zeros(128, np.float32),
+        fingerprint=np.zeros(128, np.uint32),
+        collisions=np.zeros(128, np.float32),
+        evictions=np.zeros(128, np.float32),
+    )
+    boat = Lifeboat(
+        str(tmp_path),
+        spec_new,
+        drift=_FakeDrift(table_new),
+        snapshot_s=1e9,
+        fsync_s=0.0,
+    )
+    rep = boat.recover()
+    assert not rep.ok and rep.resume_seq >= 500
+    assert boat.journal.seq >= 500  # sequencing continues past the stale file
+    assert boat.take_snapshot() is not None  # lands at seq >= 500
+    boat.close()
+    # the next restart restores the NEW-spec generation — self-healed
+    rep2 = recover(str(tmp_path), spec_new)
+    assert rep2.ok and rep2.restored and rep2.snapshot_seq >= 500
+
+
+def test_journal_from_mismatched_spec_refused(tmp_path):
+    """The no-snapshot recovery path must apply the same spec-hash
+    refusal as the snapshot side: replaying triples written under
+    different hash geometry silently scrambles entities."""
+    spec_old, spec_new = _spec(), _spec(halflife_s=60.0)
+    j = Journal(str(tmp_path), spec_hash(spec_old), fsync_s=0.0)
+    j.append(*_triples(1, 16))
+    j.close()
+    rep = recover(str(tmp_path), spec_new)
+    assert rep.ok and not rep.restored and rep.replayed_rows == 0
+    # the matching spec still replays the same bytes
+    rep2 = recover(str(tmp_path), spec_old)
+    assert rep2.restored and rep2.replayed_rows == 16
+
+
+def test_journal_append_after_close_is_bounded_loss_not_a_crash(tmp_path):
+    """Shutdown can race an in-flight flush: the journal may be closed
+    while the micro-batcher is still inside the flush lock. The append
+    degrades to the same bounded loss as a crash in the fsync window —
+    never an AttributeError under the lock."""
+    j = Journal(str(tmp_path), "e" * 16, fsync_s=0.0)
+    j.append(*_triples(1, 8))
+    j.close()
+    seq = j.append(*_triples(2, 8))  # no-op, no raise
+    assert seq == 1
+    tail = read_tail(str(tmp_path), 0)
+    assert tail.n_records == 1
+
+
+def test_recover_journal_only_before_first_snapshot(tmp_path):
+    """A process that crashed before its first snapshot still recovers
+    every journaled row from a fresh table."""
+    spec = _spec()
+    j = Journal(str(tmp_path), spec_hash(spec), fsync_s=0.0)
+    batches = [_triples(seed, 24) for seed in range(2)]
+    for fp, ts, amt in batches:
+        j.append(fp, ts, amt)
+    j.close()
+    rep = recover(str(tmp_path), spec)
+    assert rep.restored and rep.snapshot_seq == 0
+    assert rep.replayed_rows == 48 and rep.resume_seq == 2
+    manual = replay_records(
+        spec, None, [(i + 1, *b) for i, b in enumerate(batches)]
+    )
+    assert _tables_equal(rep.state, manual)
+
+
+def test_replay_records_deterministic_and_segmentation_sensitive():
+    spec = _spec()
+    batches = [_triples(seed, 32) for seed in range(3)]
+    records = [(i + 1, *b) for i, b in enumerate(batches)]
+    a = replay_records(spec, None, records)
+    b = replay_records(spec, None, records)
+    assert _tables_equal(a, b)  # bitwise-reproducible
+    # rows present in every leaf that matters
+    assert np.asarray(a.acc).any()
+
+
+# -- the Lifeboat manager ---------------------------------------------------
+
+
+class _FakeDrift:
+    """The minimal drift surface the boat touches: a host table snapshot
+    plus the bind hook a recovery lands on."""
+
+    def __init__(self, table):
+        self._table = table
+        self.rows_seen = 77
+        self.bound = None
+
+    def ledger_snapshot(self):
+        return self._table
+
+    def bind_ledger(self, spec, state):
+        self.bound = (spec, state)
+        self._table = state
+
+
+def _staged_flush(spec, seed: int, bucket: int = 32):
+    """A fake staging slot + wire batch shaped like what _flush_device
+    hands journal_staged: lh/lf/lt lanes (zeros = entity-less rows) and
+    the staged feature block."""
+    rng = np.random.default_rng(seed)
+    lh = (rng.uniform(size=bucket) < 0.75).astype(np.float32)
+    slot = SimpleNamespace(
+        lh=lh,
+        lf=np.where(
+            lh > 0, rng.integers(1, 2**32, bucket, dtype=np.uint64), 0
+        ).astype(np.uint32),
+        lt=rng.uniform(5.0, 50.0, bucket).astype(np.float32),
+    )
+    hx = rng.standard_normal((bucket, D)).astype(np.float32)
+    return slot, hx
+
+
+def test_lifeboat_snapshot_journal_recover_cycle(tmp_path):
+    spec = _spec()
+    table = _table(11)
+    boat = Lifeboat(
+        str(tmp_path),
+        spec,
+        drift=_FakeDrift(table),
+        snapshot_s=1e9,
+        fsync_s=0.0,
+    )
+    rep0 = boat.recover()  # empty directory: nothing to restore
+    assert boat.state == "ready" and not rep0.restored
+    slot1, hx1 = _staged_flush(spec, 1)
+    slot2, hx2 = _staged_flush(spec, 2)
+    with boat.flush_lock:
+        boat.journal_staged(slot1, hx1, None, 32)
+    assert boat.take_snapshot() is not None  # generation at seq 1
+    with boat.flush_lock:
+        boat.journal_staged(slot2, hx2, None, 32)
+    status = boat.status()
+    assert status["state"] == "ready"
+    assert status["journal_seq"] == 2 and status["generations"] == [1]
+    boat.close()
+
+    fresh = _FakeDrift(_table(12))
+    boat2 = Lifeboat(
+        str(tmp_path), spec, drift=fresh, snapshot_s=1e9, fsync_s=0.0
+    )
+    rep = boat2.recover()
+    boat2.close()
+    assert rep.restored and rep.snapshot_seq == 1
+    n2 = int((slot2.lh != 0).sum())
+    assert rep.replayed_rows == n2
+    assert rep.rows_seen == 77  # carried through the snapshot header
+    assert fresh.bound is not None
+    # parity: the recovered table IS snapshot + journal tail through the
+    # traced body
+    tail = read_tail(str(tmp_path), 1)
+    manual = replay_records(spec, table, tail.records)
+    assert _tables_equal(rep.state, manual)
+    # journaling resumed past the recovered point
+    assert rep.resume_seq == 2
+
+
+def test_lifeboat_dequant_scale_folds_into_journaled_amount(tmp_path):
+    """On the int8 wire the traced body consumes dequantized lattice
+    values — the journal must record exactly those, or replay skews."""
+    spec = _spec()
+    boat = Lifeboat(
+        str(tmp_path),
+        spec,
+        drift=_FakeDrift(_table()),
+        snapshot_s=1e9,
+        fsync_s=0.0,
+    )
+    boat.recover()
+    slot, hx = _staged_flush(spec, 3)
+    scale = np.full(D, 0.25, np.float32)
+    with boat.flush_lock:
+        boat.journal_staged(slot, hx, scale, 32)
+    boat.close()
+    tail = read_tail(str(tmp_path), 0)
+    mask = slot.lh != 0
+    expect = (hx[: len(slot.lh), spec.amount_col][mask] * 0.25).astype(
+        np.float32
+    )
+    assert np.array_equal(tail.amount, expect)
+
+
+def test_lifeboat_torn_tail_counted_on_metric(tmp_path):
+    from fraud_detection_tpu.service import metrics as svc_metrics
+
+    spec = _spec()
+    boat = Lifeboat(
+        str(tmp_path),
+        spec,
+        drift=_FakeDrift(_table()),
+        snapshot_s=1e9,
+        fsync_s=0.0,
+    )
+    boat.recover()
+    slot, hx = _staged_flush(spec, 4)
+    with boat.flush_lock:
+        boat.journal_staged(slot, hx, None, 32)
+    boat.close()
+    path = journal_path(str(tmp_path), 0)
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:-4])
+    before = svc_metrics.lifeboat_torn_tail_rows._value.get()
+    boat2 = Lifeboat(str(tmp_path), spec, snapshot_s=1e9, fsync_s=0.0)
+    rep = boat2.recover()
+    boat2.close()
+    n = int((slot.lh != 0).sum())
+    assert rep.torn_rows == n
+    assert svc_metrics.lifeboat_torn_tail_rows._value.get() - before == n
+
+
+# -- drift window restore ---------------------------------------------------
+
+
+def test_restore_window_roundtrip_and_mismatch_skip():
+    from fraud_detection_tpu.monitor.baseline import build_baseline_profile
+    from fraud_detection_tpu.monitor.drift import DriftMonitor, DriftWindow
+
+    rng = np.random.default_rng(5)
+    profile = build_baseline_profile(
+        rng.standard_normal((128, 6)).astype(np.float32),
+        rng.uniform(0, 1, 128).astype(np.float32),
+    )
+    dm = DriftMonitor(profile, halflife_rows=100.0)
+    win = dm.window_snapshot()
+    assert dm.restore_window(win, rows_seen=420) is True
+    assert dm.rows_seen == 420
+    # a mismatched geometry (different profile shape) is skipped loudly,
+    # never bound — the next flush would recompile or crash otherwise
+    bad = DriftWindow(
+        feature_counts=np.zeros((2, 2), np.float32),
+        score_counts=np.asarray(win.score_counts),
+        calib_count=np.asarray(win.calib_count),
+        calib_conf=np.asarray(win.calib_conf),
+        calib_label=np.asarray(win.calib_label),
+        n_rows=np.asarray(win.n_rows),
+    )
+    assert dm.restore_window(bad, rows_seen=1) is False
+    assert dm.rows_seen == 420  # untouched by the refused restore

@@ -592,6 +592,58 @@ def test_grafana_panopticon_row_present():
         assert "scorer_flushes_total" in text, rel
 
 
+def test_lifeboat_rules_file_ships():
+    """The lifeboat contract (ISSUE 15): lifeboat-alerts.yml ships
+    promlint-clean with the staleness + fsync-lag alerts."""
+    path = os.path.join(RULES_DIR, "lifeboat-alerts.yml")
+    assert os.path.exists(path)
+    assert promlint.lint_rules_file(path) == []
+    with open(path) as f:
+        text = f.read()
+    assert "SnapshotStale" in text
+    assert "JournalLagGrowing" in text
+    # the lag alert must be the drains-to-zero shape, not a raw threshold
+    # (a burst legitimately spikes the gauge between fsync ticks)
+    assert "min_over_time" in text
+    assert "DisasterRecovery.md" in text  # runbook link
+
+
+def test_lifeboat_alert_metrics_exist_in_registry():
+    """Every lifeboat_* metric the rules reference must be exported by
+    service/metrics.py — same drift-proofing contract as the other rule
+    files."""
+    exported = _exported_metric_names()
+    with open(os.path.join(RULES_DIR, "lifeboat-alerts.yml")) as f:
+        text = f.read()
+    referenced = set(re.findall(r"\b(lifeboat_[a-z_]+)\b", text))
+    referenced -= {"lifeboat_alerts"}
+    assert referenced, "lifeboat rules reference no lifeboat metrics?"
+    missing = {
+        name for name in referenced
+        if name not in exported
+        and name.removesuffix("_total") not in exported
+        and f"{name}_total" not in exported
+        and name.removesuffix("_seconds") not in exported
+    }
+    assert not missing, f"alert rules reference unexported metrics: {missing}"
+
+
+def test_grafana_lifeboat_row_present():
+    """Both dashboards carry the lifeboat row (snapshot age + journal lag,
+    replay/torn-loss counters, recovery duration)."""
+    for rel in (
+        "grafana_dashboard.json",
+        os.path.join("grafana_provisioning", "dashboards", "fraud-tpu.json"),
+    ):
+        with open(os.path.join(MONITORING, rel)) as f:
+            text = f.read()
+        assert "lifeboat_snapshot_age_seconds" in text, rel
+        assert "lifeboat_journal_lag_rows" in text, rel
+        assert "lifeboat_replayed_rows_total" in text, rel
+        assert "lifeboat_torn_tail_rows_total" in text, rel
+        assert "lifeboat_recovery_duration_seconds" in text, rel
+
+
 def test_graftcheck_alert_metric_rule_clean_on_repo():
     """The panopticon lint gate: every committed rule file's exprs
     reference only metrics registered in service/metrics.py (or the

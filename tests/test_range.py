@@ -497,6 +497,31 @@ def test_scenario_gbt_explain_under_burst():
 
 
 @pytest.mark.slow
+def test_scenario_crash_warm_restart(tmp_path):
+    """Lifeboat (ISSUE 15): the service killed mid-flush under live
+    entity-bearing traffic — the warm restart bitwise-equals both an
+    independent replay of the snapshot+journal bytes and a clean
+    uninterrupted drive, /health answers 503 + Retry-After while the
+    replay runs then flips ready, and post-recovery scoring costs zero
+    new fused-flush compiles."""
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario("crash_warm_restart", tmpdir=str(tmp_path)).raise_if_failed()
+
+
+@pytest.mark.slow
+def test_scenario_kill_mid_snapshot(tmp_path):
+    """Lifeboat (ISSUE 15): the snapshotter killed between the journal
+    rotation and the generation landing, plus a fabricated torn newest
+    generation — the previous generation loads, the synced journal
+    replays the FULL table bitwise, and a torn journal tail loses exactly
+    the final flush, counted on lifeboat_torn_tail_rows_total."""
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario("kill_mid_snapshot", tmpdir=str(tmp_path)).raise_if_failed()
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "kill_point",
     [
