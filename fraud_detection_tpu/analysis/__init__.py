@@ -1,6 +1,6 @@
 """graftcheck: JAX-aware static analysis + virtual-mesh shape verification.
 
-Two passes, one CLI (``python -m fraud_detection_tpu.analysis`` or the
+Four passes, one CLI (``python -m fraud_detection_tpu.analysis`` or the
 ``graftcheck`` console script):
 
 - **Pass 1 — AST lint engine** (:mod:`.core`, :mod:`.rules_jax`,
@@ -16,6 +16,18 @@ Two passes, one CLI (``python -m fraud_detection_tpu.analysis`` or the
   ``jax.eval_shape`` under CPU meshes of sizes 1/2/8, proving that shapes
   and named shardings compose at every mesh size before code ever reaches a
   real TPU topology.
+- **Pass 3 — jaxpr contract prover** (:mod:`.contracts`, ``--contracts``):
+  each registered entrypoint carries a declarative contract — allowed
+  collectives by primitive and count, required donations, forbidden host
+  callbacks, pinned wire dtypes — and the checker traces the entrypoint on
+  the virtual mesh, walks the closed jaxpr recursively, and diffs the
+  program against the contract.
+- **Pass 4 — lock discipline** (:mod:`.lockcheck`, :mod:`.locknames`,
+  ``--contracts`` runs it too): the named-lock inventory, a static
+  acquisition-order graph with cycle detection, inventory drift against
+  the ``lockdep`` creation sites, and the ``blocking-under-lock`` /
+  ``lock-in-jit`` lint rules. The runtime half is
+  :mod:`fraud_detection_tpu.utils.lockdep` (``LOCKDEP=1``).
 
 Findings are reported as text or JSON (:mod:`.report`) and gated against a
 checked-in baseline (:mod:`.baseline`); ``tests/test_static_analysis.py``
@@ -35,6 +47,7 @@ from fraud_detection_tpu.analysis.core import (  # noqa: F401
 )
 
 # Importing the rule modules populates the registry.
+from fraud_detection_tpu.analysis import lockcheck  # noqa: F401,E402
 from fraud_detection_tpu.analysis import rules_artifacts  # noqa: F401,E402
 from fraud_detection_tpu.analysis import rules_jax  # noqa: F401,E402
 from fraud_detection_tpu.analysis import rules_monitoring  # noqa: F401,E402

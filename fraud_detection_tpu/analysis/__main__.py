@@ -54,6 +54,15 @@ def main(argv: list[str] | None = None) -> int:
         help="run only the virtual-mesh shape verification pass",
     )
     ap.add_argument(
+        "--contracts", action="store_true",
+        help="also run the jaxpr contract prover (per-entrypoint collective/"
+        "donation/dtype contracts) and the lock-order pass",
+    )
+    ap.add_argument(
+        "--contracts-only", action="store_true",
+        help="run only the contract prover + lock-order pass",
+    )
+    ap.add_argument(
         "--mesh-sizes", default=None,
         help="comma-separated mesh sizes for the verifier (default 1,2,8)",
     )
@@ -72,7 +81,11 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = ap.parse_args(argv)
 
-    if not args.no_shape_check or args.shape_check_only:
+    if args.contracts_only:
+        args.contracts = True
+        args.no_shape_check = True
+
+    if not args.no_shape_check or args.shape_check_only or args.contracts:
         _ensure_virtual_devices()
 
     # Lint pass imports are pure-stdlib; meshcheck (imports jax + ops) is
@@ -106,16 +119,17 @@ def main(argv: list[str] | None = None) -> int:
             print(f"unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
             return 2
 
-    if args.write_baseline and args.shape_check_only:
+    if args.write_baseline and (args.shape_check_only or args.contracts_only):
         print(
-            "--write-baseline requires the lint pass; drop --shape-check-only"
-            " (writing here would wipe the baseline with an empty list)",
+            "--write-baseline requires the lint pass; drop "
+            "--shape-check-only/--contracts-only (writing here would wipe "
+            "the baseline with an empty list)",
             file=sys.stderr,
         )
         return 2
 
     findings = (
-        [] if args.shape_check_only
+        [] if args.shape_check_only or args.contracts_only
         else analyze_paths(paths, root=root, rules=rules)
     )
 
@@ -138,16 +152,40 @@ def main(argv: list[str] | None = None) -> int:
             sizes = tuple(int(s) for s in args.mesh_sizes.split(","))
         mesh_results = meshcheck.verify_all(sizes)
 
+    contract_results = contract_new = lock_report = lock_new = None
+    if args.contracts:
+        from fraud_detection_tpu.analysis import contracts, lockcheck
+
+        contract_results = contracts.verify_contracts()
+        contract_new, _ = baseline_mod.apply_keys(
+            contracts.violation_keys(contract_results),
+            baseline_mod.load_section(baseline_path, "contracts"),
+        )
+        lock_report = lockcheck.build_lock_report(root)
+        lock_new, _ = baseline_mod.apply_keys(
+            lockcheck.violation_keys(lock_report),
+            baseline_mod.load_section(baseline_path, "lockcheck"),
+        )
+
     if args.format == "json":
-        out = report.render_json(result, mesh_results)
+        out = report.render_json(
+            result, mesh_results,
+            contract_results=contract_results, contract_new=contract_new,
+            lock_report=lock_report, lock_new=lock_new,
+        )
     else:
-        out = report.render_text(result, mesh_results, verbose=args.verbose)
+        out = report.render_text(
+            result, mesh_results, verbose=args.verbose,
+            contract_results=contract_results, contract_new=contract_new,
+            lock_report=lock_report, lock_new=lock_new,
+        )
     print(out)
     if args.output:
         with open(args.output, "w", encoding="utf-8") as f:
             f.write(out + "\n")
     return report.exit_code(
-        result, mesh_results, fail_on=Severity.parse(args.fail_on)
+        result, mesh_results, fail_on=Severity.parse(args.fail_on),
+        contract_new=contract_new, lock_new=lock_new,
     )
 
 

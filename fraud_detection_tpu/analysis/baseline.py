@@ -34,28 +34,64 @@ class BaselineResult:
     stale: list[dict]           # baseline entries matching nothing (prunable)
 
 
-def load(path: str) -> list[dict]:
+def _load_doc(path: str) -> dict:
     if not os.path.exists(path):
-        return []
+        return {}
     with open(path, encoding="utf-8") as f:
         doc = json.load(f)
-    if isinstance(doc, dict):
-        return doc.get("findings", [])
+    if isinstance(doc, list):  # pre-sectioned format: bare findings list
+        return {"findings": doc}
     return doc
 
 
+def load(path: str) -> list[dict]:
+    return _load_doc(path).get("findings", [])
+
+
+def load_section(path: str, section: str) -> list[str]:
+    """Accepted violation keys for a non-lint pass (``"contracts"`` /
+    ``"lockcheck"``). Keys are the stable strings each pass mints
+    (``entrypoint:diagnostic``, ``lock-cycle:a -> b -> a``); empty is the
+    repo norm — the sections exist so adopting a new pass on a tree with
+    accepted debt never requires fixing it in the same PR."""
+    vals = _load_doc(path).get(section, [])
+    return [v for v in vals if isinstance(v, str)]
+
+
 def save(path: str, findings: Iterable[Finding]) -> None:
+    prior = _load_doc(path)
     doc = {
         "comment": (
-            "graftcheck baseline: accepted findings. Regenerate with "
-            "`python -m fraud_detection_tpu.analysis --write-baseline` "
-            "after reviewing that every entry is an accepted exception."
+            "graftcheck baseline: accepted findings, per pass. `findings` "
+            "is the lint pass (regenerate with `python -m "
+            "fraud_detection_tpu.analysis --write-baseline` after reviewing "
+            "that every entry is an accepted exception); `contracts` and "
+            "`lockcheck` hold accepted violation keys for the contract "
+            "prover and the lock-order pass (edit by hand; empty is the "
+            "norm)."
         ),
         "findings": [f.to_dict() for f in findings],
+        "contracts": prior.get("contracts", []),
+        "lockcheck": prior.get("lockcheck", []),
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=2, sort_keys=False)
         f.write("\n")
+
+
+def apply_keys(keys: list[str], accepted: list[str]) -> tuple[list[str], list[str]]:
+    """Multiset-diff stable violation keys against a baseline section:
+    returns ``(new, stale)`` — keys not covered by the baseline, and
+    baseline entries matching no current violation (prunable)."""
+    budget = Counter(accepted)
+    new: list[str] = []
+    for k in keys:
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            new.append(k)
+    stale = list(budget.elements())
+    return new, stale
 
 
 def apply(findings: list[Finding], entries: list[dict]) -> BaselineResult:

@@ -1,0 +1,598 @@
+"""Pass 3: jaxpr contract prover — program-structure contracts per entrypoint.
+
+meshcheck proves the *shapes* of every registered jitted entrypoint compose
+at every mesh size; this pass proves their *program structure*. Each
+entrypoint carries a declarative :class:`Contract`:
+
+- **collective budget**: exactly which collective primitives, and how many
+  static occurrences, the lowered program may contain (e.g.
+  ``mesh.broadside_flush: {psum: 1}`` — the one model-axis partial-dot
+  assembly — and ``{}`` for every single-device/zero-collective shard
+  body). A refactor that smuggles an ``all_gather`` into a serving hot
+  path fails CI with a named contract, not a perf mystery.
+- **forbidden primitives**: host callbacks (``io_callback`` /
+  ``pure_callback`` / ``debug_callback`` / ``outside_call``) and
+  infeed/outfeed never appear on serving paths — a stray
+  ``jax.debug.print`` left in a fused body is a sync per dispatch.
+- **donation**: the state-threading args (drift window, ledger table,
+  optimizer state) must actually be donatable — every donated leaf needs
+  an identically-shaped/dtyped output to alias, and the serving jit site
+  (``donate_site``) must still declare exactly the contracted
+  ``donate_argnums`` (checked against the source AST, so dropping a
+  donation in a refactor is caught even though the meshcheck builders wrap
+  the raw body).
+- **output dtypes**: the wire contract — e.g. quickwire's uint8 score
+  codes, lantern's float16 reason values — pinned per flat output leaf.
+
+The checker reuses meshcheck's registry and virtual CPU meshes: it builds
+each entrypoint at its largest registered mesh size, traces it with
+``jax.make_jaxpr`` (abstract — nothing executes), walks the closed jaxpr
+recursively through ``pjit``/``shard_map``/``scan``/``cond`` inner jaxprs,
+and diffs what it finds against the contract. Counts are *static
+occurrences in the program text* (a psum inside a scan body counts once),
+matching the hand-written jaxpr pins this pass replaces
+(``tests/test_broadside.py`` one-psum → ``mesh.broadside_flush``).
+
+Every registered entrypoint MUST have a contract — an uncovered
+entrypoint is itself a violation, so the registry cannot lag meshcheck.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping
+
+#: primitive-name → canonical collective name (psum traces as ``psum2``
+#: under shard_map; reduce_scatter and psum_scatter are one budget line)
+COLLECTIVE_CANON: Mapping[str, str] = {
+    "psum": "psum",
+    "psum2": "psum",
+    "all_gather": "all_gather",
+    "all_gather_invariant": "all_gather",
+    "reduce_scatter": "psum_scatter",
+    "psum_scatter": "psum_scatter",
+    "all_to_all": "all_to_all",
+    "ppermute": "ppermute",
+    "pgather": "pgather",
+    # NOT pbroadcast: it is shard_map's replication-annotation primitive
+    # (inserted by the rep-rule rewrite, no data movement) — counting it
+    # would fail legitimate programs on shard_map internals.
+}
+
+#: primitives that must never appear on a serving path: host round-trips
+#: (callbacks) and raw device I/O
+DEFAULT_FORBID: tuple[str, ...] = (
+    "io_callback",
+    "pure_callback",
+    "debug_callback",
+    "debug_print",
+    "outside_call",
+    "infeed",
+    "outfeed",
+)
+
+
+@dataclass(frozen=True)
+class DonateSite:
+    """The serving jit site whose ``donate_argnums`` the contract pins."""
+
+    module: str  # repo-relative path
+    function: str
+    argnums: tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class Contract:
+    entrypoint: str
+    #: canonical collective name → exact static occurrence count; any
+    #: collective not listed is budgeted at 0
+    collectives: Mapping[str, int] = field(default_factory=dict)
+    #: argnums of the *contract fn* (meshcheck builder order) that serving
+    #: donates — checked for aliasing feasibility via lowering
+    donate: tuple[int, ...] = ()
+    #: the real jit site whose donate_argnums must match (AST-checked)
+    donate_site: DonateSite | None = None
+    forbid: tuple[str, ...] = DEFAULT_FORBID
+    #: dtype names of the flat output leaves (None = unpinned)
+    out_dtypes: tuple[str, ...] | None = None
+    notes: str = ""
+
+
+_CONTRACTS: dict[str, Contract] = {}
+
+
+def register_contract(con: Contract) -> Contract:
+    if con.entrypoint in _CONTRACTS:
+        raise ValueError(f"duplicate contract for {con.entrypoint!r}")
+    _CONTRACTS[con.entrypoint] = con
+    return con
+
+
+def get_contract(entrypoint: str) -> Contract | None:
+    return _CONTRACTS.get(entrypoint)
+
+
+def iter_contracts() -> list[Contract]:
+    return list(_CONTRACTS.values())
+
+
+# --------------------------------------------------------------------------
+# Jaxpr walking
+# --------------------------------------------------------------------------
+
+
+def _subjaxprs(params: Mapping):
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    for v in params.values():
+        if isinstance(v, ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, Jaxpr):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                if isinstance(x, ClosedJaxpr):
+                    yield x.jaxpr
+                elif isinstance(x, Jaxpr):
+                    yield x
+
+
+def iter_eqns(jaxpr):
+    """All equations of ``jaxpr``, recursing through every inner jaxpr
+    (pjit, shard_map, scan, while, cond branches, custom_* rules)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _subjaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def count_collectives(closed_jaxpr) -> Counter:
+    """Static occurrence count of each canonical collective in the whole
+    (recursively walked) program."""
+    counts: Counter = Counter()
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        canon = COLLECTIVE_CANON.get(eqn.primitive.name)
+        if canon is not None:
+            counts[canon] += 1
+    return counts
+
+
+def forbidden_hits(closed_jaxpr, forbid: Iterable[str]) -> Counter:
+    forbid = set(forbid)
+    hits: Counter = Counter()
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name in forbid:
+            hits[eqn.primitive.name] += 1
+    return hits
+
+
+# --------------------------------------------------------------------------
+# Donation checks
+# --------------------------------------------------------------------------
+
+
+def _flat_avals(tree) -> list:
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _check_donation(con: Contract, fn, args) -> list[dict]:
+    """Donation must be (a) accepted by jit for every leaf of every
+    contracted argnum and (b) implementable — each donated leaf needs an
+    identically shaped+dtyped output buffer to alias, or XLA silently
+    degrades the donation to a copy."""
+    import jax
+
+    out: list[dict] = []
+    jitted = jax.jit(fn, donate_argnums=con.donate)
+    lowered = jitted.lower(*args)
+    pos_info = lowered.args_info[0]  # (args, kwargs) pytree of ArgInfo
+    for argnum in con.donate:
+        infos = jax.tree_util.tree_leaves(pos_info[argnum])
+        undonated = [i for i, inf in enumerate(infos) if not inf.donated]
+        if undonated:
+            out.append({
+                "diagnostic": "dropped-donation",
+                "detail": (
+                    f"arg {argnum}: {len(undonated)}/{len(infos)} leaves "
+                    f"not donated under donate_argnums={con.donate}"
+                ),
+            })
+    out_leaves = Counter(
+        (tuple(l.shape), str(l.dtype))
+        for l in _flat_avals(jax.eval_shape(fn, *args))
+    )
+    donated_leaves = Counter(
+        (tuple(l.shape), str(l.dtype))
+        for argnum in con.donate
+        for l in _flat_avals(args[argnum])
+    )
+    unaliasable = donated_leaves - out_leaves
+    if unaliasable:
+        out.append({
+            "diagnostic": "donation-unimplementable",
+            "detail": (
+                "donated buffers with no identically shaped+dtyped output "
+                f"to alias (donation degrades to a copy): "
+                f"{sorted(unaliasable.elements())[:4]}"
+            ),
+        })
+    return out
+
+
+def _decorator_donate_argnums(fn_node: ast.AST) -> list[tuple[int, ...]]:
+    """Every ``donate_argnums=(...)`` literal attached to ``fn_node`` —
+    via ``@partial(jax.jit, ...)`` / ``@jax.jit(...)`` decorators or a
+    ``jax.jit(..., donate_argnums=...)`` call in the body (the shard_map
+    wrappers jit inside the function)."""
+    found: list[tuple[int, ...]] = []
+    nodes = list(getattr(fn_node, "decorator_list", []))
+    nodes.extend(ast.walk(fn_node))
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg not in ("donate_argnums", "donate_argnames"):
+                continue
+            try:
+                val = ast.literal_eval(kw.value)
+            except (ValueError, SyntaxError):
+                continue
+            if isinstance(val, int):
+                val = (val,)
+            if isinstance(val, (tuple, list)):
+                found.append(tuple(int(v) for v in val))
+    return found
+
+
+def _check_donate_site(site: DonateSite, root: str) -> list[dict]:
+    path = os.path.join(root, site.module)
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError) as e:
+        return [{
+            "diagnostic": "donate-site-drift",
+            "detail": f"{site.module} unreadable/unparsable: {e}",
+        }]
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == site.function
+        ):
+            declared = _decorator_donate_argnums(node)
+            if tuple(site.argnums) in declared:
+                return []
+            return [{
+                "diagnostic": "donate-site-drift",
+                "detail": (
+                    f"{site.module}::{site.function} declares "
+                    f"donate_argnums {declared or 'nothing'}, contract "
+                    f"requires {tuple(site.argnums)}"
+                ),
+            }]
+    return [{
+        "diagnostic": "donate-site-drift",
+        "detail": f"{site.module}::{site.function} not found",
+    }]
+
+
+# --------------------------------------------------------------------------
+# The checker
+# --------------------------------------------------------------------------
+
+
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def check_contract(con: Contract, ep=None, root: str | None = None) -> dict:
+    """Verify one contract against its entrypoint's traced program at the
+    largest registered mesh size. Returns a result dict with named
+    diagnostics (empty ``violations`` ⇔ the contract holds)."""
+    import jax
+
+    from fraud_detection_tpu.analysis import meshcheck
+    from fraud_detection_tpu.parallel.mesh import MeshSpec, create_mesh
+
+    root = root or _repo_root()
+    if ep is None:
+        ep = meshcheck._ENTRYPOINTS.get(con.entrypoint)
+    res: dict = {
+        "entrypoint": con.entrypoint,
+        "mesh_size": None,
+        "ok": False,
+        "violations": [],
+    }
+    if ep is None:
+        res["violations"].append({
+            "diagnostic": "unknown-entrypoint",
+            "detail": "contract has no matching meshcheck entrypoint",
+        })
+        return res
+    size = ep.mesh_sizes[-1]
+    d_ax, m_ax = size if isinstance(size, tuple) else (size, 1)
+    res["mesh_size"] = f"{d_ax}x{m_ax}" if isinstance(size, tuple) else size
+    try:
+        devices = jax.devices()
+        if len(devices) < d_ax * m_ax:
+            raise RuntimeError(
+                f"need {d_ax * m_ax} devices, have {len(devices)} — run "
+                "under XLA_FLAGS=--xla_force_host_platform_device_count=8"
+            )
+        mesh = create_mesh(
+            MeshSpec(data=d_ax, model=m_ax), devices=devices[: d_ax * m_ax]
+        )
+        fn, args = ep.build(mesh)
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # graftcheck: ignore[silent-except] — error is the result (reported + gates CI)
+        res["violations"].append({
+            "diagnostic": "trace-failure",
+            "detail": f"{type(e).__name__}: {e}",
+        })
+        return res
+
+    counts = count_collectives(closed)
+    budget = dict(con.collectives)
+    for name in sorted(set(counts) | set(budget)):
+        want, got = budget.get(name, 0), counts.get(name, 0)
+        if got == want:
+            continue
+        if want == 0:
+            diag = "undeclared-collective"
+        elif got == 0:
+            diag = "missing-collective"
+        else:
+            diag = "collective-count"
+        res["violations"].append({
+            "diagnostic": diag,
+            "detail": f"{name}: contract allows {want}, program has {got}",
+        })
+
+    hits = forbidden_hits(closed, con.forbid)
+    for name, n in sorted(hits.items()):
+        res["violations"].append({
+            "diagnostic": "forbidden-primitive",
+            "detail": f"{name} appears {n}x (host sync on a serving path)",
+        })
+
+    if con.out_dtypes is not None:
+        got_dtypes = tuple(str(v.aval.dtype) for v in closed.jaxpr.outvars)
+        if got_dtypes != tuple(con.out_dtypes):
+            res["violations"].append({
+                "diagnostic": "output-dtype",
+                "detail": (
+                    f"contract pins {tuple(con.out_dtypes)}, program "
+                    f"returns {got_dtypes}"
+                ),
+            })
+
+    if con.donate:
+        try:
+            res["violations"].extend(_check_donation(con, fn, args))
+        except Exception as e:  # graftcheck: ignore[silent-except] — error is the result (reported + gates CI)
+            res["violations"].append({
+                "diagnostic": "dropped-donation",
+                "detail": f"lowering failed: {type(e).__name__}: {e}",
+            })
+    if con.donate_site is not None:
+        res["violations"].extend(_check_donate_site(con.donate_site, root))
+
+    res["ok"] = not res["violations"]
+    return res
+
+
+def verify_contracts(
+    names: Iterable[str] | None = None, root: str | None = None
+) -> list[dict]:
+    """Check every contract, plus coverage: a meshcheck entrypoint with no
+    contract is a violation (the registry must ride the meshcheck one)."""
+    from fraud_detection_tpu.analysis import meshcheck
+
+    wanted = set(names) if names is not None else None
+    results: list[dict] = []
+    for con in iter_contracts():
+        if wanted is not None and con.entrypoint not in wanted:
+            continue
+        results.append(check_contract(con, root=root))
+    if wanted is None:
+        for ep in meshcheck.iter_entrypoints():
+            if ep.name not in _CONTRACTS:
+                results.append({
+                    "entrypoint": ep.name,
+                    "mesh_size": None,
+                    "ok": False,
+                    "violations": [{
+                        "diagnostic": "uncovered-entrypoint",
+                        "detail": (
+                            "registered in meshcheck but has no contract "
+                            "— declare its collective/donation/wire budget"
+                        ),
+                    }],
+                })
+    results.sort(key=lambda r: r["entrypoint"])
+    return results
+
+
+def violation_keys(results: list[dict]) -> list[str]:
+    """Stable baseline keys, one per violation: ``entrypoint:diagnostic``."""
+    return [
+        f"{r['entrypoint']}:{v['diagnostic']}"
+        for r in results
+        for v in r["violations"]
+    ]
+
+
+# --------------------------------------------------------------------------
+# The contract table — one entry per registered entrypoint.
+#
+# Collective budgets and wire dtypes are the *declared design*, not a
+# recording: the serving flushes are zero-collective by construction (the
+# bitwise N-shard contract), broadside's 2-D flush spends exactly one
+# model-axis psum, and the training epochs spend their documented
+# 2004.13336 budgets. Changing any of these is an API change and must
+# edit the contract in the same PR.
+# --------------------------------------------------------------------------
+
+_DRIFT = "fraud_detection_tpu/monitor/drift.py"
+_SHARDFLUSH = "fraud_detection_tpu/mesh/shardflush.py"
+_RETRAIN = "fraud_detection_tpu/mesh/retrain.py"
+
+#: the six DriftWindow leaves, in pytree order — every fused flush returns
+#: the folded window after its primary outputs
+_WINDOW = ("float32",) * 6
+
+for _con in (
+    # -- stateless numerics ------------------------------------------------
+    Contract("scorer.score", out_dtypes=("float32",)),
+    Contract("telemetry.instrumented_score", out_dtypes=("float32",)),
+    Contract("logistic.lbfgs_fit", out_dtypes=("float32", "float32")),
+    Contract(
+        "logistic.sgd_epoch",
+        collectives={"psum": 3},
+        out_dtypes=("float32",) * 4,
+        notes="DP allreduce: coef grad, intercept grad, weight-sum "
+        "normalizer — one scan body, counted statically",
+    ),
+    Contract(
+        "gbt.boost_step",
+        collectives={"psum": 4},
+        out_dtypes=("int32", "int32", "float32"),
+        notes="histogram psums per boost level (segment impl), trees "
+        "replicated out",
+    ),
+    Contract("gbt.predict_proba", out_dtypes=("float32",)),
+    Contract("smote.oversample", out_dtypes=("float32", "int32")),
+    Contract("linear_shap.batch", out_dtypes=("float32",)),
+    Contract("tree_shap.batch", out_dtypes=("float32",)),
+    Contract("scaler.fit_transform", out_dtypes=("float32",)),
+    Contract(
+        "lifecycle.gate_eval", out_dtypes=("float32",) * 4,
+        notes="one fused program per gate slice; NaN fails closed host-side",
+    ),
+    # -- watchtower --------------------------------------------------------
+    Contract(
+        "watchtower.baseline_profile", out_dtypes=("float32", "float32")
+    ),
+    Contract(
+        "watchtower.window_update",
+        donate=(0,),
+        donate_site=DonateSite(_DRIFT, "_window_update", (0,)),
+        out_dtypes=_WINDOW,
+    ),
+    # -- fused serving flushes (single device): zero collectives, window
+    # donated through, wire dtypes pinned ---------------------------------
+    Contract(
+        "fastlane.flush",
+        donate=(0,),
+        donate_site=DonateSite(_DRIFT, "_fused_flush", (0,)),
+        out_dtypes=("float32",) + _WINDOW,
+    ),
+    Contract(
+        "quickwire.flush",
+        donate=(0,),
+        donate_site=DonateSite(_DRIFT, "_fused_flush_quant", (0,)),
+        out_dtypes=("uint8",) + _WINDOW,
+        notes="uint8 = the compressed d2h return wire",
+    ),
+    Contract(
+        "lantern.flush",
+        donate=(0,),
+        donate_site=DonateSite(_DRIFT, "_fused_flush_explain", (0,)),
+        out_dtypes=("float32", "uint8", "float32") + _WINDOW,
+        notes="scores, top-k reason indices (uint8), reason values",
+    ),
+    Contract(
+        "evergreen.flush",
+        donate=(0,),
+        donate_site=DonateSite(_DRIFT, "_fused_flush_quant_explain", (0,)),
+        out_dtypes=("uint8", "uint8", "float16") + _WINDOW,
+        notes="GBT quant wire: uint8 scores, uint8 reason idx, f16 values",
+    ),
+    Contract(
+        "ledger.flush",
+        donate=(0, 1),
+        donate_site=DonateSite(_DRIFT, "_fused_flush_ledger", (0, 1)),
+        out_dtypes=("float32",) + _WINDOW
+        + ("float32", "float32", "uint32", "float32", "float32"),
+        notes="window AND entity table donated through one dispatch",
+    ),
+    Contract(
+        "broadside.flush",
+        donate=(0,),
+        donate_site=DonateSite(_DRIFT, "_fused_flush_wide", (0,)),
+        out_dtypes=("float32", "uint8", "float32") + _WINDOW,
+    ),
+    # -- mesh serving flushes: ONE shard_map dispatch, zero collectives
+    # (the bitwise N-shard contract), per-shard windows donated ------------
+    Contract(
+        "mesh.sharded_flush",
+        donate=(0,),
+        donate_site=DonateSite(_SHARDFLUSH, "_sharded_flush", (0,)),
+        out_dtypes=("float32",) + _WINDOW,
+    ),
+    Contract(
+        "mesh.quickwire_flush",
+        donate=(0,),
+        donate_site=DonateSite(_SHARDFLUSH, "_sharded_flush_quant", (0,)),
+        out_dtypes=("uint8",) + _WINDOW,
+    ),
+    Contract(
+        "mesh.lantern_flush",
+        donate=(0,),
+        donate_site=DonateSite(_SHARDFLUSH, "_sharded_flush_explain", (0,)),
+        out_dtypes=("float32", "uint8", "float32") + _WINDOW,
+    ),
+    Contract(
+        "mesh.evergreen_flush",
+        donate=(0,),
+        donate_site=DonateSite(
+            _SHARDFLUSH, "_sharded_flush_quant_explain", (0,)
+        ),
+        out_dtypes=("uint8", "uint8", "float16") + _WINDOW,
+    ),
+    Contract(
+        "mesh.ledger_flush",
+        donate=(0, 1),
+        donate_site=DonateSite(_SHARDFLUSH, "_sharded_flush_ledger", (0, 1)),
+        out_dtypes=("float32",) + _WINDOW
+        + ("float32", "float32", "uint32", "float32", "float32"),
+        notes="rows placement-aligned host-side — never a device collective",
+    ),
+    Contract(
+        "mesh.broadside_flush",
+        collectives={"psum": 1},
+        donate=(0,),
+        donate_site=DonateSite(_SHARDFLUSH, "_sharded_flush_wide", (0,)),
+        out_dtypes=("float32", "uint8", "float32") + _WINDOW,
+        notes="THE one-psum pin (was tests/test_broadside.py's inline "
+        "jaxpr assert): exactly one model-axis psum assembles the widened "
+        "block; any other collective on the wide hot path is a violation",
+    ),
+    # -- training epochs: the declared 2004.13336 collective spend ---------
+    Contract(
+        "mesh.sharded_update",
+        collectives={"all_gather": 1, "psum": 2, "psum_scatter": 1},
+        donate=(0, 1),
+        donate_site=DonateSite(_RETRAIN, "_sharded_update_epoch", (0, 1)),
+        out_dtypes=("float32",) * 4,
+        notes="full-vector all_gather per forward, grads psum_scatter'd "
+        "onto owning shards, intercept psums",
+    ),
+    Contract(
+        "mesh.wide_update",
+        collectives={"all_gather": 1, "psum": 4, "psum_scatter": 1},
+        donate=(0, 1, 2, 3),
+        donate_site=DonateSite(_RETRAIN, "_wide_update_epoch", (0, 1, 2, 3)),
+        out_dtypes=("float32",) * 6,
+        notes="2-D: model-axis psum assembles the widened logit; data-axis "
+        "grad reduction + scatter onto column owners",
+    ),
+):
+    register_contract(_con)

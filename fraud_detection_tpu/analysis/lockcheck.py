@@ -1,0 +1,576 @@
+"""Pass 4: static lock-order + lock-hygiene analysis over the named locks.
+
+Three checks ride the :mod:`.locknames` inventory:
+
+1. **Acquisition-order graph** (:func:`build_lock_report`): every
+   ``with <lock>:`` site in the package is resolved to a canonical lock
+   name; directly nested acquisitions record an order edge, and a one-hop
+   interprocedural closure adds edges for calls made while a lock is held
+   to functions that themselves acquire a named lock (``take_snapshot``
+   holding ``lifeboat.flush`` calls ``journal.rotate`` which takes
+   ``lifeboat.journal`` → edge ``lifeboat.flush → lifeboat.journal``).
+   A cycle in the graph is an ABBA deadlock waiting for timing; the gate
+   requires the graph acyclic. The runtime witness
+   (:mod:`fraud_detection_tpu.utils.lockdep`) checks the same property on
+   *executed* orders — static for coverage, dynamic for call-chains deeper
+   than one hop.
+
+2. **Inventory drift**: every ``lockdep.lock("name")`` /
+   ``lockdep.rlock("name")`` creation site must have a matching
+   :class:`~fraud_detection_tpu.analysis.locknames.LockDecl` (same module,
+   same kind), and every declaration must have a creation site. The
+   inventory the docs render and the witness instruments cannot rot.
+
+3. **graftcheck rules** (per-module, baseline/suppression discipline):
+
+   - ``blocking-under-lock``: a blocking operation (fsync, socket I/O,
+     sleep, device sync, future.result) — or a call to a same-module
+     function that performs one — inside a held named-lock region. Every
+     occurrence is either a bug or a reviewed design point carrying a
+     ``# graftcheck: ignore[blocking-under-lock]`` sanction (the journal's
+     group-commit fsync under its own lock is the canonical sanction).
+   - ``lock-in-jit``: threading primitives referenced inside a
+     jit-compiled function body — locks don't trace; at best they run at
+     trace time (once), at worst they capture a tracer.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from fraud_detection_tpu.analysis import locknames
+from fraud_detection_tpu.analysis.core import (
+    ModuleInfo,
+    Severity,
+    dotted_name,
+    iter_python_files,
+    register_rule,
+)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: dotted-name suffixes that block the calling thread. Deliberately narrow:
+#: every entry is unambiguous enough that a hit under a held lock is worth
+#: a human decision (fix or sanction) — no ``.join`` (str.join) or broad
+#: "I/O-ish" names.
+BLOCKING_SUFFIXES: frozenset[str] = frozenset({
+    "os.fsync",
+    "os.fdatasync",
+    "time.sleep",
+    ".sendall",
+    ".recv",
+    ".recv_into",
+    ".accept",
+    ".connect",
+    ".block_until_ready",
+    "jax.block_until_ready",
+    "jax.device_get",
+    ".result",
+})
+
+#: method names too generic to resolve across modules without a receiver
+#: hint (``rows.append`` must not resolve to ``Journal.append``)
+_COMMON_METHODS: frozenset[str] = frozenset({
+    "append", "close", "flush", "sync", "get", "put", "update", "stats",
+    "write", "read", "pop", "add", "remove", "clear", "reset", "start",
+    "stop", "run", "send",
+})
+
+_THREADING_PRIMITIVES: frozenset[str] = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "lockdep.lock", "lockdep.rlock",
+})
+
+
+def _hint_matches(hint: str, cls: str) -> bool:
+    """Receiver-name ↔ class-name affinity: ``boat`` ↔ ``Lifeboat``,
+    ``drift`` ↔ ``DriftMonitor``, ``journal`` ↔ ``Journal``. Receivers
+    shorter than 3 chars (``self._f``, loop vars) carry no type evidence
+    and never match — a one-letter handle must not resolve to a lock
+    owner just because the letter occurs in some class name."""
+    h, c = hint.lower().lstrip("_"), cls.lower()
+    return len(h) >= 3 and (h in c or c in h)
+
+
+# --------------------------------------------------------------------------
+# Lock-name resolution
+# --------------------------------------------------------------------------
+
+
+class _ClassMap:
+    """class name → base-class names, per module (names, not objects — a
+    subclass in another module names its base textually, which is all the
+    resolver needs: ``MeshDriftMonitor(DriftMonitor)`` inherits the
+    ``drift.window`` binding)."""
+
+    def __init__(self):
+        self.bases: dict[str, set[str]] = {}
+
+    def add_module(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                names = set()
+                for b in node.bases:
+                    dn = dotted_name(b)
+                    if dn:
+                        names.add(dn.split(".")[-1])
+                self.bases.setdefault(node.name, set()).update(names)
+
+    def is_a(self, cls: str, base: str) -> bool:
+        seen: set[str] = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop()
+            if c == base:
+                return True
+            if c in seen:
+                continue
+            seen.add(c)
+            stack.extend(self.bases.get(c, ()))
+        return False
+
+
+def resolve_lock_name(
+    expr: ast.AST, enclosing_cls: str | None, classes: _ClassMap
+) -> str | None:
+    """Canonical lock name for a ``with <expr>:`` context item, or None
+    when the expression is not (recognizably) a named lock."""
+    dn = dotted_name(expr)
+    if dn is None:
+        return None
+    parts = dn.split(".")
+    attr = parts[-1]
+    decls = locknames.by_attr().get(attr)
+    if not decls:
+        return None
+    # self.<attr> — the owning class (or a subclass of it) declares it
+    if parts[:-1] == ["self"] and enclosing_cls is not None:
+        for d in decls:
+            if d.cls and classes.is_a(enclosing_cls, d.cls):
+                return d.name
+    # unique attribute name repo-wide (flush_lock, _retrain_lock, ...)
+    if len(decls) == 1:
+        return decls[0].name
+    # receiver hint: boat.flush_lock / self.pool._lock / journal._lock
+    if len(parts) >= 2 and parts[-2] != "self":
+        for d in decls:
+            if d.cls and _hint_matches(parts[-2], d.cls):
+                return d.name
+    return None
+
+
+# --------------------------------------------------------------------------
+# Package index: every function, its acquisitions, its blocking ops
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _Func:
+    module: str  # repo-relative path
+    cls: str | None
+    name: str
+    node: ast.AST
+    #: named locks this function acquires anywhere in its own body
+    acquires: list[tuple[str, int]] = field(default_factory=list)
+    #: (held-lock names at that point, order edges, calls-under-lock)
+    edges: list[tuple[str, str, int]] = field(default_factory=list)
+    calls_under: list[tuple[str, ast.Call]] = field(default_factory=list)
+
+
+def _walk_function(fn: _Func, classes: _ClassMap) -> None:
+    """Single pass over one function body tracking the held-lock stack;
+    nested function defs get their own _Func and are skipped here."""
+
+    def visit(node: ast.AST, held: tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (*_FuncDef, ast.Lambda)):
+                continue  # nested def: analyzed as its own function
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                names = []
+                for item in child.items:
+                    ln = resolve_lock_name(
+                        item.context_expr, fn.cls, classes
+                    )
+                    if ln is not None:
+                        names.append(ln)
+                for ln in names:
+                    fn.acquires.append((ln, child.lineno))
+                    for h in held:
+                        if h != ln:
+                            fn.edges.append((h, ln, child.lineno))
+                visit(child, held + tuple(names))
+                continue
+            if isinstance(child, ast.Call) and held:
+                fn.calls_under.append((held[-1], child))
+            visit(child, held)
+
+    visit(fn.node, ())
+
+
+def _index_package(
+    package_dir: str, root: str
+) -> tuple[list[_Func], _ClassMap, list[dict]]:
+    classes = _ClassMap()
+    funcs: list[_Func] = []
+    creation_sites: list[dict] = []
+    trees: list[tuple[str, ast.AST]] = []
+    # excludes=(): the only caller-visible roots are the package dir (no
+    # fixture paths inside) and explicit fixture files in tests
+    for path in iter_python_files([package_dir], excludes=()):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue  # graftcheck: ignore[silent-except] — syntax errors are rule findings, not lockcheck's job
+        trees.append((rel, tree))
+        classes.add_module(tree)
+    for rel, tree in trees:
+        parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                dn = dotted_name(node.func)
+                if dn in ("lockdep.lock", "lockdep.rlock") and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str
+                    ):
+                        creation_sites.append({
+                            "name": arg.value,
+                            "module": rel,
+                            "kind": "rlock" if dn.endswith("rlock") else "lock",
+                            "line": node.lineno,
+                        })
+            if not isinstance(node, _FuncDef):
+                continue
+            cls = None
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, ast.ClassDef):
+                    cls = cur.name
+                    break
+                cur = parents.get(cur)
+            funcs.append(_Func(module=rel, cls=cls, name=node.name, node=node))
+    for fn in funcs:
+        _walk_function(fn, classes)
+    return funcs, classes, creation_sites
+
+
+def _callee_candidates(
+    call: ast.Call, caller: _Func, funcs_by_name: dict[str, list[_Func]],
+    classes: _ClassMap,
+) -> list[_Func]:
+    dn = dotted_name(call.func)
+    if dn is None:
+        return []
+    parts = dn.split(".")
+    name = parts[-1]
+    cands = [f for f in funcs_by_name.get(name, []) if f.acquires]
+    if not cands:
+        return []
+    if len(parts) == 1:
+        # bare call: same-module function (module-level or same class)
+        return [
+            f for f in cands
+            if f.module == caller.module and f.cls in (None, caller.cls)
+        ]
+    recv = parts[-2]
+    if recv == "self" and len(parts) == 2 and caller.cls is not None:
+        return [
+            f for f in cands
+            if f.cls and (
+                classes.is_a(caller.cls, f.cls)
+                or classes.is_a(f.cls, caller.cls)
+            )
+        ]
+    # attribute call on another object: require receiver-name affinity,
+    # always for _COMMON_METHODS, and even for rarer names (cheap and
+    # kills false edges from coincidental method names)
+    return [f for f in cands if f.cls and _hint_matches(recv, f.cls)]
+
+
+# --------------------------------------------------------------------------
+# The report
+# --------------------------------------------------------------------------
+
+
+def _find_cycles(edges: dict[tuple[str, str], list[str]]) -> list[list[str]]:
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: list[list[str]] = []
+    seen_cycles: set[tuple[str, ...]] = set()
+
+    def dfs(node: str, stack: list[str], on_stack: set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                # canonicalize rotation so each cycle reports once
+                body = cyc[:-1]
+                i = body.index(min(body))
+                canon = tuple(body[i:] + body[:i])
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(list(canon) + [canon[0]])
+                continue
+            if any(nxt == s for s in stack):
+                continue
+            stack.append(nxt)
+            on_stack.add(nxt)
+            dfs(nxt, stack, on_stack)
+            on_stack.discard(nxt)
+            stack.pop()
+
+    for start in sorted(graph):
+        dfs(start, [start], {start})
+    return cycles
+
+
+def _check_inventory(creation_sites: list[dict]) -> list[dict]:
+    drift: list[dict] = []
+    decls = locknames.by_name()
+    seen: dict[str, dict] = {}
+    for site in creation_sites:
+        d = decls.get(site["name"])
+        if d is None:
+            drift.append({
+                "diagnostic": "undeclared-lock",
+                "detail": f"{site['module']}:{site['line']} creates "
+                f"lockdep.{site['kind']}({site['name']!r}) with no "
+                f"LockDecl in analysis/locknames.py",
+            })
+            continue
+        if d.module != site["module"] or d.kind != site["kind"]:
+            drift.append({
+                "diagnostic": "lock-inventory-drift",
+                "detail": f"{site['name']!r} declared as {d.kind} in "
+                f"{d.module} but created as {site['kind']} in "
+                f"{site['module']}:{site['line']}",
+            })
+        seen[site["name"]] = site
+    for name, d in decls.items():
+        if name not in seen:
+            drift.append({
+                "diagnostic": "lock-inventory-drift",
+                "detail": f"{name!r} declared in locknames.py but no "
+                f"lockdep.{d.kind}({name!r}) creation site exists "
+                f"(expected in {d.module})",
+            })
+    return drift
+
+
+def build_edges(
+    funcs: list[_Func], classes: _ClassMap
+) -> dict[tuple[str, str], list[str]]:
+    """(src, dst) → example sites, from direct nesting plus the one-hop
+    interprocedural closure over calls made while a lock is held."""
+    funcs_by_name: dict[str, list[_Func]] = {}
+    for f in funcs:
+        funcs_by_name.setdefault(f.name, []).append(f)
+
+    edges: dict[tuple[str, str], list[str]] = {}
+
+    def add_edge(a: str, b: str, site: str) -> None:
+        if a == b:
+            return
+        edges.setdefault((a, b), [])
+        if len(edges[(a, b)]) < 4 and site not in edges[(a, b)]:
+            edges[(a, b)].append(site)
+
+    for fn in funcs:
+        where = f"{fn.module}:{fn.cls + '.' if fn.cls else ''}{fn.name}"
+        for a, b, line in fn.edges:
+            add_edge(a, b, f"{where}:{line} (nested with)")
+        for held, call in fn.calls_under:
+            for cand in _callee_candidates(call, fn, funcs_by_name, classes):
+                for acq, _line in cand.acquires:
+                    add_edge(
+                        held, acq,
+                        f"{where}:{call.lineno} -> "
+                        f"{cand.cls + '.' if cand.cls else ''}{cand.name}",
+                    )
+    return edges
+
+
+def build_lock_report(
+    root: str | None = None, package_dir: str | None = None
+) -> dict:
+    """The whole-package lock-order report: edges (with sites), cycles,
+    inventory drift, and the lock inventory itself. ``package_dir``
+    overrides the scanned tree (fixture tests); inventory drift is only
+    meaningful for the real package and is skipped for overrides."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+    is_fixture = package_dir is not None
+    if package_dir is None:
+        package_dir = os.path.join(root, "fraud_detection_tpu")
+    funcs, classes, creation_sites = _index_package(package_dir, root)
+    edges = build_edges(funcs, classes)
+    cycles = _find_cycles(edges)
+    drift = [] if is_fixture else _check_inventory(creation_sites)
+    return {
+        "locks": [
+            {
+                "name": d.name, "module": d.module, "cls": d.cls,
+                "attr": d.attr, "kind": d.kind, "purpose": d.purpose,
+            }
+            for d in locknames.LOCKS
+        ],
+        "edges": [
+            {"src": a, "dst": b, "sites": sites}
+            for (a, b), sites in sorted(edges.items())
+        ],
+        "cycles": [" -> ".join(c) for c in cycles],
+        "inventory_drift": drift,
+        "ok": not cycles and not drift,
+    }
+
+
+def violation_keys(report: dict) -> list[str]:
+    """Stable baseline keys: one per cycle, one per drift entry."""
+    keys = [f"lock-cycle:{c}" for c in report["cycles"]]
+    keys.extend(
+        f"{d['diagnostic']}:{d['detail'].split(' ', 1)[0]}"
+        for d in report["inventory_drift"]
+    )
+    return keys
+
+
+# --------------------------------------------------------------------------
+# graftcheck rules (per-module; suppressions + baseline apply)
+# --------------------------------------------------------------------------
+
+
+def _module_classes(mod: ModuleInfo) -> _ClassMap:
+    cm = _ClassMap()
+    cm.add_module(mod.tree)
+    return cm
+
+
+def _blocking_call(node: ast.Call) -> str | None:
+    dn = dotted_name(node.func)
+    if dn is None:
+        return None
+    for suffix in BLOCKING_SUFFIXES:
+        if suffix.startswith("."):
+            if dn.endswith(suffix) and dn != suffix.lstrip("."):
+                return dn
+        elif dn == suffix or dn.endswith("." + suffix):
+            return dn
+    return None
+
+
+def _directly_blocking_functions(mod: ModuleInfo) -> dict[str, str]:
+    """function name -> the blocking op it performs (same-module one-hop
+    closure for blocking-under-lock: ``_sync_locked`` fsyncs, so calling
+    it under a lock is flagged at the call site)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, _FuncDef):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                op = _blocking_call(sub)
+                if op is not None:
+                    out[node.name] = op
+                    break
+    return out
+
+
+@register_rule(
+    "blocking-under-lock",
+    Severity.WARNING,
+    "blocking operation (fsync/socket/sleep/device-sync) while holding a "
+    "named lock — every hit is a latency cliff for every other thread "
+    "queued on that lock; fix it or sanction it with an ignore tag",
+)
+def check_blocking_under_lock(mod: ModuleInfo):
+    classes = _module_classes(mod)
+    blocking_fns = _directly_blocking_functions(mod)
+
+    def enclosing_class(node: ast.AST) -> str | None:
+        cur = mod.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = mod.parents.get(cur)
+        return None
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        held = None
+        for item in node.items:
+            held = resolve_lock_name(
+                item.context_expr, enclosing_class(node), classes
+            )
+            if held is not None:
+                break
+        if held is None:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, _FuncDef):
+                continue  # a def under a lock doesn't run under it
+            if not isinstance(sub, ast.Call):
+                continue
+            op = _blocking_call(sub)
+            if op is not None:
+                yield mod.finding(
+                    check_blocking_under_lock.rule, sub,
+                    f"{op}() while holding {held!r}",
+                )
+                continue
+            dn = dotted_name(sub.func)
+            if dn is None:
+                continue
+            callee = dn.split(".")[-1]
+            via = blocking_fns.get(callee)
+            if via is not None and dn in (callee, f"self.{callee}"):
+                yield mod.finding(
+                    check_blocking_under_lock.rule, sub,
+                    f"{callee}() blocks ({via}) and is called while "
+                    f"holding {held!r}",
+                )
+
+
+@register_rule(
+    "lock-in-jit",
+    Severity.ERROR,
+    "threading primitive inside a jit-compiled function — locks don't "
+    "trace: at best they fire once at trace time, at worst they capture "
+    "trace-time state into the compiled program",
+)
+def check_lock_in_jit(mod: ModuleInfo):
+    classes = _module_classes(mod)
+    for node in ast.walk(mod.tree):
+        if not mod.in_jit_context(node):
+            continue
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn in _THREADING_PRIMITIVES or (
+                dn is not None
+                and dn.split(".")[0] == "threading"
+                and len(dn.split(".")) == 2
+            ):
+                yield mod.finding(
+                    check_lock_in_jit.rule, node,
+                    f"{dn}() created inside a traced body",
+                )
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ln = resolve_lock_name(item.context_expr, None, classes)
+                if ln is not None:
+                    yield mod.finding(
+                        check_lock_in_jit.rule, node,
+                        f"named lock {ln!r} acquired inside a traced body "
+                        "(runs at trace time, not per call)",
+                    )

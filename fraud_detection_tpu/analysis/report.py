@@ -19,6 +19,10 @@ def render_text(
     result: BaselineResult,
     mesh_results: list[dict] | None = None,
     verbose: bool = False,
+    contract_results: list[dict] | None = None,
+    contract_new: list[str] | None = None,
+    lock_report: dict | None = None,
+    lock_new: list[str] | None = None,
 ) -> str:
     lines: list[str] = []
     for f in result.new:
@@ -40,6 +44,36 @@ def render_text(
                     f"meshcheck: ok [{r['entrypoint']}] mesh size "
                     f"{r['mesh_size']} ({r['out']})"
                 )
+    if contract_results is not None:
+        covered = {k for k in (contract_new or [])}
+        for r in contract_results:
+            for v in r["violations"]:
+                key = f"{r['entrypoint']}:{v['diagnostic']}"
+                tag = "error" if key in covered else "baselined"
+                lines.append(
+                    f"contracts: {tag} [{r['entrypoint']}] "
+                    f"{v['diagnostic']}: {v['detail']}"
+                )
+            if r["ok"] and verbose:
+                lines.append(
+                    f"contracts: ok [{r['entrypoint']}] mesh "
+                    f"{r['mesh_size']}"
+                )
+    if lock_report is not None:
+        new_keys = set(lock_new or [])
+        for cyc in lock_report["cycles"]:
+            tag = "error" if f"lock-cycle:{cyc}" in new_keys else "baselined"
+            lines.append(f"lockcheck: {tag} acquisition cycle: {cyc}")
+        for d in lock_report["inventory_drift"]:
+            lines.append(
+                f"lockcheck: error [{d['diagnostic']}] {d['detail']}"
+            )
+        if verbose:
+            for e in lock_report["edges"]:
+                lines.append(
+                    f"lockcheck: edge {e['src']} -> {e['dst']} "
+                    f"({e['sites'][0]})"
+                )
     n_mesh_fail = sum(1 for r in (mesh_results or []) if not r["ok"])
     summary = (
         f"graftcheck: {len(result.new)} finding(s), "
@@ -52,6 +86,17 @@ def render_text(
             f"; mesh verification: {len(mesh_results) - n_mesh_fail}/"
             f"{len(mesh_results)} checks passed"
         )
+    if contract_results is not None:
+        n_ok = sum(1 for r in contract_results if r["ok"])
+        summary += (
+            f"; contracts: {n_ok}/{len(contract_results)} entrypoints hold"
+        )
+    if lock_report is not None:
+        summary += (
+            f"; lockcheck: {len(lock_report['edges'])} order edge(s), "
+            f"{len(lock_report['cycles'])} cycle(s), "
+            f"{len(lock_report['inventory_drift'])} drift"
+        )
     lines.append(summary)
     if result.stale and verbose:
         for e in result.stale:
@@ -63,7 +108,12 @@ def render_text(
 
 
 def render_json(
-    result: BaselineResult, mesh_results: list[dict] | None = None
+    result: BaselineResult,
+    mesh_results: list[dict] | None = None,
+    contract_results: list[dict] | None = None,
+    contract_new: list[str] | None = None,
+    lock_report: dict | None = None,
+    lock_new: list[str] | None = None,
 ) -> str:
     doc: dict[str, Any] = {
         "findings": [f.to_dict() for f in result.new],
@@ -88,6 +138,17 @@ def render_json(
         doc["summary"]["mesh_failures"] = sum(
             1 for r in mesh_results if not r["ok"]
         )
+    if contract_results is not None:
+        doc["contracts"] = contract_results
+        doc["summary"]["contract_violations"] = sum(
+            len(r["violations"]) for r in contract_results
+        )
+        doc["summary"]["contract_new"] = list(contract_new or [])
+    if lock_report is not None:
+        doc["lockcheck"] = lock_report
+        doc["summary"]["lock_cycles"] = len(lock_report["cycles"])
+        doc["summary"]["lock_drift"] = len(lock_report["inventory_drift"])
+        doc["summary"]["lock_new"] = list(lock_new or [])
     return json.dumps(doc, indent=2)
 
 
@@ -95,11 +156,16 @@ def exit_code(
     result: BaselineResult,
     mesh_results: list[dict] | None = None,
     fail_on: Severity = Severity.INFO,
+    contract_new: list[str] | None = None,
+    lock_new: list[str] | None = None,
 ) -> int:
-    """1 when any non-baselined finding at/above ``fail_on`` exists or any
-    mesh verification failed, else 0."""
+    """1 when any non-baselined finding at/above ``fail_on`` exists, any
+    mesh verification failed, or any non-baselined contract/lock violation
+    exists, else 0."""
     if any(f.severity >= fail_on for f in result.new):
         return 1
     if mesh_results and any(not r["ok"] for r in mesh_results):
+        return 1
+    if contract_new or lock_new:
         return 1
     return 0

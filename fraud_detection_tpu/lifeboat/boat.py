@@ -44,6 +44,7 @@ from fraud_detection_tpu.lifeboat import recovery as recovery_mod
 from fraud_detection_tpu.lifeboat import snapshot as snapshot_mod
 from fraud_detection_tpu.range.faults import fire
 from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.utils import lockdep
 
 log = logging.getLogger("fraud_detection_tpu.lifeboat")
 
@@ -90,7 +91,7 @@ class Lifeboat:
         #: {table+window read → seq capture → rotate} on the snapshot path:
         #: both sides hold it, so a snapshot cut can never split a flush
         #: from its journal record
-        self.flush_lock = threading.Lock()
+        self.flush_lock = lockdep.lock("lifeboat.flush")
         self.journal: journal_mod.Journal | None = None
         self.last_report: recovery_mod.RecoveryReport | None = None
         self._flushes_since_snapshot = 0

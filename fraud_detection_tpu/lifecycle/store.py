@@ -45,6 +45,7 @@ import numpy as np
 
 from fraud_detection_tpu import config
 from fraud_detection_tpu.range.faults import fire
+from fraud_detection_tpu.utils import lockdep
 
 log = logging.getLogger("fraud_detection_tpu.lifecycle")
 
@@ -139,7 +140,7 @@ class LifecycleStore:
             else config.conductor_reservoir_size()
         )
         self._rng = np.random.default_rng(seed)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("lifecycle.store")
         self._connect()
         with self._lock, self._conn:
             for stmt in _SCHEMA:

@@ -27,6 +27,7 @@ import numpy as np
 
 from fraud_detection_tpu import config
 from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.utils import lockdep
 
 log = logging.getLogger("fraud_detection_tpu.lifecycle")
 
@@ -172,7 +173,7 @@ class ModelReloader:
         self._thread: threading.Thread | None = None
         # check_once can be driven concurrently by the poll thread and
         # /admin/reload — serialize so two loads can't interleave swaps
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("lifecycle.reloader")
         metrics.lifecycle_active_model_version.set(slot.version or 0)
 
     # -- registry probes ---------------------------------------------------

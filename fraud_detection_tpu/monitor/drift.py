@@ -45,6 +45,7 @@ from fraud_detection_tpu.monitor.baseline import (
     score_histogram,
 )
 from fraud_detection_tpu.ops.scorer import _bucket, _raw_score_linear
+from fraud_detection_tpu.utils import lockdep
 
 PSI_EPS = 1e-4
 N_CALIB_BINS = 10
@@ -700,7 +701,7 @@ class DriftMonitor:
         # just-invalidated arrays to _drift_stats and crash the scrape.
         # Both paths are cheap (one dispatch / a small host sync), so one
         # lock serializes them.
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("drift.window")
 
     def _decay_for(self, n: int) -> jax.Array:
         decay = self._decay_cache.get(n)

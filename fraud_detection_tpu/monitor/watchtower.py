@@ -39,6 +39,7 @@ from fraud_detection_tpu.monitor.baseline import BaselineProfile, load_profile
 from fraud_detection_tpu.monitor.drift import DriftMonitor
 from fraud_detection_tpu.monitor.shadow import ShadowScorer
 from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.utils import lockdep
 
 log = logging.getLogger("fraud_detection_tpu.watchtower")
 
@@ -171,7 +172,7 @@ class Watchtower:
         # a /metrics scrape and a /monitor/status call can evaluate status()
         # concurrently (separate to_thread workers) — the latch check/set
         # must be atomic or one episode enqueues duplicate retrain tasks
-        self._retrain_lock = threading.Lock()
+        self._retrain_lock = lockdep.lock("watchtower.retrain")
         # Bounded handoff queue + ONE daemon ingest thread, not a thread
         # pool: put_nowait is ~2µs with no per-call Future allocation — the
         # observe() hook is the only monitoring cost the request path ever

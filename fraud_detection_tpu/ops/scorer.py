@@ -28,6 +28,7 @@ import numpy as np
 from fraud_detection_tpu.ops.logistic import LogisticParams
 from fraud_detection_tpu.ops.quant import QuantCalibration, derive_calibration
 from fraud_detection_tpu.ops.scaler import ScalerParams
+from fraud_detection_tpu.utils import lockdep
 
 
 def fold_scaler_into_linear(
@@ -313,7 +314,7 @@ class StagingPool:
         self.n_features = n_features
         self.io_dtype = io_dtype
         self._free: dict[int, list[_StagingSlot]] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("staging.pool")
         self.allocations = 0
 
     def acquire(self, bucket: int) -> _StagingSlot:

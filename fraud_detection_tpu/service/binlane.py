@@ -88,6 +88,7 @@ from fraud_detection_tpu.service.errors import ProtocolError
 from fraud_detection_tpu.service.microbatch import AdmissionFull, IngestBlock
 from fraud_detection_tpu.service.wire import _HDR, StalledPeerError
 from fraud_detection_tpu.service import tracing
+from fraud_detection_tpu.utils import lockdep
 from fraud_detection_tpu.telemetry import slo
 from fraud_detection_tpu.telemetry.timeline import RequestTimeline
 
@@ -635,7 +636,7 @@ class BinaryIngestServer:
         self._accept_thread: threading.Thread | None = None
         self._conns: set[socket.socket] = set()
         self._threads: set[threading.Thread] = set()
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("binlane.server")
         self._stopping = False
         self._c_req = metrics.ingest_requests.labels("binary")
         self._c_rows = metrics.ingest_rows.labels("binary")

@@ -57,6 +57,7 @@ from fraud_detection_tpu import config
 
 from fraud_detection_tpu.service.db import SqliteResultsDB
 from fraud_detection_tpu.service.taskq import DEFAULT_MAX_RETRIES, SqliteBroker
+from fraud_detection_tpu.utils import lockdep
 from fraud_detection_tpu.service.wire import (
     AUTH_REJECTION,
     CONN_STALL_TIMEOUT,
@@ -128,14 +129,14 @@ class StoreServer:
         # RLock: writes capture their row image and publish under the same
         # critical section (_dispatch → _publish), so a slower writer can't
         # publish an older row image with a newer seq (replica staleness).
-        self._pub_lock = threading.RLock()
+        self._pub_lock = lockdep.rlock("netstore.pub")
         self._subs: list[queue.Queue] = []
         self._last_state_save = 0.0
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._listener: socket.socket | None = None
         self._conns: set[socket.socket] = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = lockdep.lock("netstore.conns")
 
     # -- durable state -----------------------------------------------------
     def _state_path(self) -> str:
@@ -349,7 +350,7 @@ class StoreServer:
                 # and the durable write makes the promotion survive a full
                 # tier restart.
                 self.epoch += 1
-                self._save_state()
+                self._save_state()  # graftcheck: ignore[blocking-under-lock] -- promotion must be durable before any write observes PRIMARY
             log.warning("PROMOTED to primary (seq %d, epoch %d)", self.seq, self.epoch)
             return {"role": self.role}
         if op == "demote":
@@ -367,7 +368,7 @@ class StoreServer:
                 self.role = REPLICA
                 self.repl_gen += 1
                 gen = self.repl_gen
-                self._save_state()
+                self._save_state()  # graftcheck: ignore[blocking-under-lock] -- demotion durable before releasing writers, or a crash resurrects a stale primary
             log.warning(
                 "DEMOTED/re-pointed to replica of %s (was %s, seq %d)",
                 self.replicate_from, was, self.seq,
@@ -562,7 +563,7 @@ class StoreServer:
                                 self.seq = msg["seq"]
                                 if up_epoch != self.epoch:
                                     self.epoch = up_epoch
-                                self._save_state()
+                                self._save_state()  # graftcheck: ignore[blocking-under-lock] -- resync state durable atomically with the replaced rows
                             log.info(
                                 "replica synced: %d results, %d tasks "
                                 "(seq %d, epoch %d)",

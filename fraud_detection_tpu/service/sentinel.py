@@ -34,6 +34,7 @@ import time
 from typing import Any
 
 from fraud_detection_tpu import config
+from fraud_detection_tpu.utils import lockdep
 from fraud_detection_tpu.service.wire import (
     AUTH_REJECTION,
     CONN_STALL_TIMEOUT,
@@ -95,7 +96,7 @@ class Sentinel:
         self._started = time.time()
         self._last_ok: dict[Endpoint, float] = {}
         self._last_info: dict[Endpoint, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("sentinel.conns")
         self._stop = threading.Event()
         self._listener: socket.socket | None = None
 

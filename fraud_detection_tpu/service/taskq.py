@@ -35,6 +35,7 @@ from typing import Any
 from fraud_detection_tpu import config
 from fraud_detection_tpu.range.faults import fire, patched
 from fraud_detection_tpu.service import metrics
+from fraud_detection_tpu.utils import lockdep
 
 log = logging.getLogger("fraud_detection_tpu.taskq")
 
@@ -67,7 +68,7 @@ class SqliteBroker:
         path = _path(self.url)
         if path != ":memory:" and os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("taskq.broker")
         # Per-instance delivery-anomaly counters, mirrored into the shared
         # Prometheus registry: the netserver's module-local exporter reads
         # these via set_function (counters can't), and chaos scenarios

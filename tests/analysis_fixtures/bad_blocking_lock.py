@@ -1,0 +1,33 @@
+"""Fixture: blocking operations under a held named lock the
+blocking-under-lock rule must flag — direct syscalls and the one-hop
+same-module helper shape (``_sync_locked``-style)."""
+
+import os
+import time
+
+
+class Journal:
+    def __init__(self, f, sock):
+        self._lock = object()
+        self._f = f
+        self._sock = sock
+
+    def _sync_locked(self):
+        os.fsync(self._f.fileno())
+
+    def append(self, rec):
+        with self._lock:
+            self._f.write(rec)
+            self._sync_locked()  # BAD: fsync via helper under the lock
+
+    def direct(self):
+        with self._lock:
+            os.fsync(self._f.fileno())  # BAD: fsync under the lock
+
+    def chatty(self, payload):
+        with self._lock:
+            self._sock.sendall(payload)  # BAD: socket I/O under the lock
+
+    def lazy(self):
+        with self._lock:
+            time.sleep(0.1)  # BAD: sleep under the lock

@@ -1,0 +1,20 @@
+"""Fixture: threading primitives inside traced bodies the lock-in-jit rule
+must flag — they fire once at trace time, not per call."""
+
+import threading
+from functools import partial
+
+import jax
+
+
+@jax.jit
+def guarded_score(x):
+    lock = threading.Lock()  # BAD: created inside a traced body
+    with lock:
+        return x * 2.0
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def flush(win, x):
+    with boat.flush_lock:  # BAD: named lock acquired in a traced body
+        return win + x

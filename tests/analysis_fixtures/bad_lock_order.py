@@ -1,0 +1,29 @@
+"""Fixture: an ABBA lock-order cycle lockcheck's graph pass must detect.
+
+The class/attribute names deliberately mirror the real inventory
+(analysis/locknames.py) so the resolver binds them to canonical names:
+``snapshot`` takes lifeboat.flush → lifeboat.journal while ``rotate``
+takes lifeboat.journal → lifeboat.flush — a deadlock under timing.
+"""
+
+
+class Journal:
+    def __init__(self, boat):
+        self._lock = object()
+        self.boat = boat
+
+    def rotate(self):
+        with self._lock:  # lifeboat.journal
+            with self.boat.flush_lock:  # BAD: reverse of snapshot's order
+                pass
+
+
+class Lifeboat:
+    def __init__(self, journal):
+        self.flush_lock = object()
+        self.journal = journal
+
+    def snapshot(self):
+        with self.flush_lock:  # lifeboat.flush
+            with self.journal._lock:  # lifeboat.flush -> lifeboat.journal
+                pass

@@ -1,0 +1,33 @@
+"""Fixture: the clean shapes blocking-under-lock must NOT flag — blocking
+work moved outside the critical section, sanctioned design points tagged,
+and blocking calls under unnamed (unregistered) locks ignored."""
+
+import os
+import time
+
+
+class Journal:
+    def __init__(self, f):
+        self._lock = object()
+        self._f = f
+
+    def _sync_locked(self):
+        os.fsync(self._f.fileno())
+
+    def append(self, rec):
+        with self._lock:
+            self._f.write(rec)  # buffered write: fine
+        self._sync_locked()  # blocking AFTER the lock is released
+
+    def group_commit(self):
+        with self._lock:
+            self._sync_locked()  # graftcheck: ignore[blocking-under-lock] -- reviewed: the fsync IS the critical section
+
+
+class Unregistered:
+    def __init__(self):
+        self._mutex = object()  # not a named lock: rule stays silent
+
+    def work(self):
+        with self._mutex:
+            time.sleep(0.1)

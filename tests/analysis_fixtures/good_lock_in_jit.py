@@ -1,0 +1,20 @@
+"""Fixture: the clean shape — the lock guards the dispatch *around* the
+traced body; nothing threading-shaped inside it."""
+
+import threading
+
+import jax
+
+
+@jax.jit
+def score(x):
+    return x * 2.0
+
+
+class Scorer:
+    def __init__(self):
+        self._lock = threading.Lock()  # created OUTSIDE any traced body
+
+    def flush(self, x):
+        with self._lock:
+            return score(x)  # lock wraps the dispatch, not the trace

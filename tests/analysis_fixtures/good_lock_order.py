@@ -1,0 +1,26 @@
+"""Fixture: same locks as bad_lock_order.py, consistent outer→inner order
+everywhere (the canonical lifeboat.flush → lifeboat.journal) — no cycle."""
+
+
+class Journal:
+    def __init__(self):
+        self._lock = object()
+
+    def rotate(self):
+        with self._lock:  # lifeboat.journal held alone: leaf discipline
+            pass
+
+
+class Lifeboat:
+    def __init__(self, journal):
+        self.flush_lock = object()
+        self.journal = journal
+
+    def snapshot(self):
+        with self.flush_lock:
+            with self.journal._lock:  # canonical order, both sites
+                pass
+
+    def flush(self):
+        with self.flush_lock:
+            self.journal.rotate()  # one-hop: same canonical edge

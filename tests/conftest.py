@@ -17,6 +17,11 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("DEVICE", "cpu")
+# Runtime lock-order witness (utils/lockdep.py): every named lock in the
+# suite records cross-thread acquisition orders and fails fast on an ABBA
+# inversion — the chaos scenarios' kill/stall schedules double as race
+# probes. Opt out per-run with LOCKDEP=0.
+os.environ.setdefault("LOCKDEP", "1")
 
 import jax  # noqa: E402
 

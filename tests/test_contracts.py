@@ -1,0 +1,268 @@
+"""Contract prover (analysis/contracts.py): the violation fixtures.
+
+The gate test asserts the real registry holds; these tests assert the
+prover *catches* — each deliberately broken fixture entrypoint must fail
+with the right named diagnostic, because a prover that never fires is
+indistinguishable from one that doesn't work.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from fraud_detection_tpu.analysis import contracts, meshcheck
+
+
+def _diag_set(res):
+    return {v["diagnostic"] for v in res["violations"]}
+
+
+def _ep(name, build, mesh_sizes=(8,)):
+    return meshcheck.Entrypoint(name=name, build=build, mesh_sizes=mesh_sizes)
+
+
+def _psum_build(mesh):
+    fn = shard_map(
+        lambda x: jax.lax.psum(x, "data"),
+        mesh=mesh,
+        in_specs=P("data"),
+        out_specs=P(),
+    )
+    return fn, (meshcheck.sds((8, 4), jnp.float32),)
+
+
+# -- collective budget ------------------------------------------------------
+
+
+def test_smuggled_psum_is_caught():
+    """A zero-collective contract over a program that psums: the exact
+    failure mode of a refactor adding a collective to a serving flush."""
+    ep = _ep("fixture.smuggled", _psum_build)
+    con = contracts.Contract("fixture.smuggled", collectives={})
+    res = contracts.check_contract(con, ep=ep)
+    assert not res["ok"]
+    assert _diag_set(res) == {"undeclared-collective"}
+    assert "psum" in res["violations"][0]["detail"]
+
+
+def test_collective_count_mismatch_is_caught():
+    def build(mesh):
+        fn = shard_map(
+            lambda x: jax.lax.psum(x, "data") + jax.lax.psum(x * 2, "data"),
+            mesh=mesh, in_specs=P("data"), out_specs=P(),
+        )
+        return fn, (meshcheck.sds((8, 4), jnp.float32),)
+
+    con = contracts.Contract("fixture.twice", collectives={"psum": 1})
+    res = contracts.check_contract(con, ep=_ep("fixture.twice", build))
+    assert _diag_set(res) == {"collective-count"}
+    assert "allows 1, program has 2" in res["violations"][0]["detail"]
+
+
+def test_missing_collective_is_caught():
+    """The dual direction: the contract demands a psum the program dropped
+    (e.g. someone deleted the model-axis assembly and broke the math)."""
+    def build(mesh):
+        return (lambda x: x * 2.0), (meshcheck.sds((8, 4), jnp.float32),)
+
+    con = contracts.Contract("fixture.dropped", collectives={"psum": 1})
+    res = contracts.check_contract(con, ep=_ep("fixture.dropped", build))
+    assert _diag_set(res) == {"missing-collective"}
+
+
+def test_psum2_canonicalizes_to_psum():
+    """shard_map traces psum as the `psum2` primitive; the contract is
+    written against the canonical name and must still match."""
+    ep = _ep("fixture.canon", _psum_build)
+    con = contracts.Contract("fixture.canon", collectives={"psum": 1})
+    res = contracts.check_contract(con, ep=ep)
+    assert res["ok"], res["violations"]
+
+
+def test_collectives_inside_inner_jaxprs_are_found():
+    """The walker must recurse through scan bodies — a psum hidden inside
+    jax.lax.scan counts."""
+    def build(mesh):
+        def body(x):
+            def step(c, _):
+                return c + jax.lax.psum(x, "data"), None
+            out, _ = jax.lax.scan(step, jnp.zeros_like(x), None, length=3)
+            return out
+
+        # check_rep=False: the rep checker rejects a psum'd carry; the
+        # fixture only cares that the walker sees inside the scan body
+        fn = shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            check_rep=False,
+        )
+        return fn, (meshcheck.sds((8, 4), jnp.float32),)
+
+    con = contracts.Contract("fixture.scan", collectives={})
+    res = contracts.check_contract(con, ep=_ep("fixture.scan", build))
+    assert _diag_set(res) == {"undeclared-collective"}
+
+
+# -- forbidden primitives ---------------------------------------------------
+
+
+def test_host_callback_is_caught():
+    def build(mesh):
+        def fn(x):
+            jax.debug.print("score {}", x.sum())  # host round-trip
+            return x * 2.0
+
+        return fn, (meshcheck.sds((8, 4), jnp.float32),)
+
+    con = contracts.Contract("fixture.callback")
+    res = contracts.check_contract(con, ep=_ep("fixture.callback", build))
+    assert "forbidden-primitive" in _diag_set(res)
+
+
+def test_io_callback_is_caught():
+    from jax.experimental import io_callback
+
+    def build(mesh):
+        def fn(x):
+            io_callback(
+                lambda v: None, None, x, ordered=True
+            )
+            return x * 2.0
+
+        return fn, (meshcheck.sds((8, 4), jnp.float32),)
+
+    con = contracts.Contract("fixture.io")
+    res = contracts.check_contract(con, ep=_ep("fixture.io", build))
+    assert "forbidden-primitive" in _diag_set(res)
+
+
+# -- donation ---------------------------------------------------------------
+
+
+def test_unimplementable_donation_is_caught():
+    """Donating a buffer with no identically shaped/dtyped output to alias
+    silently degrades to a copy — the contract calls it out."""
+    def build(mesh):
+        def fn(win, x):
+            return x.sum()  # win donated but nothing to alias it with
+
+        return fn, (
+            meshcheck.sds((64, 64), jnp.float32),
+            meshcheck.sds((8, 4), jnp.float32),
+        )
+
+    con = contracts.Contract("fixture.donate", donate=(0,))
+    res = contracts.check_contract(con, ep=_ep("fixture.donate", build))
+    assert "donation-unimplementable" in _diag_set(res)
+
+
+def test_feasible_donation_passes():
+    def build(mesh):
+        def fn(win, x):
+            return win + 1.0, x.sum()
+
+        return fn, (
+            meshcheck.sds((64, 64), jnp.float32),
+            meshcheck.sds((8, 4), jnp.float32),
+        )
+
+    con = contracts.Contract("fixture.donate_ok", donate=(0,))
+    res = contracts.check_contract(con, ep=_ep("fixture.donate_ok", build))
+    assert res["ok"], res["violations"]
+
+
+def test_donate_site_drift_is_caught(tmp_path):
+    """The AST half: the real serving jit site must still declare the
+    contracted donate_argnums — a refactor that drops them is caught even
+    though the meshcheck builder wraps the raw body."""
+    mod = tmp_path / "site.py"
+    mod.write_text(
+        "from functools import partial\nimport jax\n\n"
+        "@partial(jax.jit, donate_argnums=(1,))\n"
+        "def flush(win, x):\n    return win, x\n"
+    )
+
+    def build(mesh):
+        def fn(win):
+            return win + 1.0
+
+        return fn, (meshcheck.sds((64,), jnp.float32),)
+
+    con = contracts.Contract(
+        "fixture.site",
+        donate=(0,),
+        donate_site=contracts.DonateSite("site.py", "flush", (0,)),
+    )
+    res = contracts.check_contract(
+        con, ep=_ep("fixture.site", build), root=str(tmp_path)
+    )
+    assert "donate-site-drift" in _diag_set(res)
+    # matching declaration: clean
+    mod.write_text(
+        "from functools import partial\nimport jax\n\n"
+        "@partial(jax.jit, donate_argnums=(0,))\n"
+        "def flush(win, x):\n    return win, x\n"
+    )
+    res = contracts.check_contract(
+        con, ep=_ep("fixture.site", build), root=str(tmp_path)
+    )
+    assert res["ok"], res["violations"]
+
+
+# -- output dtypes ----------------------------------------------------------
+
+
+def test_output_dtype_drift_is_caught():
+    """The wire contract: a flush that starts returning float32 where the
+    transport expects uint8 codes fails with output-dtype."""
+    def build(mesh):
+        return (lambda x: x * 2.0), (meshcheck.sds((8, 4), jnp.float32),)
+
+    con = contracts.Contract("fixture.wire", out_dtypes=("uint8",))
+    res = contracts.check_contract(con, ep=_ep("fixture.wire", build))
+    assert _diag_set(res) == {"output-dtype"}
+
+
+# -- registry coverage ------------------------------------------------------
+
+
+def test_unknown_entrypoint_is_a_violation():
+    con = contracts.Contract("fixture.no_such_entrypoint")
+    res = contracts.check_contract(con)
+    assert _diag_set(res) == {"unknown-entrypoint"}
+
+
+def test_uncovered_entrypoint_is_a_violation():
+    """A meshcheck entrypoint with no contract must fail verify_contracts —
+    the contract registry is not allowed to lag the meshcheck one."""
+    name = "fixture.uncontracted"
+    meshcheck._ENTRYPOINTS[name] = _ep(
+        name, lambda mesh: ((lambda x: x), (meshcheck.sds((8,), jnp.float32),))
+    )
+    try:
+        results = contracts.verify_contracts()
+    finally:
+        del meshcheck._ENTRYPOINTS[name]
+    bad = [r for r in results if r["entrypoint"] == name]
+    assert bad and _diag_set(bad[0]) == {"uncovered-entrypoint"}
+
+
+def test_every_registered_entrypoint_has_a_contract():
+    covered = {c.entrypoint for c in contracts.iter_contracts()}
+    registered = {ep.name for ep in meshcheck.iter_entrypoints()}
+    assert registered <= covered, registered - covered
+
+
+def test_violation_keys_are_stable_strings():
+    ep = _ep("fixture.keys", _psum_build)
+    con = contracts.Contract("fixture.keys", collectives={})
+    res = contracts.check_contract(con, ep=ep)
+    assert contracts.violation_keys([res]) == [
+        "fixture.keys:undeclared-collective"
+    ]
+
+
+def test_duplicate_contract_registration_rejected():
+    with pytest.raises(ValueError):
+        contracts.register_contract(contracts.Contract("scorer.score"))

@@ -1,0 +1,220 @@
+"""Lock discipline: the static pass (analysis/lockcheck.py) and the
+runtime witness (utils/lockdep.py).
+
+Static: the fixture with an ABBA ordering must yield a cycle, the
+consistent-order fixture must not, and the two lint rules must fire on
+their bad fixtures and stay silent on the good ones. Runtime: two threads
+acquiring two named locks in opposite orders must fail fast with
+LockOrderInversion — no timing luck required, the second order is refused
+the moment it is attempted.
+"""
+
+import os
+import threading
+
+import pytest
+
+from fraud_detection_tpu.analysis import lockcheck, locknames
+from fraud_detection_tpu.analysis.core import analyze_file
+from fraud_detection_tpu.utils import lockdep
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "analysis_fixtures")
+
+
+def _fixture_report(name):
+    return lockcheck.build_lock_report(
+        root=REPO_ROOT, package_dir=os.path.join(FIXTURES, name)
+    )
+
+
+def _rule_findings(name, rule_id):
+    findings = analyze_file(
+        os.path.join(FIXTURES, name),
+        root=REPO_ROOT,
+        rules=[lockcheck.check_blocking_under_lock.rule
+               if rule_id == "blocking-under-lock"
+               else lockcheck.check_lock_in_jit.rule],
+    )
+    return [f for f in findings if f.rule_id == rule_id]
+
+
+# -- static: acquisition-order graph ---------------------------------------
+
+
+def test_cycle_fixture_is_detected():
+    rep = _fixture_report("bad_lock_order.py")
+    assert rep["cycles"] == [
+        "lifeboat.flush -> lifeboat.journal -> lifeboat.flush"
+    ]
+    assert not rep["ok"]
+    assert lockcheck.violation_keys(rep) == [
+        "lock-cycle:lifeboat.flush -> lifeboat.journal -> lifeboat.flush"
+    ]
+
+
+def test_consistent_order_fixture_is_clean():
+    rep = _fixture_report("good_lock_order.py")
+    assert rep["ok"], rep
+    # both the nested-with site and the one-hop call-site record the edge
+    (edge,) = rep["edges"]
+    assert (edge["src"], edge["dst"]) == ("lifeboat.flush", "lifeboat.journal")
+    assert any("nested with" in s for s in edge["sites"])
+    assert any("Journal.rotate" in s for s in edge["sites"])
+
+
+def test_repo_lock_graph_is_acyclic_with_canonical_edges():
+    """THE GATE (also enforced via --contracts in CI): the real package's
+    acquisition graph is acyclic, contains the two canonical serving-tier
+    edges, and the lockdep creation sites match the declared inventory."""
+    rep = lockcheck.build_lock_report(root=REPO_ROOT)
+    assert rep["cycles"] == [], rep["cycles"]
+    assert rep["inventory_drift"] == [], rep["inventory_drift"]
+    assert rep["ok"]
+    pairs = {(e["src"], e["dst"]) for e in rep["edges"]}
+    assert ("lifeboat.flush", "lifeboat.journal") in pairs
+    assert ("lifeboat.flush", "drift.window") in pairs
+
+
+def test_inventory_covers_every_declared_lock():
+    names = {d.name for d in locknames.LOCKS}
+    assert len(names) == len(locknames.LOCKS), "duplicate lock names"
+    assert {"lifeboat.flush", "lifeboat.journal", "drift.window"} <= names
+
+
+# -- static: lint rules -----------------------------------------------------
+
+
+def test_blocking_under_lock_rule_fires_on_bad_fixture():
+    findings = _rule_findings("bad_blocking_lock.py", "blocking-under-lock")
+    assert len(findings) == 4, [f.message for f in findings]
+    msgs = "\n".join(f.message for f in findings)
+    assert "os.fsync" in msgs          # direct + via _sync_locked
+    assert "_sync_locked" in msgs      # one-hop helper shape
+    assert ".sendall" in msgs or "sendall" in msgs
+    assert "time.sleep" in msgs
+
+
+def test_blocking_under_lock_rule_silent_on_good_fixture():
+    assert _rule_findings("good_blocking_lock.py", "blocking-under-lock") == []
+
+
+def test_lock_in_jit_rule_fires_on_bad_fixture():
+    findings = _rule_findings("bad_lock_in_jit.py", "lock-in-jit")
+    assert len(findings) == 2, [f.message for f in findings]
+    msgs = "\n".join(f.message for f in findings)
+    assert "threading.Lock" in msgs
+    assert "lifeboat.flush" in msgs
+
+
+def test_lock_in_jit_rule_silent_on_good_fixture():
+    assert _rule_findings("good_lock_in_jit.py", "lock-in-jit") == []
+
+
+# -- runtime witness --------------------------------------------------------
+
+
+def test_lockdep_enabled_in_suite():
+    """conftest exports LOCKDEP=1 for the whole tier-1 suite (and CI's
+    chaos job): every named lock in these tests is the witnessing kind."""
+    assert lockdep.enabled()
+    assert isinstance(lockdep.lock("test.enabled"), lockdep.LockdepLock)
+    assert isinstance(lockdep.rlock("test.enabled.r"), lockdep.LockdepRLock)
+
+
+def test_lockdep_off_returns_plain_primitives(monkeypatch):
+    monkeypatch.setenv("LOCKDEP", "0")
+    assert type(lockdep.lock("test.off")) is type(threading.Lock())
+    # RLock factory differs across impls; duck-check: not the witness type
+    assert not isinstance(lockdep.rlock("test.off.r"), lockdep.LockdepLock)
+
+
+def test_lockdep_two_inverted_threads_fail_fast():
+    """The ABBA probe: thread 1 witnesses A -> B; thread 2 attempting
+    B -> A is refused deterministically with both stacks in the error."""
+    a = lockdep.lock("test.inv.A")
+    b = lockdep.lock("test.inv.B")
+    errors = []
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def inverted():
+        try:
+            with b:
+                with a:  # reverse of the witnessed order
+                    pass
+        except lockdep.LockOrderInversion as e:
+            errors.append(e)
+
+    t1 = threading.Thread(target=forward)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=inverted)
+    t2.start()
+    t2.join()
+    assert len(errors) == 1
+    msg = str(errors[0])
+    assert "test.inv.A" in msg and "test.inv.B" in msg
+    assert "prior" in msg  # carries the first order's stack
+    # fail-fast released the partially-acquired lock: both still usable
+    assert not a.locked() and not b.locked()
+    with a:
+        with b:
+            pass
+
+
+def test_lockdep_same_order_from_many_threads_is_fine():
+    a = lockdep.lock("test.ok.A")
+    b = lockdep.lock("test.ok.B")
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(50):
+                with a:
+                    with b:
+                        pass
+        except lockdep.LockOrderInversion as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert ("test.ok.A", "test.ok.B") in lockdep.edges()
+
+
+def test_lockdep_reentrant_rlock_records_no_self_edge():
+    r = lockdep.rlock("test.re.R")
+    with r:
+        with r:  # reentrant hold: not order evidence
+            pass
+    assert all(
+        "test.re.R" not in key for key in lockdep.edges()
+        if key == ("test.re.R", "test.re.R")
+    )
+    assert not r.locked()
+
+
+def test_lockdep_witnesses_held_chain_not_just_top():
+    """Holding A and B then taking C records BOTH A->C and B->C — the
+    inversion check must cover every held lock, not only the innermost."""
+    a = lockdep.lock("test.chain.A")
+    b = lockdep.lock("test.chain.B")
+    c = lockdep.lock("test.chain.C")
+    with a:
+        with b:
+            with c:
+                pass
+    e = lockdep.edges()
+    assert ("test.chain.A", "test.chain.C") in e
+    assert ("test.chain.B", "test.chain.C") in e
+    with pytest.raises(lockdep.LockOrderInversion):
+        with c:
+            with a:
+                pass

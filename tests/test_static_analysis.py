@@ -77,6 +77,47 @@ def test_every_entrypoint_shape_verifies_at_all_mesh_sizes():
             assert sizes == [1, 2, 8], (name, sizes)
 
 
+def test_every_contract_holds_modulo_baseline():
+    """THE GATE, leg 3 (CI: ``--contracts``): every registered entrypoint's
+    declared program-structure contract — collective budget, donation,
+    forbidden host callbacks, wire dtypes — holds against the traced
+    program, modulo the ``contracts`` baseline section (empty is the
+    norm)."""
+    from fraud_detection_tpu.analysis import contracts
+
+    results = contracts.verify_contracts()
+    new, _stale = baseline_mod.apply_keys(
+        contracts.violation_keys(results),
+        baseline_mod.load_section(
+            os.path.join(REPO_ROOT, baseline_mod.DEFAULT_BASELINE),
+            "contracts",
+        ),
+    )
+    detail = {
+        r["entrypoint"]: r["violations"] for r in results if not r["ok"]
+    }
+    assert not new, f"non-baselined contract violations: {detail}"
+
+
+def test_lock_graph_acyclic_modulo_baseline():
+    """THE GATE, leg 4: the static acquisition-order graph over the named
+    locks is acyclic and the lockdep creation sites match the declared
+    inventory, modulo the ``lockcheck`` baseline section."""
+    from fraud_detection_tpu.analysis import lockcheck
+
+    rep = lockcheck.build_lock_report(root=REPO_ROOT)
+    new, _stale = baseline_mod.apply_keys(
+        lockcheck.violation_keys(rep),
+        baseline_mod.load_section(
+            os.path.join(REPO_ROOT, baseline_mod.DEFAULT_BASELINE),
+            "lockcheck",
+        ),
+    )
+    assert not new, {
+        "cycles": rep["cycles"], "drift": rep["inventory_drift"]
+    }
+
+
 def test_verifier_catches_indivisible_sharding():
     """Negative control: the verifier must FAIL a sharding that stops
     composing — 1003 rows over the data axis don't divide an 8-way mesh."""
