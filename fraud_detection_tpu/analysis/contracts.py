@@ -594,5 +594,37 @@ for _con in (
         notes="2-D: model-axis psum assembles the widened logit; data-axis "
         "grad reduction + scatter onto column owners",
     ),
+    # -- longhaul fleet MapReduce: map bodies provably collective-free,
+    # merge bodies carry the fleet's ENTIRE collective budget --------------
+    Contract(
+        "longhaul.partial_pool",
+        out_dtypes=("float32",) * 5,
+        notes="one host's pool partials (map side) — zero collectives by "
+        "construction; the reduce rides the transport (mesh psum under "
+        "jax.distributed, rank-order socket sum otherwise)",
+    ),
+    Contract(
+        "longhaul.fleet_grad",
+        out_dtypes=("float32", "float32"),
+        notes="one host's un-normalized gradient sums — zero collectives; "
+        "objective scaling happens host-side AFTER the fleet merge so "
+        "every host applies identical reduced floats",
+    ),
+    Contract(
+        "longhaul.pool_merge",
+        collectives={"psum": 5},
+        out_dtypes=("float32",) * 5,
+        notes="one psum per pool component (n, n_pos, score_sum, Σx, Σx²) "
+        "over the hosts axis; under jax.distributed this axis spans "
+        "processes and the SAME program reduces over DCN — proved here on "
+        "the single-process degenerate mesh",
+    ),
+    Contract(
+        "longhaul.grad_merge",
+        collectives={"psum": 2},
+        out_dtypes=("float32", "float32"),
+        notes="coef block + intercept: the whole per-step collective "
+        "footprint of fleet SGD (2004.13336 at host level)",
+    ),
 ):
     register_contract(_con)

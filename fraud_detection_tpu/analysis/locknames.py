@@ -15,6 +15,7 @@ on::
 
     lifeboat.flush  →  lifeboat.journal      (journal_staged / rotate)
     lifeboat.flush  →  drift.window          (snapshot cut materialization)
+    longhaul.inherit →  lifeboat.flush       (segment merge + rebind publish)
 
 Everything else is a leaf: held for short critical sections, never while
 acquiring another named lock. ``ShardFront`` health state and the
@@ -119,6 +120,23 @@ LOCKS: tuple[LockDecl, ...] = (
         "Watchtower", "_retrain_lock",
         purpose="latch check/set for retrain recommendations — concurrent "
         "status() evaluations must not enqueue duplicate retrain tasks",
+    ),
+    LockDecl(
+        "longhaul.members", "fraud_detection_tpu/longhaul/membership.py",
+        "DirectoryServer", "_members_lock",
+        purpose="one critical section per membership mutation: epoch bump "
+        "+ member-table update + durable members.json replace publish "
+        "together, so no reader ever sees a new epoch with an old view "
+        "(or vice versa)",
+    ),
+    LockDecl(
+        "longhaul.inherit", "fraud_detection_tpu/longhaul/host.py",
+        "HostServer", "_inherit_lock",
+        purpose="serializes segment inheritance on the surviving host — "
+        "state flip to INHERITING, peer journal replay, and the "
+        "merge+rebind are one take-over; acquired BEFORE lifeboat.flush "
+        "(the merge publishes under the flush lock so a snapshot cut "
+        "can't split the rebind)",
     ),
 )
 

@@ -1040,3 +1040,59 @@ def _build_scaler(mesh: Mesh):
         return scaler_transform(params, xx)
 
     return fit_transform, (x,)
+
+
+@register_entrypoint("longhaul.partial_pool")
+def _build_longhaul_partial_pool(mesh: Mesh):
+    """The fleet pool map body: one HOST's partial sums, so its inputs are
+    that host's local rows (replicated here — the body must compile with
+    ZERO collectives at every mesh size, which is exactly what makes it a
+    map body)."""
+    from fraud_detection_tpu.longhaul.fleet import _host_partial_pool
+
+    per_row = lambda: sds((_ROWS,), jnp.float32, mesh, P())  # noqa: E731
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, P())
+    return _host_partial_pool, (x, per_row(), per_row(), per_row())
+
+
+@register_entrypoint("longhaul.fleet_grad")
+def _build_longhaul_fleet_grad(mesh: Mesh):
+    """The fleet SGD map body: one host's un-normalized gradient sums —
+    zero collectives; the reduce is the transport's job."""
+    from fraud_detection_tpu.longhaul.fleet import _host_grad
+
+    coef = sds((_FEATURES,), jnp.float32, mesh, P())
+    intercept = sds((), jnp.float32, mesh, P())
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, P())
+    per_row = lambda: sds((_ROWS,), jnp.float32, mesh, P())  # noqa: E731
+    return _host_grad, (coef, intercept, x, per_row(), per_row())
+
+
+@register_entrypoint("longhaul.pool_merge")
+def _build_longhaul_pool_merge(mesh: Mesh):
+    """The fleet pool merge: per-host partials stacked on the data axis
+    (standing in for the hosts axis — under jax.distributed the same axis
+    spans processes), ONE psum per summary component."""
+    from fraud_detection_tpu.longhaul.fleet import _fleet_pool_merge
+
+    size = mesh.shape[DATA_AXIS]
+    shard = P(DATA_AXIS)
+    scalar = lambda: sds((size,), jnp.float32, mesh, shard)  # noqa: E731
+    vec = lambda: sds((size, _FEATURES), jnp.float32, mesh, shard)  # noqa: E731
+    fn = lambda n, np_, s, fx, fx2: _fleet_pool_merge(  # noqa: E731
+        n, np_, s, fx, fx2, mesh=mesh
+    )
+    return fn, (scalar(), scalar(), scalar(), vec(), vec())
+
+
+@register_entrypoint("longhaul.grad_merge")
+def _build_longhaul_grad_merge(mesh: Mesh):
+    """The fleet gradient merge: 2 psums (coef block, intercept), nothing
+    else — the whole collective footprint of one fleet SGD step."""
+    from fraud_detection_tpu.longhaul.fleet import _fleet_grad_merge
+
+    size = mesh.shape[DATA_AXIS]
+    g_coef = sds((size, _FEATURES), jnp.float32, mesh, P(DATA_AXIS))
+    g_b = sds((size,), jnp.float32, mesh, P(DATA_AXIS))
+    fn = lambda gc, gb: _fleet_grad_merge(gc, gb, mesh=mesh)  # noqa: E731
+    return fn, (g_coef, g_b)

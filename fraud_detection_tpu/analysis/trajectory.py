@@ -52,6 +52,12 @@ HEADLINES: dict[str, tuple[str, str, float]] = {
     "recovery_snapshot_overhead_frac": (
         "recovery_snapshot_overhead_frac", "lower", 0.01,
     ),
+    "multihost_replay_rows_per_sec": (
+        "multihost_replay_rows_per_sec", "higher", 0.0,
+    ),
+    # failover wall time includes a directory round-trip + socket setup —
+    # sub-second but jittery, so an absolute slack carries the noise
+    "multihost_failover_s": ("multihost_failover_s", "lower", 0.5),
 }
 
 

@@ -716,6 +716,18 @@ def lifecycle_db_url(broker: str | None = None) -> str:
     broker = broker or broker_url()
     if broker.startswith(("sqlite", "postgresql://", "postgres://")):
         return broker
+    if longhaul_hosts() > 1:
+        # A multi-host fleet silently splitting its feedback store is an
+        # outage, not a warning: every host would accumulate feedback and
+        # race the retrain/promotion latch in its OWN file, and the fleet
+        # would promote N different champions. Refuse to start.
+        raise RuntimeError(
+            "LONGHAUL_HOSTS>1 but LIFECYCLE_DB_URL is unset and broker "
+            f"{broker!r} has no SQL surface: the process-local "
+            "sqlite:///lifecycle.db fallback cannot carry feedback or the "
+            "retrain/promotion latch across hosts. Set LIFECYCLE_DB_URL "
+            "to a shared database (see README 'longhaul')."
+        )
     global _warned_local_lifecycle_db
     if not _warned_local_lifecycle_db:
         _warned_local_lifecycle_db = True
@@ -789,6 +801,64 @@ def lifecycle_retrain_stale_after() -> float:
     longest tolerable worker GC/IO stall, not above the fit duration (the
     heartbeat runs on its own thread for the whole fit)."""
     return _get_float("LIFECYCLE_RETRAIN_STALE_AFTER_S", 900.0)
+
+
+# --------------------------------------------------------------------------
+# Longhaul: the multi-host switchyard (longhaul/)
+# --------------------------------------------------------------------------
+
+
+def longhaul_hosts() -> int:
+    """Fleet geometry: the number of host segments (the outer modulus of
+    the two-level placement — ``slot mod LONGHAUL_HOSTS`` names the owning
+    host). 1 = single-host (longhaul dormant). Fixed for the life of a
+    fleet: changing it remaps every entity's owner."""
+    return _get_int("LONGHAUL_HOSTS", 1)
+
+
+def longhaul_directory() -> str:
+    """``host:port`` of the membership directory every host joins and
+    heartbeats (``LONGHAUL_DIRECTORY``)."""
+    return os.environ.get("LONGHAUL_DIRECTORY", "127.0.0.1:7300")
+
+
+def longhaul_host_id() -> str:
+    """This process's stable member identity (``LONGHAUL_HOST_ID``; rank
+    assignment is sticky per host_id across rejoins)."""
+    return os.environ.get("LONGHAUL_HOST_ID", "host-0")
+
+
+def longhaul_data_dir() -> str:
+    """Root under which each host keeps its lifeboat directory at
+    ``<root>/<host_id>`` (``LONGHAUL_DATA_DIR``). On a shared filesystem
+    this is what makes journal handoff possible: the inheritor replays
+    the dead peer's generation straight from ``<root>/<peer_id>``."""
+    return os.environ.get("LONGHAUL_DATA_DIR", "longhaul-data")
+
+
+def longhaul_heartbeat_s() -> float:
+    """Seconds between a member's heartbeats (``LONGHAUL_HEARTBEAT_S``)."""
+    return _get_float("LONGHAUL_HEARTBEAT_S", 1.0)
+
+
+def longhaul_dead_after_s() -> float:
+    """Heartbeat silence after which the directory marks a member dead and
+    bumps the membership epoch (``LONGHAUL_DEAD_AFTER_S``). Keep ≥ 3×
+    the heartbeat interval or a GC pause reads as a death."""
+    return _get_float("LONGHAUL_DEAD_AFTER_S", 3.0)
+
+
+def longhaul_retry_after_s() -> float:
+    """Retry-After hint (seconds) the front attaches to 503s while a
+    segment's owner is inheriting or no host is healthy
+    (``LONGHAUL_RETRY_AFTER_S``)."""
+    return _get_float("LONGHAUL_RETRY_AFTER_S", 1.0)
+
+
+def longhaul_probation_s() -> float:
+    """Half-open probation: seconds a DEAD host handle waits before the
+    front risks ONE probe request on it (``LONGHAUL_PROBATION_S``)."""
+    return _get_float("LONGHAUL_PROBATION_S", 2.0)
 
 
 @dataclass

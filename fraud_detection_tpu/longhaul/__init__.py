@@ -1,0 +1,48 @@
+"""longhaul — the multi-host switchyard: a cross-process serving mesh.
+
+The shard front (``mesh/front.py``) shards only within one process;
+longhaul spreads the same contracts across hosts. One keyspace, two
+moduli: an entity's ledger slot picks its owning HOST via
+``slot mod N_hosts`` (outer level, :mod:`.placement`) and its device
+shard within that host via the existing ``slot mod n_shards`` rule
+(inner level, ``ledger/placement.py``) — the two levels compose because
+both are congruences on the same slot integer.
+
+Layers (one module each):
+
+- :mod:`.membership` — the netstore-disciplined host directory:
+  heartbeats, epoch-numbered membership views, durable state.
+- :mod:`.placement` — segment ownership, ring inheritance on host
+  death, and the host-side segment merge used by failover.
+- :mod:`.front` — the routing tier: JSON / msgpack / binary frames in,
+  rows grouped per owning host (same-slot rows always travel together,
+  which is what keeps routed scores bitwise), PR-6/7 degradation
+  contracts out (503 + Retry-After, last-healthy-host protection,
+  per-host half-open probation).
+- :mod:`.host` — one serving process: wraps the micro-batcher +
+  lifeboat stack behind a framed-socket data plane, inherits a dead
+  peer's segment by replaying the peer's journal+snapshot generation
+  (``lifeboat/recovery.py`` — the bitwise-replay guarantee, segment
+  scoped), epoch-fences promotion finalization.
+- :mod:`.fleet` — the cross-host reduce: per-host partial pools, one
+  merge (the DrJAX idiom at host level); a mesh-collective path for
+  jax.distributed process meshes and a socket allreduce fallback, both
+  behind one interface and both meshcheck/contract-proven.
+- :mod:`.scrape` — fleet drift-window merge and /slo/status
+  aggregation with the epoch fence: two membership epochs never
+  double-count a host's window.
+"""
+
+from fraud_detection_tpu.longhaul.membership import (  # noqa: F401
+    DirectoryClient,
+    DirectoryServer,
+    MemberInfo,
+    MembershipView,
+)
+from fraud_detection_tpu.longhaul.placement import (  # noqa: F401
+    host_of,
+    merge_segment,
+    owned_segments,
+    segment_mask,
+    segment_owner,
+)

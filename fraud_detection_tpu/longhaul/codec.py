@@ -1,0 +1,283 @@
+"""Request/response codecs for the longhaul front tier.
+
+The front accepts the SAME scoring request in three formats and answers
+in kind:
+
+- ``json`` — ``{"rows": [[...]], "entities": [...|null], "ts": [...]}``;
+- ``msgpack`` — the identical schema, msgpack-packed (rides the
+  ``application/msgpack`` content type like the binlane HTTP fallback);
+- ``binary`` — the hyperloop frame layout (``service/binlane.py``'s
+  versioned wire contract: magic/version/layout header, little-endian
+  f32 feature block, u32 entity fingerprints, f64 timestamps), so a
+  binlane client can point at the longhaul front unchanged.
+
+Whatever the ingress format, the canonical internal form is the same
+``(rows f32[n,d], ents)`` pair the micro-batcher flushes — ``ents[i]``
+is ``(slot, fingerprint, rel_ts)`` or ``None`` — which is what keeps
+routed scores bitwise across formats: the format only changes how bytes
+arrive, never the floats that reach the fused body. Float fidelity notes:
+JSON/msgpack carry f32 values through f64, which is exact in both
+directions; the binary path ships the f32 bytes themselves.
+
+Host-to-host frames (front → owning host) use base64-packed f32 blocks
+inside the framed-JSON wire (``service/wire.py``) — bitwise-safe and
+auditable with the same tooling as the netstore protocol.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+import numpy as np
+
+from fraud_detection_tpu.ledger.state import entity_slot
+from fraud_detection_tpu.service.binlane import (
+    FLAG_ENTITY,
+    FLAG_TS,
+    LAYOUT_F32,
+    MAGIC,
+    ST_OK,
+    ST_UNAVAILABLE,
+    VERSION,
+    _ERRPAY,
+    _FRAME,
+    _RESP,
+)
+
+FORMATS = ("json", "msgpack", "binary")
+
+
+class Unavailable(Exception):
+    """The typed 503: the segment's owner is inheriting, or no healthy
+    host serves it. Always carries a Retry-After hint — the degradation
+    contract's floor (never worse than 503 + Retry-After)."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        self.retry_after_s = retry_after_s
+        super().__init__(message)
+
+
+# -- base64 array packing (host-to-host frames) ----------------------------
+
+def pack_array(arr: np.ndarray) -> dict:
+    # shape from the ORIGINAL array: ascontiguousarray promotes 0-d
+    # scalars to (1,), and a reduced scalar must come back 0-d
+    a = np.asarray(arr)
+    return {
+        "b64": base64.b64encode(
+            np.ascontiguousarray(a).tobytes()
+        ).decode("ascii"),
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+    }
+
+
+def unpack_array(d: dict) -> np.ndarray:
+    return np.frombuffer(
+        base64.b64decode(d["b64"]), dtype=np.dtype(d["dtype"])
+    ).reshape(d["shape"])
+
+
+def pack_table(state) -> dict:
+    return {
+        name: pack_array(np.asarray(getattr(state, name)))
+        for name in ("acc", "last_ts", "fingerprint", "collisions",
+                     "evictions")
+    }
+
+
+def unpack_table(d: dict):
+    from fraud_detection_tpu.ledger.state import LedgerState
+
+    return LedgerState(**{name: unpack_array(d[name]) for name in d})
+
+
+# -- the three ingress formats ---------------------------------------------
+
+def _ents_from_ids(entities, ts, spec):
+    ents = []
+    for i, ent in enumerate(entities):
+        if ent is None:
+            ents.append(None)
+        else:
+            s, fp = spec.row_keys(ent)
+            ents.append((s, fp, float(ts[i])))
+    return ents
+
+
+def decode_request(payload: bytes, fmt: str, spec):
+    """Decode one scoring request → ``(rows f32[n,d], ents)``."""
+    if fmt == "json":
+        return _decode_mapping(json.loads(payload.decode("utf-8")), spec)
+    if fmt == "msgpack":
+        import msgpack
+
+        return _decode_mapping(msgpack.unpackb(payload, raw=False), spec)
+    if fmt == "binary":
+        return _decode_binary(payload, spec)
+    raise ValueError(f"unknown request format: {fmt}")
+
+
+def _decode_mapping(obj: dict, spec):
+    rows = np.asarray(obj["rows"], np.float32)
+    if rows.ndim == 1:
+        rows = rows[None, :]
+    n = rows.shape[0]
+    entities = obj.get("entities") or [None] * n
+    ts = obj.get("ts") or [0.0] * n
+    return rows, _ents_from_ids(entities, ts, spec)
+
+
+def _decode_binary(payload: bytes, spec):
+    """The hyperloop request frame (f32 layout). Entities arrive as u32
+    fingerprints — the slot derives from the SAME multiply-shift hash the
+    JSON edge applies, so an entity keyed on any lane shares one slot
+    (and therefore one owning host)."""
+    if len(payload) < _FRAME.size:
+        raise ValueError("short binary frame")
+    magic, version, layout, d, flags, n = _FRAME.unpack_from(payload, 0)
+    if magic != MAGIC or version != VERSION:
+        raise ValueError("bad magic/version")
+    if layout != LAYOUT_F32:
+        raise ValueError("longhaul front accepts the f32 layout only")
+    off = _FRAME.size
+    need = n * d * 4
+    rows = np.frombuffer(
+        payload, dtype="<f4", count=n * d, offset=off
+    ).reshape(n, d).astype(np.float32)
+    off += need
+    fps = None
+    if flags & FLAG_ENTITY:
+        fps = np.frombuffer(payload, dtype="<u4", count=n, offset=off)
+        off += n * 4
+    ts = None
+    if flags & FLAG_TS:
+        ts = np.frombuffer(payload, dtype="<f8", count=n, offset=off)
+        off += n * 8
+    ents = []
+    for i in range(n):
+        fp = int(fps[i]) if fps is not None else 0
+        if fp == 0:
+            ents.append(None)  # the reserved null path
+        else:
+            slot = entity_slot(fp, spec.log2_slots)
+            t = float(ts[i]) if ts is not None else 0.0
+            ents.append((slot, fp, t))
+    return rows, ents
+
+
+def encode_request(rows, entities, ts, fmt: str, spec=None) -> bytes:
+    """Client-side encoder (tests/bench drive the front with this)."""
+    rows = np.asarray(rows, np.float32)
+    n = rows.shape[0]
+    if fmt == "json":
+        return json.dumps(
+            {
+                "rows": rows.astype(np.float64).tolist(),
+                "entities": list(entities),
+                "ts": [float(t) for t in ts],
+            }
+        ).encode("utf-8")
+    if fmt == "msgpack":
+        import msgpack
+
+        return msgpack.packb(
+            {
+                "rows": rows.astype(np.float64).tolist(),
+                "entities": list(entities),
+                "ts": [float(t) for t in ts],
+            },
+            use_single_float=False,
+        )
+    if fmt == "binary":
+        if spec is None:
+            raise ValueError("binary encoding needs the ledger spec")
+        from fraud_detection_tpu.ledger.state import entity_fingerprint
+
+        fps = np.zeros(n, "<u4")
+        for i, ent in enumerate(entities):
+            if ent is not None:
+                fps[i] = entity_fingerprint(ent)
+        hdr = _FRAME.pack(
+            MAGIC, VERSION, LAYOUT_F32, rows.shape[1],
+            FLAG_ENTITY | FLAG_TS, n,
+        )
+        return (
+            hdr
+            + rows.astype("<f4").tobytes()
+            + fps.tobytes()
+            + np.asarray(ts, "<f8").tobytes()
+        )
+    raise ValueError(f"unknown request format: {fmt}")
+
+
+def encode_response(scores: np.ndarray, fmt: str) -> bytes:
+    scores = np.asarray(scores, np.float32)
+    if fmt == "json":
+        return json.dumps(
+            {"scores": scores.astype(np.float64).tolist()}
+        ).encode("utf-8")
+    if fmt == "msgpack":
+        import msgpack
+
+        return msgpack.packb(
+            {"scores": scores.astype(np.float64).tolist()},
+            use_single_float=False,
+        )
+    if fmt == "binary":
+        hdr = _RESP.pack(MAGIC, VERSION, ST_OK, 0, scores.shape[0])
+        return hdr + scores.astype("<f4").tobytes()
+    raise ValueError(f"unknown response format: {fmt}")
+
+
+def encode_unavailable(message: str, retry_after_s: float, fmt: str) -> bytes:
+    """The 503 + Retry-After floor, in the caller's own format."""
+    if fmt == "json":
+        return json.dumps(
+            {"error": message, "status": 503,
+             "retry_after_s": retry_after_s}
+        ).encode("utf-8")
+    if fmt == "msgpack":
+        import msgpack
+
+        return msgpack.packb(
+            {"error": message, "status": 503,
+             "retry_after_s": retry_after_s}
+        )
+    if fmt == "binary":
+        msg = message.encode("utf-8")
+        hdr = _RESP.pack(MAGIC, VERSION, ST_UNAVAILABLE, 0, len(msg))
+        return hdr + _ERRPAY.pack(int(retry_after_s * 1000.0)) + msg
+    raise ValueError(f"unknown response format: {fmt}")
+
+
+def decode_response(payload: bytes, fmt: str) -> np.ndarray:
+    """Decode a front response; raises :class:`Unavailable` on the 503."""
+    if fmt in ("json", "msgpack"):
+        if fmt == "json":
+            obj = json.loads(payload.decode("utf-8"))
+        else:
+            import msgpack
+
+            obj = msgpack.unpackb(payload, raw=False)
+        if obj.get("status") == 503:
+            raise Unavailable(
+                obj.get("error", "unavailable"),
+                float(obj.get("retry_after_s", 1.0)),
+            )
+        return np.asarray(obj["scores"], np.float32)
+    if fmt == "binary":
+        magic, version, status, _k, n = _RESP.unpack_from(payload, 0)
+        if magic != MAGIC or version != VERSION:
+            raise ValueError("bad response magic/version")
+        if status == ST_OK:
+            return np.frombuffer(
+                payload, dtype="<f4", count=n, offset=_RESP.size
+            ).astype(np.float32)
+        (retry_ms,) = _ERRPAY.unpack_from(payload, _RESP.size)
+        msg = payload[_RESP.size + _ERRPAY.size:].decode(
+            "utf-8", "replace"
+        )
+        raise Unavailable(msg or "unavailable", retry_ms / 1000.0)
+    raise ValueError(f"unknown response format: {fmt}")

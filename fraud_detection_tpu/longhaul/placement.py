@@ -1,0 +1,146 @@
+"""Two-level placement: one keyspace, two moduli.
+
+``ledger/placement.py`` places a request's rows onto device shards with
+``slot mod n_shards``; longhaul generalizes the SAME rule one level up:
+``slot mod N_hosts`` names the host segment that owns the slot. Both are
+congruences on the same slot integer, so they compose freely — a host
+owns every table slot in its segment, and within the host the existing
+shard rule subdivides them. Two facts carry all the correctness weight:
+
+- **Same-slot rows always land on the same host.** The ledger fold and
+  the widened-feature read are per-slot (nothing in the fused body mixes
+  slots; ``collisions``/``evictions`` are per-slot *events* summed into
+  scalars), so grouping a batch's rows by ``slot mod N`` and flushing
+  each group on its owner preserves every slot's flush grouping exactly
+  — routed scores and per-slot table leaves stay bitwise equal to a
+  single-host serve of the same batches.
+- **Segments are disjoint and cover the table**, so failover is a pure
+  row-select: the inheritor copies the dead peer's segment rows (from
+  the peer's recovered table) into its live table and SUMS the scalar
+  event counters — no slot is ever owned twice.
+
+Ring inheritance: segment ``r`` is served by rank ``r`` while alive;
+when rank ``r`` dies its segment is inherited by the next LIVE rank
+scanning upward with wrap-around. Deterministic, view-only (any observer
+with the same membership view computes the same owner), and stable under
+rejoin (the returning rank takes its own segment back).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from fraud_detection_tpu.ledger.state import LedgerState
+
+
+def host_of(slot, n_hosts: int):
+    """The outer modulus: segment index for a slot (scalar or ndarray)."""
+    return slot % n_hosts
+
+
+def segment_owner(segment: int, live: Sequence[int], n_hosts: int) -> int:
+    """The live rank serving ``segment`` under ring inheritance.
+
+    ``live`` is the set/sequence of live ranks from the current
+    membership view. Scans ``segment, segment+1, ... (mod n_hosts)`` and
+    returns the first live rank — the segment's own rank while it lives,
+    its ring successor after it dies.
+    """
+    if not 0 <= segment < n_hosts:
+        raise ValueError(f"segment {segment} out of range for {n_hosts} hosts")
+    alive = set(live)
+    if not alive:
+        raise ValueError("no live hosts")
+    for step in range(n_hosts):
+        cand = (segment + step) % n_hosts
+        if cand in alive:
+            return cand
+    raise ValueError(f"live ranks {sorted(alive)} outside 0..{n_hosts - 1}")
+
+
+def owned_segments(
+    rank: int, live: Sequence[int], n_hosts: int
+) -> tuple[int, ...]:
+    """Every segment ``rank`` currently serves (its own + inherited)."""
+    return tuple(
+        seg
+        for seg in range(n_hosts)
+        if segment_owner(seg, live, n_hosts) == rank
+    )
+
+
+def segment_mask(
+    n_slots: int, segments: Iterable[int], n_hosts: int
+) -> np.ndarray:
+    """Boolean mask over table slots belonging to ``segments``."""
+    slots = np.arange(n_slots, dtype=np.int64)
+    mask = np.zeros(n_slots, dtype=bool)
+    for seg in set(segments):
+        mask |= (slots % n_hosts) == seg
+    return mask
+
+
+def merge_segment(
+    dst: LedgerState,
+    src: LedgerState,
+    segments: Iterable[int],
+    n_hosts: int,
+    baseline: tuple[float, float] = (0.0, 0.0),
+) -> LedgerState:
+    """Fold ``src``'s rows for ``segments`` into ``dst`` (host numpy).
+
+    Per-slot leaves are a pure row-select (the segments are disjoint from
+    anything ``dst`` owns, so nothing is overwritten that mattered); the
+    scalar event counters sum — each collision/eviction happened at one
+    slot on one owner, so the sum counts every event exactly once.
+    ``baseline`` is the ``(collisions, evictions)`` pair BOTH tables
+    started from (the seeded warmup events every fleet member replicates
+    at build): the sum subtracts it once so shared history is not
+    double-counted. Same shapes/dtypes in and out: binding the merged
+    table back into the drift monitor recompiles nothing.
+    """
+    acc = np.array(np.asarray(dst.acc), np.float32, copy=True)
+    last_ts = np.array(np.asarray(dst.last_ts), np.float32, copy=True)
+    fp = np.array(np.asarray(dst.fingerprint), np.uint32, copy=True)
+    mask = segment_mask(last_ts.shape[-1], segments, n_hosts)
+    acc[..., mask, :] = np.asarray(src.acc, np.float32)[..., mask, :]
+    last_ts[..., mask] = np.asarray(src.last_ts, np.float32)[..., mask]
+    fp[..., mask] = np.asarray(src.fingerprint, np.uint32)[..., mask]
+    coll0, evic0 = np.float32(baseline[0]), np.float32(baseline[1])
+    return LedgerState(
+        acc=acc,
+        last_ts=last_ts,
+        fingerprint=fp,
+        collisions=np.asarray(
+            np.float32(dst.collisions) + np.float32(src.collisions) - coll0
+        ),
+        evictions=np.asarray(
+            np.float32(dst.evictions) + np.float32(src.evictions) - evic0
+        ),
+    )
+
+
+def segments_equal(
+    a: LedgerState, b: LedgerState, segments: Iterable[int], n_hosts: int
+) -> tuple[bool, str]:
+    """Bitwise comparison of the per-slot leaves restricted to
+    ``segments`` (the failover acceptance check: the inherited segment of
+    the survivor's table vs the same segment of an uninterrupted serve).
+    Scalar event counters are global, not per-segment — compare those
+    separately with :func:`merge_segment`'s sum semantics in mind."""
+    mask = segment_mask(
+        np.asarray(a.last_ts).shape[-1], segments, n_hosts
+    )
+    for name in ("acc", "last_ts", "fingerprint"):
+        av = np.asarray(getattr(a, name))
+        bv = np.asarray(getattr(b, name))
+        if name == "acc":  # (..., S, 3): slot axis is second-to-last
+            av, bv = av[..., mask, :], bv[..., mask, :]
+        else:
+            av, bv = av[..., mask], bv[..., mask]
+        if av.tobytes() != bv.tobytes():
+            n_diff = int(np.sum(av != bv))
+            return False, f"{name}: {n_diff} element(s) differ in segment"
+    return True, "segment bitwise equal on per-slot leaves"

@@ -79,6 +79,29 @@ The suite (``run_scenario(name)``):
                           synced journal replays the FULL table bitwise,
                           and a torn journal tail loses exactly the final
                           flush — counted on the metric, never silent
+``ledger_owner_failover_mid_traffic``
+                          longhaul: a 2-host fleet serving routed traffic
+                          loses one host to an abrupt kill; the data plane
+                          never answers worse than 503 + Retry-After
+                          during the handoff, the survivor replays the
+                          dead peer's journal generation and ends owning
+                          BOTH segments with the inherited segment bitwise
+                          equal to an uninterrupted single-host serve, at
+                          zero new fused-flush compiles
+``host_partition_mid_promotion``
+                          longhaul: a host partitioned from the directory
+                          is declared dead (epoch bumps); its promotion
+                          finalize — decided under the old epoch — is
+                          fenced (directory unreachable), a reachable
+                          host's finalize under the old epoch is fenced
+                          too (epoch moved), and after rejoin a finalize
+                          under the fresh epoch lands exactly once
+``split_brain_scrape``    longhaul: a partitioned host keeps serving and
+                          answering scrapes under its frozen epoch; the
+                          fleet merge drops its contribution (counted on
+                          longhaul_scrape_stale_epoch), never
+                          double-counts the drift window, and re-admits
+                          the host after rejoin under the fresh epoch
 ========================  ==================================================
 """
 
@@ -2523,6 +2546,480 @@ def scenario_kill_mid_snapshot(
     return result
 
 
+# -- longhaul: the multi-host switchyard -------------------------------------
+
+def _keyed_batches(spec, batches):
+    """Entity strings → ``(slot, fp, ts)`` triples, the form the front
+    routes on and the micro-batcher stages."""
+    out = []
+    for rows, ents, ts in batches:
+        ke = [
+            None if e is None else (*spec.row_keys(e), float(ts[i]))
+            for i, e in enumerate(ents)
+        ]
+        out.append((rows, ke))
+    return out
+
+
+def _longhaul_fleet(tmpdir: str, seed: int, dead_after_s: float = 1.0):
+    """A 2-host localhost fleet: directory + two lifeboat-backed hosts +
+    front, plus the single-host parity reference."""
+    from fraud_detection_tpu.longhaul.front import LonghaulFront
+    from fraud_detection_tpu.longhaul.host import (
+        HostServer,
+        build_seeded_backend,
+    )
+    from fraud_detection_tpu.longhaul.membership import DirectoryServer
+
+    dirsrv = DirectoryServer(
+        os.path.join(tmpdir, "dir"), n_hosts=2, dead_after_s=dead_after_s
+    )
+    dirsrv.start()
+    fleet_dir = os.path.join(tmpdir, "fleet")
+    b_a, t0 = build_seeded_backend(seed, fleet_dir, "host-a")
+    b_b, _ = build_seeded_backend(seed, fleet_dir, "host-b")
+    h_a = HostServer(
+        "host-a", b_a, n_hosts=2, directory_addr=dirsrv.addr,
+        heartbeat_s=0.2,
+    )
+    h_b = HostServer(
+        "host-b", b_b, n_hosts=2, directory_addr=dirsrv.addr,
+        heartbeat_s=0.2,
+    )
+    h_a.start()
+    h_b.start()
+    b_ref, _ = build_seeded_backend(seed, "", "ref")
+    front = LonghaulFront(
+        b_ref.spec, n_hosts=2, directory_addr=dirsrv.addr,
+    )
+    # both joins must be visible in every host's serving claim before
+    # traffic flows (host-a momentarily ring-owns both segments)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if h_a.owned_segments == {0} and h_b.owned_segments == {1}:
+            break
+        time.sleep(0.05)
+    return dirsrv, h_a, h_b, b_ref, front, fleet_dir, t0
+
+
+def _wait_dead(dirsrv, rank: int, timeout_s: float = 6.0) -> float:
+    """Block until the failure detector declares ``rank`` dead; returns
+    the detection latency."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        m = dirsrv.view().member_by_rank(rank)
+        if m is not None and not m.alive:
+            return time.monotonic() - t0
+        time.sleep(0.05)
+    raise TimeoutError(f"rank {rank} never declared dead")
+
+
+def scenario_ledger_owner_failover_mid_traffic(
+    tmpdir: str, seed: int = 2027, n_batches: int = 8, batch: int = 32,
+) -> ScenarioResult:
+    """Kill one host of a 2-host fleet mid-traffic; the survivor inherits
+    the dead peer's ledger segment from its journal generation.
+
+    Invariants:
+
+    - **routed-bitwise**: scores routed through the front (pre-kill AND
+      post-failover) are bitwise equal to an uninterrupted single-host
+      serve of the same batches;
+    - **degraded-503**: between the kill and the completed inheritance,
+      every request touching the dead owner's segment answers the typed
+      503 with a positive Retry-After — never a silent misroute into a
+      table that hasn't inherited the rows;
+    - **failover-bitwise**: after inheritance + the remaining traffic,
+      the survivor's FULL table (both segments, scalar counters included)
+      is bitwise equal to the uninterrupted single-host table;
+    - **zero-new-compiles**: inheritance rebinds the merged table with
+      identical shapes/dtypes — the fused ledger-flush cache grows by 0.
+    """
+    from fraud_detection_tpu import config as config_mod
+    from fraud_detection_tpu.longhaul import placement
+    from fraud_detection_tpu.longhaul.codec import Unavailable
+    from fraud_detection_tpu.monitor import drift as drift_mod
+
+    result = ScenarioResult("ledger_owner_failover_mid_traffic")
+    dirsrv, h_a, h_b, b_ref, front, fleet_dir, t0 = _longhaul_fleet(
+        tmpdir, seed
+    )
+    spec = b_ref.spec
+    try:
+        batches = _keyed_batches(
+            spec, _entity_batches(seed, n_batches, batch, t0)
+        )
+        half = n_batches // 2
+
+        def ref_drive(rows, ke):
+            return b_ref.score_items(
+                [(rows[i], None, None, ke[i]) for i in range(rows.shape[0])]
+            )
+
+        pre_ok = True
+        for rows, ke in batches[:half]:
+            ref = ref_drive(rows, ke)
+            routed = front.score(rows, ke, fmt="json")
+            pre_ok = pre_ok and ref.tobytes() == routed.tobytes()
+        result.add(
+            InvariantOutcome(
+                "routed-bitwise-pre-kill", pre_ok,
+                f"{half} routed batches bitwise equal to the single-host "
+                "serve (per-slot fold independence)",
+            )
+        )
+
+        # -- the kill: abrupt, mid-traffic ---------------------------------
+        h_b.kill()
+        detect_s = _wait_dead(dirsrv, rank=1)
+
+        # a probe carrying ONLY the dead owner's segment: every attempt
+        # during the handoff must surface the typed 503 — a success here
+        # would mean a silent serve from a table missing the rows
+        rows_p, ke_p = batches[half]
+        idx = [
+            i for i, e in enumerate(ke_p)
+            if e is not None and placement.host_of(int(e[0]), 2) == 1
+        ]
+        probe_rows = rows_p[idx]
+        probe_ke = [ke_p[i] for i in idx]
+        degraded, attempts = True, 0
+        for _ in range(3):
+            attempts += 1
+            try:
+                front.score(probe_rows, probe_ke, fmt="json")
+                degraded = False
+            except Unavailable as exc:
+                degraded = degraded and exc.retry_after_s > 0.0
+        result.add(
+            InvariantOutcome(
+                "degraded-503-with-retry-after", degraded,
+                f"{attempts} mid-handoff attempts on the dead owner's "
+                "segment all answered 503 + Retry-After "
+                f"(retry_after_s={config_mod.longhaul_retry_after_s()})",
+            )
+        )
+
+        compiles_before = drift_mod._fused_flush_ledger._cache_size()
+        t_fo = time.monotonic()
+        summary = front.drive_failover(
+            1, os.path.join(fleet_dir, "host-b")
+        )
+        failover_s = time.monotonic() - t_fo
+        restored = bool(summary and summary.get("restored"))
+        result.add(
+            InvariantOutcome(
+                "failover-restores-segment",
+                restored and summary["torn_rows"] == 0
+                and summary["replayed_rows"] > 0,
+                f"survivor replayed {summary and summary['replayed_rows']}"
+                f" rows from the peer generation in "
+                f"{summary and round(summary['duration_s'], 3)}s",
+            )
+        )
+
+        # remaining traffic: everything routes to the survivor now
+        post_ok = True
+        for rows, ke in batches[half:]:
+            ref = ref_drive(rows, ke)
+            routed = front.score(rows, ke, fmt="json")
+            post_ok = post_ok and ref.tobytes() == routed.tobytes()
+        result.add(
+            InvariantOutcome(
+                "routed-bitwise-post-failover", post_ok,
+                f"{n_batches - half} batches served by the survivor "
+                "bitwise equal to the uninterrupted serve",
+            )
+        )
+
+        compiles_delta = (
+            drift_mod._fused_flush_ledger._cache_size() - compiles_before
+        )
+        t_ref = b_ref.table()
+        t_srv = h_a.backend.table()
+        eq, detail = placement.segments_equal(t_srv, t_ref, [0, 1], 2)
+        scal_ok = (
+            np.float32(t_srv.collisions).tobytes()
+            == np.float32(t_ref.collisions).tobytes()
+            and np.float32(t_srv.evictions).tobytes()
+            == np.float32(t_ref.evictions).tobytes()
+        )
+        result.add(
+            InvariantOutcome(
+                "survivor-table-bitwise",
+                eq and scal_ok
+                and h_a.owned_segments == {0, 1},
+                f"survivor owns both segments; full table {detail}; "
+                f"scalar counters {'match' if scal_ok else 'DIFFER'}",
+            )
+        )
+        result.add(
+            InvariantOutcome(
+                "zero-new-compiles", compiles_delta == 0,
+                f"{compiles_delta} fused ledger-flush executables "
+                "compiled across inherit + post-failover traffic",
+            )
+        )
+        result.metrics = {
+            "batches": n_batches,
+            "detect_s": round(detect_s, 3),
+            "failover_s": round(failover_s, 3),
+            "replayed_rows": summary and summary["replayed_rows"],
+            "replay_rows_per_sec": summary
+            and round(summary["replay_rows_per_sec"], 1),
+            "mid_handoff_503s": attempts,
+            "compiles_delta": compiles_delta,
+        }
+        return result
+    finally:
+        front.close()
+        h_a.close()
+        h_b.kill()
+        dirsrv.close()
+
+
+def scenario_host_partition_mid_promotion(
+    tmpdir: str, seed: int = 2028,
+) -> ScenarioResult:
+    """Partition a host from the directory mid-promotion: every finalize
+    decided under the pre-partition epoch must die, and exactly the
+    post-rejoin finalize under the fresh epoch lands.
+
+    The epoch is the fence token: the partitioned host cannot REACH the
+    directory (fail-safe — unreachable means un-finalizable), and a
+    reachable host holding the old epoch sees the directory has moved on.
+    """
+    from fraud_detection_tpu.service import metrics as svc_metrics
+
+    result = ScenarioResult("host_partition_mid_promotion")
+    dirsrv, h_a, h_b, b_ref, front, _fleet, _t0 = _longhaul_fleet(
+        tmpdir, seed
+    )
+    try:
+        epoch_before = dirsrv.view().epoch
+        fenced_before = (
+            svc_metrics.longhaul_promotion_fenced.labels("host-b")._value.get()
+            + svc_metrics.longhaul_promotion_fenced.labels("host-a")._value.get()
+        )
+
+        # the partition: control-plane packets stop routing for B
+        h_b.partitioned = True
+        detect_s = _wait_dead(dirsrv, rank=1)
+        epoch_dead = dirsrv.view().epoch
+        result.add(
+            InvariantOutcome(
+                "partition-detected",
+                epoch_dead > epoch_before,
+                f"detector declared the partitioned host dead in "
+                f"{detect_s:.2f}s (epoch {epoch_before} -> {epoch_dead})",
+            )
+        )
+
+        # fence 1: the partitioned host itself — directory unreachable
+        res_b = h_b.finalize_promotion("v2", epoch_before)
+        # fence 2: a reachable host holding the stale epoch
+        res_a = h_a.finalize_promotion("v2", epoch_before)
+        result.add(
+            InvariantOutcome(
+                "stale-finalizes-fenced",
+                not res_b["applied"] and res_b.get("fenced")
+                and not res_a["applied"] and res_a.get("fenced"),
+                f"partitioned host: {res_b.get('reason', '')[:60]}; "
+                f"stale-epoch host: {res_a.get('reason', '')[:60]}",
+            )
+        )
+
+        # heal: B rejoins (its next heartbeat learns it was declared
+        # dead and re-registers), epoch bumps again
+        h_b.partitioned = False
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            m = dirsrv.view().member_by_rank(1)
+            if m is not None and m.alive:
+                break
+            time.sleep(0.05)
+        epoch_fresh = dirsrv.view().epoch
+        res_a2 = h_a.finalize_promotion("v2", epoch_fresh)
+        res_b2 = h_b.finalize_promotion("v2", epoch_fresh)
+        result.add(
+            InvariantOutcome(
+                "fresh-finalize-lands",
+                res_a2["applied"] and res_b2["applied"]
+                and h_a.served_version == "v2"
+                and h_b.served_version == "v2",
+                f"both hosts finalized v2 under fresh epoch {epoch_fresh}",
+            )
+        )
+        fenced_after = (
+            svc_metrics.longhaul_promotion_fenced.labels("host-b")._value.get()
+            + svc_metrics.longhaul_promotion_fenced.labels("host-a")._value.get()
+        )
+        result.add(
+            InvariantOutcome(
+                "fences-counted",
+                fenced_after - fenced_before == 2,
+                f"longhaul_promotion_fenced_total grew by "
+                f"{fenced_after - fenced_before} (one per refused "
+                "finalize)",
+            )
+        )
+        result.metrics = {
+            "detect_s": round(detect_s, 3),
+            "epoch_before": epoch_before,
+            "epoch_dead": epoch_dead,
+            "epoch_fresh": epoch_fresh,
+        }
+        return result
+    finally:
+        front.close()
+        h_a.close()
+        h_b.close()
+        dirsrv.close()
+
+
+def scenario_split_brain_scrape(
+    tmpdir: str, seed: int = 2029, n_batches: int = 4, batch: int = 32,
+) -> ScenarioResult:
+    """A partitioned host keeps serving and answering scrapes under its
+    frozen epoch; the fleet merge must never double-count it.
+
+    Invariants: the stale contribution is dropped and counted
+    (``longhaul_scrape_stale_epoch``), the merged drift window equals the
+    live host's window alone (not the sum), and after rejoin the merge
+    re-admits both hosts under the fresh epoch.
+    """
+    from fraud_detection_tpu.longhaul import scrape as scrape_mod
+    from fraud_detection_tpu.longhaul.front import HostHandle
+    from fraud_detection_tpu.service import metrics as svc_metrics
+
+    result = ScenarioResult("split_brain_scrape")
+    dirsrv, h_a, h_b, b_ref, front, _fleet, t0 = _longhaul_fleet(
+        tmpdir, seed
+    )
+    spec = b_ref.spec
+    try:
+        batches = _keyed_batches(
+            spec, _entity_batches(seed, n_batches, batch, t0)
+        )
+        for rows, ke in batches:
+            front.score(rows, ke, fmt="json")
+
+        clients = [
+            HostHandle("host-a", 0, h_a.addr, h_a.token),
+            HostHandle("host-b", 1, h_b.addr, h_b.token),
+        ]
+        epoch0 = dirsrv.view().epoch
+        # both hosts must have learned the current epoch before the
+        # baseline scrape, or their stamps race the sweep
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if h_a.known_epoch == epoch0 and h_b.known_epoch == epoch0:
+                break
+            time.sleep(0.05)
+        base = scrape_mod.fleet_scrape(clients, epoch0)
+        both_counted = (
+            sorted(base["accepted"]) == ["host-a", "host-b"]
+            and base["window"] is not None
+        )
+        n_rows_both = float(np.sum(np.asarray(base["window"].n_rows)))
+        result.add(
+            InvariantOutcome(
+                "healthy-scrape-merges-both", both_counted,
+                f"pre-partition scrape merged 2 hosts, window n_rows="
+                f"{n_rows_both:.1f}",
+            )
+        )
+
+        # the partition: B's control plane freezes (epoch stays stale),
+        # its DATA plane — including the scrape op — keeps answering
+        h_b.partitioned = True
+        _wait_dead(dirsrv, rank=1)
+        epoch1 = dirsrv.view().epoch
+        # the live host must learn the bumped epoch before the scrape,
+        # or ITS contribution would read stale too
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if h_a.known_epoch == epoch1:
+                break
+            time.sleep(0.05)
+        stale_before = svc_metrics.longhaul_scrape_stale_epoch.labels(
+            "host-b"
+        )._value.get()
+        split = scrape_mod.fleet_scrape(clients, epoch1)
+        stale_delta = (
+            svc_metrics.longhaul_scrape_stale_epoch.labels(
+                "host-b"
+            )._value.get()
+            - stale_before
+        )
+        # the no-double-count pin: the merged window is A's alone —
+        # bitwise — not A + a stale copy of B
+        a_only = scrape_mod.fleet_scrape(clients[:1], epoch1)
+        merged_is_a = (
+            split["window"] is not None
+            and a_only["window"] is not None
+            and all(
+                np.asarray(x).tobytes() == np.asarray(y).tobytes()
+                for x, y in zip(split["window"], a_only["window"])
+            )
+        )
+        result.add(
+            InvariantOutcome(
+                "stale-epoch-dropped",
+                split["stale"] == ["host-b"]
+                and split["accepted"] == ["host-a"]
+                and stale_delta == 1,
+                f"split-brain contribution dropped and counted "
+                f"(stale_epoch delta={stale_delta})",
+            )
+        )
+        result.add(
+            InvariantOutcome(
+                "no-double-count", merged_is_a,
+                "merged window under the split is bitwise the live "
+                "host's window alone",
+            )
+        )
+
+        # heal: B rejoins and the next scrape re-admits it
+        h_b.partitioned = False
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            m = dirsrv.view().member_by_rank(1)
+            if m is not None and m.alive:
+                break
+            time.sleep(0.05)
+        epoch2 = dirsrv.view().epoch
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if h_a.known_epoch == epoch2 and h_b.known_epoch == epoch2:
+                break
+            time.sleep(0.05)
+        healed = scrape_mod.fleet_scrape(clients, epoch2)
+        result.add(
+            InvariantOutcome(
+                "rejoin-readmits",
+                sorted(healed["accepted"]) == ["host-a", "host-b"],
+                f"post-rejoin scrape merged both hosts under epoch "
+                f"{epoch2}",
+            )
+        )
+        result.metrics = {
+            "epoch_baseline": epoch0,
+            "epoch_split": epoch1,
+            "epoch_healed": epoch2,
+            "window_rows_baseline": round(n_rows_both, 1),
+        }
+        return result
+    finally:
+        for c in clients:
+            c.close()
+        front.close()
+        h_a.close()
+        h_b.close()
+        dirsrv.close()
+
+
 SCENARIOS = {
     "burst": scenario_burst,
     "drift_onset": scenario_drift_onset,
@@ -2539,6 +3036,11 @@ SCENARIOS = {
     "slo_burn_under_shed": scenario_slo_burn_under_shed,
     "crash_warm_restart": scenario_crash_warm_restart,
     "kill_mid_snapshot": scenario_kill_mid_snapshot,
+    "ledger_owner_failover_mid_traffic": (
+        scenario_ledger_owner_failover_mid_traffic
+    ),
+    "host_partition_mid_promotion": scenario_host_partition_mid_promotion,
+    "split_brain_scrape": scenario_split_brain_scrape,
 }
 
 #: scenarios that need a scratch directory as their first argument
@@ -2547,6 +3049,9 @@ NEEDS_TMPDIR = (
     "control_plane_chaos",
     "crash_warm_restart",
     "kill_mid_snapshot",
+    "ledger_owner_failover_mid_traffic",
+    "host_partition_mid_promotion",
+    "split_brain_scrape",
 )
 
 

@@ -648,6 +648,129 @@ lifecycle_feedback_rows = Gauge(
 )
 
 
+# Longhaul: the multi-host switchyard (longhaul/). These names are the
+# alerting contract for monitoring/prometheus/rules/longhaul-alerts.yml
+# (HostDown, MembershipFlapping, FailoverStuck, FleetBudgetExhausted) and
+# the longhaul dashboard rows. Per-host gauges carry the `host` label and
+# follow the panopticon stale-series discipline: drop_host_gauges() on
+# leave/death, re-bound by the directory on (re)join.
+longhaul_membership_epoch = Gauge(
+    "longhaul_membership_epoch",
+    "Current membership epoch — the fleet's fence token; bumps on every "
+    "join/death/leave/rejoin (MembershipFlapping alert input: a churning "
+    "epoch means a host is oscillating through the failure detector)",
+    registry=registry,
+)
+longhaul_hosts_live = Gauge(
+    "longhaul_hosts_live",
+    "Live members in the current membership view",
+    registry=registry,
+)
+longhaul_host_up = Gauge(
+    "longhaul_host_up",
+    "1 while this member is live in the membership view, 0 once marked "
+    "dead (HostDown alert input)",
+    ["host"],
+    registry=registry,
+)
+longhaul_host_heartbeat_age = Gauge(
+    "longhaul_host_heartbeat_age_seconds",
+    "Seconds since this member's last heartbeat reached the directory",
+    ["host"],
+    registry=registry,
+)
+longhaul_routed_rows = Counter(
+    "longhaul_routed_rows",
+    "Rows the front routed to each owning host, by request format "
+    "(json/msgpack/binary)",
+    ["host", "format"],
+    registry=registry,
+)
+longhaul_route_errors = Counter(
+    "longhaul_route_errors",
+    "Transport/handler failures routing to a host (strikes toward its "
+    "DEAD transition; explicit 503 backpressure is NOT counted here)",
+    ["host"],
+    registry=registry,
+)
+longhaul_unavailable = Counter(
+    "longhaul_unavailable",
+    "Requests the front answered 503 + Retry-After (owner inheriting, or "
+    "no healthy host for the segment) — the degradation contract doing "
+    "its job, never silent data loss",
+    registry=registry,
+)
+longhaul_failovers = Counter(
+    "longhaul_failovers",
+    "Segment inheritances completed, labeled by the INHERITING host",
+    ["host"],
+    registry=registry,
+)
+longhaul_failover_in_progress = Gauge(
+    "longhaul_failover_in_progress",
+    "1 while a host is replaying a dead peer's journal+snapshot "
+    "generation into its live table (FailoverStuck alert input)",
+    registry=registry,
+)
+longhaul_failover_duration = Gauge(
+    "longhaul_failover_duration_seconds",
+    "Wall time of the last completed segment inheritance (peer recovery "
+    "replay + segment merge + rebind)",
+    registry=registry,
+)
+longhaul_inherited_rows = Counter(
+    "longhaul_inherited_rows",
+    "Journal rows replayed from dead peers' generations, labeled by the "
+    "inheriting host",
+    ["host"],
+    registry=registry,
+)
+longhaul_replay_rows_per_sec = Gauge(
+    "longhaul_replay_rows_per_sec",
+    "Replay throughput of the last inheritance (journal rows/s through "
+    "the traced ledger body)",
+    registry=registry,
+)
+longhaul_scrape_stale_epoch = Counter(
+    "longhaul_scrape_stale_epoch",
+    "Host scrape contributions DROPPED from a fleet merge because they "
+    "were reported under a different membership epoch (the split-brain "
+    "double-count guard)",
+    ["host"],
+    registry=registry,
+)
+longhaul_fleet_budget_remaining = Gauge(
+    "longhaul_fleet_budget_remaining",
+    "Fleet-level SLO error budget remaining over the longest window, "
+    "merged from per-host good/bad totals under ONE membership epoch "
+    "(FleetBudgetExhausted alert input)",
+    ["slo"],
+    registry=registry,
+)
+longhaul_promotion_fenced = Counter(
+    "longhaul_promotion_fenced",
+    "Promotion finalizations REFUSED by the membership-epoch fence (the "
+    "flip was decided under a stale epoch — a partitioned host must not "
+    "move traffic)",
+    ["host"],
+    registry=registry,
+)
+
+
+def drop_host_gauges(host: str) -> None:
+    """Drop one member's per-host GAUGE series on death/leave (panopticon
+    stale-series discipline, the host-level twin of
+    :func:`drop_shard_gauges`): a dead host's last heartbeat-age sample
+    must not read as live on dashboards. Counters stay — their rate goes
+    quiet on its own. The directory re-binds ``longhaul_host_up`` on
+    (re)join."""
+    for g in (longhaul_host_heartbeat_age,):
+        try:
+            g.remove(host)
+        except KeyError:
+            pass  # never written for this host yet
+
+
 def render() -> bytes:
     return generate_latest(registry)
 

@@ -1,0 +1,684 @@
+"""Longhaul (ISSUE 17): the multi-host switchyard — fast tier.
+
+Covers the pure and cheap-socket pieces: two-level placement math,
+segment merge with the seeded-baseline counter discipline, the membership
+directory (epochs, durable restart fencing, the sweeper, sticky ranks,
+auth), the three ingress codecs, front routing + the PR-6/7 degradation
+ladder against stub hosts, the epoch-fenced scrape merge, and the
+SocketReducer / fleet MapReduce entrants. The full-stack failover drills
+live in ``test_range.py`` behind ``-m slow``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fraud_detection_tpu.ledger.state import (
+    LEDGER_K,
+    LedgerSpec,
+    LedgerState,
+    init_state,
+)
+from fraud_detection_tpu.longhaul import codec, placement
+from fraud_detection_tpu.longhaul.codec import Unavailable
+from fraud_detection_tpu.longhaul.membership import (
+    DirectoryClient,
+    DirectoryServer,
+    MemberInfo,
+    MembershipView,
+)
+
+D = 6
+
+
+def _spec(slots=64):
+    return LedgerSpec(
+        n_base=D, slots=slots, halflife_s=600.0, amount_col=-1,
+        null_features=np.zeros(LEDGER_K, np.float32),
+    )
+
+
+# -- placement --------------------------------------------------------------
+
+
+def test_host_of_scalar_and_array():
+    assert placement.host_of(5, 2) == 1
+    assert placement.host_of(6, 2) == 0
+    np.testing.assert_array_equal(
+        placement.host_of(np.arange(6), 3), [0, 1, 2, 0, 1, 2]
+    )
+
+
+def test_segment_owner_ring_inheritance():
+    # everyone alive: each rank owns its own segment
+    for seg in range(4):
+        assert placement.segment_owner(seg, [0, 1, 2, 3], 4) == seg
+    # rank 1 dead: its segment falls to the next live rank upward
+    assert placement.segment_owner(1, [0, 2, 3], 4) == 2
+    # wrap-around: rank 3 dead, next live scanning up from 3 is 0
+    assert placement.segment_owner(3, [0, 1, 2], 4) == 0
+    # cascading deaths still deterministic
+    assert placement.segment_owner(1, [0, 3], 4) == 3
+    with pytest.raises(ValueError):
+        placement.segment_owner(0, [], 4)
+    with pytest.raises(ValueError):
+        placement.segment_owner(7, [0], 4)
+
+
+def test_owned_segments_rejoin_stability():
+    assert placement.owned_segments(0, [0, 1], 2) == (0,)
+    assert placement.owned_segments(0, [0], 2) == (0, 1)
+    # the returning rank takes its own segment back
+    assert placement.owned_segments(0, [0, 1], 2) == (0,)
+    assert placement.owned_segments(1, [0, 1], 2) == (1,)
+
+
+def test_segment_masks_partition_the_table():
+    m0 = placement.segment_mask(64, [0], 2)
+    m1 = placement.segment_mask(64, [1], 2)
+    assert not np.any(m0 & m1)
+    assert np.all(m0 | m1)
+    assert m0.sum() == 32
+
+
+def _filled_state(slots: int, seed: int) -> LedgerState:
+    rng = np.random.default_rng(seed)
+    st = init_state(slots)
+    return st._replace(
+        acc=rng.standard_normal((slots, 3)).astype(np.float32),
+        last_ts=rng.random(slots).astype(np.float32),
+        fingerprint=rng.integers(
+            1, 2**32, slots, dtype=np.uint32
+        ),
+        collisions=np.float32(36.0),
+        evictions=np.float32(2.0),
+    )
+
+
+def test_merge_segment_row_select_and_baseline_counters():
+    dst = _filled_state(64, 1)
+    src = _filled_state(64, 2)
+    # both tables replicate the same seeded warmup: 36 collisions,
+    # 2 evictions happened ONCE in history, not once per host
+    merged = placement.merge_segment(
+        dst, src, [1], 2, baseline=(36.0, 2.0)
+    )
+    m1 = placement.segment_mask(64, [1], 2)
+    # segment 1 rows come from src, segment 0 rows untouched
+    np.testing.assert_array_equal(merged.acc[m1], src.acc[m1])
+    np.testing.assert_array_equal(merged.acc[~m1], dst.acc[~m1])
+    np.testing.assert_array_equal(merged.last_ts[m1], src.last_ts[m1])
+    np.testing.assert_array_equal(
+        merged.fingerprint[~m1], dst.fingerprint[~m1]
+    )
+    # counters: dst + src − shared baseline
+    assert float(merged.collisions) == 36.0
+    assert float(merged.evictions) == 2.0
+    ok, detail = placement.segments_equal(merged, src, [1], 2)
+    assert ok, detail
+    ok, _ = placement.segments_equal(merged, src, [0], 2)
+    assert not ok
+
+
+# -- membership -------------------------------------------------------------
+
+
+def test_directory_join_epochs_and_sticky_ranks(tmp_path):
+    d = DirectoryServer(str(tmp_path), n_hosts=2, token="")
+    e0 = d.epoch
+    v = d.join("ha", "127.0.0.1:1")
+    assert v.epoch == e0 + 1
+    assert v.member_by_rank(0).host_id == "ha"
+    v = d.join("hb", "127.0.0.1:2")
+    assert v.member_by_rank(1).host_id == "hb"
+    assert v.live_ranks == (0, 1)
+    with pytest.raises(ValueError):
+        d.join("hc", "127.0.0.1:3")  # fleet full
+    # death then rejoin: hb keeps rank 1 (its segment follows it)
+    d.mark_dead("hb")
+    v = d.join("hb", "127.0.0.1:9")
+    assert v.member_by_rank(1).host_id == "hb"
+    assert v.member_by_rank(1).addr == "127.0.0.1:9"
+
+
+def test_directory_restart_bumps_epoch_and_resets_liveness(tmp_path):
+    d = DirectoryServer(str(tmp_path), n_hosts=2, token="")
+    d.join("ha", "127.0.0.1:1")
+    d.join("hb", "127.0.0.1:2")
+    e_live = d.epoch
+    d.close()
+    # restart from the same durable state: strictly higher epoch (every
+    # view the old incarnation issued is fenced), liveness volatile
+    d2 = DirectoryServer(str(tmp_path), n_hosts=2, token="")
+    try:
+        assert d2.epoch > e_live
+        v = d2.view()
+        assert v.member_by_rank(0).host_id == "ha"
+        assert not any(m.alive for m in v.members)
+        # a dead-looking member's heartbeat is told to rejoin
+        assert d2.heartbeat("ha")["stale"] is True
+        v = d2.join("ha", "127.0.0.1:1")
+        assert v.member_by_rank(0).alive
+    finally:
+        d2.close()
+
+
+def test_sweeper_declares_silent_member_dead(tmp_path):
+    d = DirectoryServer(
+        str(tmp_path), n_hosts=2, dead_after_s=0.2, token=""
+    )
+    d.start()
+    try:
+        v = d.join("ha", "127.0.0.1:1")
+        e_joined = v.epoch
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            m = d.view().member_by_rank(0)
+            if m is not None and not m.alive:
+                break
+            time.sleep(0.05)
+        v = d.view()
+        assert not v.member_by_rank(0).alive
+        assert v.epoch > e_joined
+        assert d.heartbeat("ha")["stale"] is True
+    finally:
+        d.close()
+
+
+def test_directory_client_wire_and_auth(tmp_path):
+    d = DirectoryServer(str(tmp_path), n_hosts=2, token="tok")
+    d.start()
+    try:
+        cl = DirectoryClient(d.addr, token="tok")
+        v = cl.join("ha", "127.0.0.1:1")
+        assert v.member_by_rank(0).host_id == "ha"
+        assert cl.heartbeat("ha")["stale"] is False
+        assert cl.view().live_ranks == (0,)
+        v = cl.mark_dead("ha")
+        assert not v.member_by_rank(0).alive
+        with pytest.raises(RuntimeError, match="unauthorized"):
+            DirectoryClient(d.addr, token="wrong").view()
+    finally:
+        d.close()
+
+
+def test_membership_view_dict_roundtrip():
+    v = MembershipView(
+        epoch=9, n_hosts=2,
+        members=(
+            MemberInfo("ha", 0, "127.0.0.1:1", True),
+            MemberInfo("hb", 1, "127.0.0.1:2", False),
+        ),
+    )
+    assert MembershipView.from_dict(v.to_dict()) == v
+    assert v.live_ranks == (0,)
+
+
+# -- codecs -----------------------------------------------------------------
+
+
+def test_pack_array_roundtrip_preserves_dtype_and_bytes():
+    for arr in (
+        np.random.default_rng(0).standard_normal((5, 3)).astype(np.float32),
+        np.arange(7, dtype=np.uint32),
+        np.float32(41.5),
+    ):
+        back = codec.unpack_array(codec.pack_array(np.asarray(arr)))
+        assert back.dtype == np.asarray(arr).dtype
+        assert back.tobytes() == np.asarray(arr).tobytes()
+
+
+def test_pack_table_roundtrip():
+    st = _filled_state(32, 5)
+    back = codec.unpack_table(codec.pack_table(st))
+    for a, b in zip(st, back):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+@pytest.mark.parametrize("fmt", codec.FORMATS)
+def test_request_roundtrip_all_formats(fmt):
+    spec = _spec()
+    rng = np.random.default_rng(3)
+    rows = rng.standard_normal((4, D)).astype(np.float32)
+    entities = ["card-1", None, "card-2", "card-1"]
+    ts = [10.0, 0.0, 11.0, 12.0]
+    payload = codec.encode_request(rows, entities, ts, fmt, spec=spec)
+    rows2, ents2 = codec.decode_request(payload, fmt, spec)
+    assert rows2.tobytes() == rows.tobytes()
+    want = [
+        None if e is None else (*spec.row_keys(e), float(t))
+        for e, t in zip(entities, ts)
+    ]
+    assert ents2 == want
+    # same entity, any lane → same slot → same owning host
+    assert ents2[0][0] == ents2[3][0]
+
+
+@pytest.mark.parametrize("fmt", codec.FORMATS)
+def test_response_and_503_roundtrip(fmt):
+    scores = np.asarray([0.25, 0.5, 0.875], np.float32)
+    out = codec.decode_response(codec.encode_response(scores, fmt), fmt)
+    assert out.tobytes() == scores.tobytes()
+    payload = codec.encode_unavailable("owner inheriting", 1.5, fmt)
+    with pytest.raises(Unavailable) as ei:
+        codec.decode_response(payload, fmt)
+    assert ei.value.retry_after_s == 1.5
+    assert "inheriting" in str(ei.value)
+
+
+# -- the front --------------------------------------------------------------
+
+
+def _static_front(n_hosts=2, **kw):
+    from fraud_detection_tpu.longhaul.front import LonghaulFront
+
+    view = MembershipView(
+        epoch=3, n_hosts=n_hosts,
+        members=tuple(
+            MemberInfo(f"h{r}", r, f"127.0.0.1:{7400 + r}", True)
+            for r in range(n_hosts)
+        ),
+    )
+    kw.setdefault("probation_s", 0.05)
+    kw.setdefault("retry_after_s", 0.5)
+    return LonghaulFront(_spec(), n_hosts, view=view, token="", **kw)
+
+
+def _stub_call(front, rank, fn):
+    front.handles[rank].call = fn
+
+
+def test_front_groups_rows_by_segment_and_reassembles():
+    front = _static_front()
+    seen: dict[int, list] = {0: [], 1: []}
+
+    def make(rank):
+        def call(op, args, timeout=30.0):
+            assert op == "score"
+            rows = codec.unpack_array(args["rows"])
+            seen[rank].append([tuple(e) for e in args["ents"] if e])
+            return {"scores": codec.pack_array(rows[:, 0].copy())}
+        return call
+
+    _stub_call(front, 0, make(0))
+    _stub_call(front, 1, make(1))
+    rows = np.arange(5 * D, dtype=np.float32).reshape(5, D)
+    # slots 2,4 → segment 0; 3,5 → segment 1; None rides segment 0
+    ents = [(2, 11, 1.0), (3, 12, 1.0), None, (5, 13, 1.0), (4, 14, 1.0)]
+    out = front.score(rows, ents, fmt="json")
+    # request order survives the per-owner scatter/gather
+    np.testing.assert_array_equal(out, rows[:, 0])
+    assert {s for batch in seen[0] for s, _, _ in batch} == {2, 4}
+    assert {s for batch in seen[1] for s, _, _ in batch} == {3, 5}
+
+
+def test_front_backpressure_is_not_a_strike():
+    front = _static_front()
+    _stub_call(
+        front, 1,
+        lambda op, args, timeout=30.0: {
+            "unavailable": True, "retry_after_s": 2.5,
+            "reason": "inheriting",
+        },
+    )
+    with pytest.raises(Unavailable) as ei:
+        front.score(np.ones((1, D), np.float32), [(1, 9, 1.0)])
+    assert ei.value.retry_after_s == 2.5
+    h = front.handles[1]
+    assert h.consecutive_errors == 0 and h.state == "healthy"
+
+
+def test_front_death_probation_and_revival():
+    front = _static_front(death_threshold=2)
+
+    def boom(op, args, timeout=30.0):
+        raise ConnectionError("wire down")
+
+    _stub_call(front, 1, boom)
+    rows, ents = np.ones((1, D), np.float32), [(1, 9, 1.0)]
+    for _ in range(2):
+        with pytest.raises(Unavailable):
+            front.score(rows, ents)
+    assert front.handles[1].state == "dead"
+    # probation: requests shed without touching the dead host
+    with pytest.raises(Unavailable, match="probation"):
+        front.score(rows, ents)
+    time.sleep(0.06)
+    # half-open admits ONE probe; a healthy answer revives
+    _stub_call(
+        front, 1,
+        lambda op, args, timeout=30.0: {
+            "scores": codec.pack_array(np.zeros(1, np.float32))
+        },
+    )
+    front.score(rows, ents)
+    assert front.handles[1].state == "healthy"
+    assert front.handles[1].consecutive_errors == 0
+
+
+def test_front_last_healthy_host_is_never_given_up():
+    front = _static_front(n_hosts=1, death_threshold=2)
+
+    def boom(op, args, timeout=30.0):
+        raise ConnectionError("wire down")
+
+    _stub_call(front, 0, boom)
+    rows, ents = np.ones((1, D), np.float32), [(0, 9, 1.0)]
+    for _ in range(5):
+        with pytest.raises(Unavailable):
+            front.score(rows, ents)
+    h = front.handles[0]
+    # strikes accumulate but the only host we can name stays in rotation
+    assert h.consecutive_errors >= 5 and h.state == "healthy"
+
+
+@pytest.mark.parametrize("fmt", codec.FORMATS)
+def test_front_handles_request_end_to_end_with_503_floor(fmt):
+    spec = _spec()
+    front = _static_front()
+    _stub_call(
+        front, 0,
+        lambda op, args, timeout=30.0: {
+            "scores": codec.pack_array(
+                np.full(
+                    codec.unpack_array(args["rows"]).shape[0],
+                    0.25, np.float32,
+                )
+            )
+        },
+    )
+    _stub_call(
+        front, 1,
+        lambda op, args, timeout=30.0: {
+            "unavailable": True, "retry_after_s": 1.0,
+            "reason": "inheriting",
+        },
+    )
+    rows = np.ones((2, D), np.float32)
+    ok_payload = codec.encode_request(
+        rows, [None, None], [0.0, 0.0], fmt, spec=spec
+    )
+    out = codec.decode_response(
+        front.handle_request(ok_payload, fmt), fmt
+    )
+    np.testing.assert_array_equal(out, [0.25, 0.25])
+    # an entity whose slot lands on the inheriting owner: the 503 floor,
+    # in the caller's own format
+    seg1_entity = next(
+        e for e in (f"card-{i}" for i in range(64))
+        if spec.row_keys(e)[0] % 2 == 1
+    )
+    bad_payload = codec.encode_request(
+        rows[:1], [seg1_entity], [1.0], fmt, spec=spec
+    )
+    resp = front.handle_request(bad_payload, fmt)
+    with pytest.raises(Unavailable) as ei:
+        codec.decode_response(resp, fmt)
+    assert ei.value.retry_after_s == 1.0
+
+
+# -- scrape merge discipline ------------------------------------------------
+
+
+def _window_contrib(host, epoch, base=1.0):
+    leaves = [
+        np.full((4,), base, np.float32),
+        np.full((4,), base, np.float32),
+        np.float32(base),
+        np.full((3,), base, np.float32),
+        np.full((3,), base, np.float32),
+        np.float32(base * 8),
+    ]
+    return {
+        "host_id": host,
+        "epoch": epoch,
+        "rows_seen": int(base * 8),
+        "window": [codec.pack_array(np.asarray(x)) for x in leaves],
+        "slo": {
+            "availability": {
+                "objective": 0.99,
+                "window_good": int(90 * base),
+                "window_bad": int(1 * base),
+                "total_good": int(900 * base),
+                "total_bad": int(10 * base),
+            }
+        },
+    }
+
+
+def test_merge_drift_windows_sums_same_epoch_only():
+    from fraud_detection_tpu.longhaul import scrape
+    from fraud_detection_tpu.service import metrics as svc_metrics
+
+    stale_before = svc_metrics.longhaul_scrape_stale_epoch.labels(
+        "hb"
+    )._value.get()
+    merged, accepted, stale = scrape.merge_drift_windows(
+        [
+            _window_contrib("ha", 5, base=1.0),
+            _window_contrib("hb", 4, base=100.0),  # frozen epoch
+            _window_contrib("hc", 5, base=2.0),
+        ],
+        epoch=5,
+    )
+    assert accepted == ["ha", "hc"] and stale == ["hb"]
+    # the stale host's rows are nowhere in the merge
+    assert float(np.asarray(merged.n_rows)) == 8.0 + 16.0
+    np.testing.assert_allclose(np.asarray(merged[0]), np.full(4, 3.0))
+    after = svc_metrics.longhaul_scrape_stale_epoch.labels(
+        "hb"
+    )._value.get()
+    assert after - stale_before == 1
+
+
+def test_merge_slo_status_burns_from_summed_counts():
+    from fraud_detection_tpu.longhaul import scrape
+
+    agg = scrape.merge_slo_status(
+        [
+            _window_contrib("ha", 5, base=1.0),
+            _window_contrib("hb", 4, base=100.0),  # stale: excluded
+            _window_contrib("hc", 5, base=1.0),
+        ],
+        epoch=5,
+    )
+    a = agg["availability"]
+    assert a["hosts"] == 2
+    assert a["window_good"] == 180 and a["window_bad"] == 2
+    # burn from the SUMS: (2/182) / 0.01
+    assert a["burn_rate"] == pytest.approx(
+        (2 / 182) / 0.01, abs=1e-3
+    )
+    assert a["budget_remaining"] == pytest.approx(
+        1 - a["burn_rate"], abs=1e-9
+    )
+
+
+def test_fleet_scrape_skips_unreachable_hosts():
+    from fraud_detection_tpu.longhaul import scrape
+
+    class Dead:
+        host_id = "hdead"
+
+        def call(self, op, args):
+            raise ConnectionError("gone")
+
+    class Live:
+        host_id = "ha"
+
+        def call(self, op, args):
+            return _window_contrib("ha", 7, base=1.0)
+
+    out = scrape.fleet_scrape([Live(), Dead()], epoch=7)
+    assert out["unreachable"] == ["hdead"]
+    assert out["accepted"] == ["ha"]
+    assert out["rows_seen"] == 8
+
+
+# -- fleet reduce + MapReduce entrants --------------------------------------
+
+
+def test_local_reducer_is_identity():
+    from fraud_detection_tpu.longhaul.fleet import LocalReducer
+
+    r = LocalReducer()
+    a = np.asarray([1.5, 2.5], np.float32)
+    out = r.allreduce([a, np.float32(3.0)])
+    assert out[0].tobytes() == a.tobytes()
+    assert float(out[1]) == 3.0
+
+
+def test_make_reducer_dispatch():
+    from fraud_detection_tpu.longhaul.fleet import (
+        LocalReducer,
+        make_reducer,
+    )
+
+    assert isinstance(make_reducer(n_hosts=1), LocalReducer)
+    with pytest.raises(ValueError, match="coordinator addr"):
+        make_reducer(rank=1, n_hosts=2, addr=None)
+
+
+def _two_rank(fn):
+    """Run ``fn(rank, reducer)`` on two SocketReducer ranks; returns
+    [rank0_result, rank1_result]."""
+    from fraud_detection_tpu.longhaul.fleet import SocketReducer
+
+    r0 = SocketReducer(0, 2, "127.0.0.1:0", token="t")
+    r1 = SocketReducer(1, 2, r0.addr, token="t", timeout=30.0)
+    results = [None, None]
+    errs = []
+
+    def run(rank, red):
+        try:
+            results[rank] = fn(rank, red)
+        except Exception as e:  # surfaced below
+            errs.append(e)
+
+    t = threading.Thread(target=run, args=(1, r1), daemon=True)
+    t.start()
+    try:
+        run(0, r0)
+        t.join(timeout=60.0)
+    finally:
+        r1.close()
+        r0.close()
+    assert not errs, errs
+    return results
+
+
+def test_socket_reducer_rank_order_sum_is_byte_identical():
+    a0 = np.asarray([0.1, 0.2, 0.3], np.float32)
+    a1 = np.asarray([1.0, 2.0, 3.0], np.float32)
+
+    def fn(rank, red):
+        return red.allreduce([a0 if rank == 0 else a1])[0]
+
+    out0, out1 = _two_rank(fn)
+    # both ranks hold the SAME bytes: rank-order sum, one association
+    assert out0.tobytes() == out1.tobytes()
+    assert out0.tobytes() == (a0 + a1).tobytes()
+
+
+def test_fleet_pool_stats_two_hosts_match_single():
+    from fraud_detection_tpu.longhaul.fleet import (
+        LocalReducer,
+        fleet_pool_stats,
+    )
+
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((64, 5)).astype(np.float32)
+    y = (rng.random(64) < 0.3).astype(np.float32)
+    s = rng.random(64).astype(np.float32)
+    single = fleet_pool_stats(x, y, s, LocalReducer())
+
+    def fn(rank, red):
+        half = slice(0, 32) if rank == 0 else slice(32, 64)
+        return fleet_pool_stats(x[half], y[half], s[half], red)
+
+    st0, st1 = _two_rank(fn)
+    assert st0["rows"] == st1["rows"] == single["rows"] == 64
+    assert st0["positives"] == single["positives"]
+    assert st0["hosts"] == 2
+    np.testing.assert_allclose(
+        st0["feature_mean"], single["feature_mean"], rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        st0["feature_std"], single["feature_std"], rtol=1e-4
+    )
+    # fleet replication: both hosts derive identical floats
+    assert (
+        np.asarray(st0["feature_mean"]).tobytes()
+        == np.asarray(st1["feature_mean"]).tobytes()
+    )
+
+
+def test_fleet_sgd_fit_weights_replicate_bitwise():
+    from fraud_detection_tpu.longhaul.fleet import fleet_sgd_fit
+
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal((64, 5)).astype(np.float32)
+    w_true = np.asarray([1.0, -1.0, 0.5, 0.0, 2.0], np.float32)
+    y = (x @ w_true + 0.1 * rng.standard_normal(64) > 0).astype(
+        np.float32
+    )
+
+    def fn(rank, red):
+        half = slice(0, 32) if rank == 0 else slice(32, 64)
+        p = fleet_sgd_fit(
+            x[half], y[half], red, epochs=2, batch_size=16, seed=4
+        )
+        return (
+            np.asarray(p.coef, np.float32).tobytes(),
+            np.asarray(p.intercept, np.float32).tobytes(),
+        )
+
+    (c0, b0), (c1, b1) = _two_rank(fn)
+    # the fleet-replication contract: every host applies the identical
+    # merged gradient bytes, so the weights can never diverge
+    assert c0 == c1 and b0 == b1
+
+
+# -- config + metrics hygiene ----------------------------------------------
+
+
+def test_lifecycle_db_url_refuses_split_brain_fallback(monkeypatch):
+    from fraud_detection_tpu import config
+
+    monkeypatch.delenv("LIFECYCLE_DB_URL", raising=False)
+    monkeypatch.setenv("LONGHAUL_HOSTS", "2")
+    with pytest.raises(RuntimeError, match="LONGHAUL_HOSTS"):
+        config.lifecycle_db_url(broker="fraud://store:7300/0")
+    # a fleet of one keeps the (warned) process-local fallback
+    monkeypatch.setenv("LONGHAUL_HOSTS", "1")
+    url = config.lifecycle_db_url(broker="fraud://store:7300/0")
+    assert url.startswith("sqlite")
+    # an explicit shared DB satisfies the fleet
+    monkeypatch.setenv("LONGHAUL_HOSTS", "2")
+    monkeypatch.setenv("LIFECYCLE_DB_URL", "postgresql://db/fleet")
+    assert config.lifecycle_db_url(
+        broker="fraud://store:7300/0"
+    ) == "postgresql://db/fleet"
+
+
+def test_drop_host_gauges_removes_stale_series():
+    from fraud_detection_tpu.service import metrics
+
+    metrics.longhaul_host_heartbeat_age.labels("h-stale").set(4.2)
+
+    def series():
+        return {
+            s.labels.get("host")
+            for fam in metrics.longhaul_host_heartbeat_age.collect()
+            for s in fam.samples
+        }
+
+    assert "h-stale" in series()
+    metrics.drop_host_gauges("h-stale")
+    assert "h-stale" not in series()
+    # idempotent on never-written hosts
+    metrics.drop_host_gauges("h-never")

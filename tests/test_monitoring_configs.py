@@ -477,6 +477,55 @@ def test_grafana_watchtower_panels_present():
     assert "watchtower_shadow_disagreement" in text
 
 
+def test_longhaul_rules_file_ships():
+    """The longhaul contract (ISSUE 17): longhaul-alerts.yml ships
+    promlint-clean with the four alerts the multi-host switchyard
+    promises."""
+    path = os.path.join(RULES_DIR, "longhaul-alerts.yml")
+    assert os.path.exists(path)
+    assert promlint.lint_rules_file(path) == []
+    with open(path) as f:
+        text = f.read()
+    assert "HostDown" in text
+    assert "MembershipFlapping" in text
+    assert "FailoverStuck" in text
+    assert "FleetBudgetExhausted" in text
+
+
+def test_longhaul_alert_metrics_exist_in_registry():
+    """Every longhaul_* metric an alert references must be exported by
+    service/metrics.py — same contract test as the other rule files."""
+    exported = _exported_metric_names()
+    with open(os.path.join(RULES_DIR, "longhaul-alerts.yml")) as f:
+        text = f.read()
+    referenced = set(re.findall(r"\b(longhaul_[a-z_]+)\b", text))
+    referenced -= {"longhaul_alerts"}  # the file's own name
+    assert referenced, "longhaul rules reference no longhaul metrics?"
+    missing = {
+        name for name in referenced
+        if name not in exported
+        and name.removesuffix("_total") not in exported
+        and f"{name}_total" not in exported
+    }
+    assert not missing, f"alert rules reference unexported metrics: {missing}"
+
+
+def test_grafana_longhaul_row_present():
+    """Both dashboards carry the longhaul fleet panels (membership,
+    routed rows vs the 503 floor, failover replay, fleet SLO budget)."""
+    for rel in (
+        "grafana_dashboard.json",
+        os.path.join("grafana_provisioning", "dashboards", "fraud-tpu.json"),
+    ):
+        with open(os.path.join(MONITORING, rel)) as f:
+            text = f.read()
+        assert "longhaul_hosts_live" in text, rel
+        assert "longhaul_routed_rows_total" in text, rel
+        assert "longhaul_unavailable_total" in text, rel
+        assert "longhaul_replay_rows_per_sec" in text, rel
+        assert "longhaul_fleet_budget_remaining" in text, rel
+
+
 # -- the lint engine itself -------------------------------------------------
 # These pin the STRUCTURAL backend (no promtool, PyYAML required): a real
 # promtool validates different things (e.g. it ignores severity label

@@ -590,3 +590,46 @@ def test_scenario_store_stall_keeps_service_answering(served_app):
     # disarmed: both planes healthy again
     assert client.post("/predict", json={"features": [0.1] * D}).status_code == 200
     assert client.get("/lifecycle/status").status_code == 200
+
+
+@pytest.mark.slow
+def test_scenario_ledger_owner_failover_mid_traffic(tmp_path):
+    """Longhaul (ISSUE 17): one host of a 2-host fleet SIGKILLed
+    mid-traffic — the data plane never answers worse than 503 +
+    Retry-After during the handoff, the survivor replays the dead peer's
+    journal generation and ends owning BOTH segments with the inherited
+    segment (and the scalar counters) bitwise equal to an uninterrupted
+    single-host serve, at zero new fused-flush compiles."""
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario(
+        "ledger_owner_failover_mid_traffic", tmpdir=str(tmp_path)
+    ).raise_if_failed()
+
+
+@pytest.mark.slow
+def test_scenario_host_partition_mid_promotion(tmp_path):
+    """Longhaul (ISSUE 17): a host partitioned from the directory
+    mid-promotion — the partitioned host cannot finalize (directory
+    unreachable = fail-safe), a reachable host holding the stale epoch is
+    fenced by the live epoch check, both refusals are counted, and
+    exactly the post-rejoin finalize under the fresh epoch lands."""
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario(
+        "host_partition_mid_promotion", tmpdir=str(tmp_path)
+    ).raise_if_failed()
+
+
+@pytest.mark.slow
+def test_scenario_split_brain_scrape(tmp_path):
+    """Longhaul (ISSUE 17): a partitioned host keeps serving and
+    answering scrapes under its frozen epoch — the fleet merge drops the
+    stale contribution (counted on longhaul_scrape_stale_epoch_total),
+    the merged window is bitwise the live host's alone, and the healed
+    host is re-admitted under the fresh epoch."""
+    from fraud_detection_tpu.range.scenarios import run_scenario
+
+    run_scenario(
+        "split_brain_scrape", tmpdir=str(tmp_path)
+    ).raise_if_failed()
