@@ -65,6 +65,7 @@ SECTION_BUDGETS = {
     "stateful_flush": 240,
     "quantized_flush": 300,  # + the evergreen GBT parity row
     "explain_flush": 300,    # + the evergreen GBT cost/parity row
+    "kernel_audit": 120,     # chisel: roofline audit of the fused bodies
     "mesh_serving": 300,
     "wide_flush": 300,
     "telemetry": 240,
@@ -523,8 +524,15 @@ STATEFUL_CPU_FLOOR = 0.45
 #: a tree-batched variant only reaches ~0.15), so the CPU gate is a
 #: no-collapse floor, exactly the STATEFUL_CPU_FLOOR precedent. The f32
 #: bitwise-parity and zero-alloc gates are backend-independent and hold
-#: everywhere.
-GBT_EXPLAIN_CPU_FLOOR = 0.05
+#: everywhere. Reconciled for the chisel PR: the original 0.05 was set
+#: defensively before the ratio had a committed measurement; the bench
+#: host now measures 0.1095 (2026-08, x86_64 CPU runner, 16 trees at
+#: depth 3) and BENCH_TRAJECTORY.json carries the number, so the floor
+#: rises to 0.08 — below the measured value by honest shared-runner
+#: slack, no longer below half of it. (The chisel Pallas kernel does not
+#: move this CPU gate: off-TPU it runs in interpret mode, which is a
+#: correctness path, not a perf path — see docs/KERNELS.md.)
+GBT_EXPLAIN_CPU_FLOOR = 0.08
 
 #: CPU-runner ceiling for the lifeboat journal hook's flush-loop overhead
 #: (JOURNAL vs OFF in bench_recovery). The hook is fixed host-side work —
@@ -1267,6 +1275,53 @@ def bench_explain_flush(x, coef, intercept, mean, scale) -> dict[str, float]:
     g_barrier()
     gbt_steady_allocs = gscorer.staging.allocations - galloc_before
 
+    # ---- chisel: roofline placement of the exact-TreeSHAP explain body,
+    # before (XLA dense expansion) vs after (Pallas kernel). The kernel is
+    # a real perf path only on a TPU — off-TPU it runs the interpreter, so
+    # the "after" utilization is honestly reported as unmeasured with the
+    # reason, never a fabricated number. The XLA leg's measured placement
+    # is what earned the kernel: memory-bound far below its ceiling.
+    import importlib
+
+    import jax
+
+    from fraud_detection_tpu.telemetry import roofline
+
+    ts_mod = importlib.import_module("fraud_detection_tpu.ops.tree_shap")
+    xs = jnp.asarray(np.stack(rows_list))
+
+    def _roofline_leg(use_kernel: bool) -> dict:
+        f = jax.jit(
+            lambda e, xx: ts_mod._raw_tree_shap(
+                e.model, e.bg_table, xx, use_kernel=use_kernel
+            )
+        )
+        ca = f.lower(gexplainer, xs).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+        jax.block_until_ready(f(gexplainer, xs))
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(gexplainer, xs))
+            best = min(best, time.perf_counter() - t0)
+        return roofline.classify_program(flops, nbytes, best)
+
+    rl_before = _roofline_leg(False)
+    if jax.default_backend() == "tpu":
+        rl_after = _roofline_leg(True)
+    else:
+        rl_after = {
+            "utilization": None,
+            "verdict": "unmeasured",
+            "reason": "chisel kernel runs in interpret mode off-TPU — not "
+            "a perf path; TPU numbers live in the dispatch-gate docstring "
+            "and docs/KERNELS.md",
+        }
+
     return {
         "explain_flushes_per_sec": explain_rate,
         "plain_flushes_per_sec": plain_rate,
@@ -1288,7 +1343,167 @@ def bench_explain_flush(x, coef, intercept, mean, scale) -> dict[str, float]:
         "gbt_explain_staging_steady_allocations": float(gbt_steady_allocs),
         "gbt_trees": float(_GBT_BENCH_TREES),
         "gbt_depth": float(_GBT_BENCH_DEPTH),
+        # chisel: roofline placement of the explain body, XLA vs kernel
+        "gbt_explain_roofline_before": rl_before,
+        "gbt_explain_roofline_after": rl_after,
     }
+
+
+def bench_kernel_audit() -> dict:
+    """Chisel roofline audit (ISSUE 20) of the OTHER fused serving bodies:
+    the ledger entity scatter chain, the broadside wide gather body, and
+    the quickwire dequant branch, each placed on the measured device
+    roofline (``telemetry/roofline.classify_program`` — matmul-probe peak
+    FLOP/s, stream-probe peak B/s). For each program the audit records
+    arithmetic intensity, the utilization *ceiling* the roofline permits,
+    measured utilization, and the verdict: ``kernel-candidate`` when
+    achieved falls below ``KERNEL_CANDIDATE_SLACK × ceiling`` (a hand
+    kernel has headroom), ``compiler-wins`` otherwise. Compiler-wins rows
+    are recorded, not hidden — they are the honest negative results the
+    audit method exists to produce (docs/KERNELS.md carries the
+    decisions). Programs are traced through their UNJITTED bodies under a
+    local non-donating jit so the audit neither invalidates donated
+    buffers nor pollutes the serving jit caches."""
+    import jax
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.ledger.state import device_state
+    from fraud_detection_tpu.monitor.baseline import (
+        N_FEATURE_BINS,
+        N_SCORE_BINS,
+    )
+    from fraud_detection_tpu.monitor.drift import (
+        N_CALIB_BINS,
+        DriftWindow,
+        _fused_flush_ledger,
+        _fused_flush_quant,
+        _fused_flush_wide,
+    )
+    from fraud_detection_tpu.ops.crosses import CrossSpec
+    from fraud_detection_tpu.ops.scorer import _raw_score_linear
+    from fraud_detection_tpu.telemetry import roofline
+
+    b, d = 1024, 30
+    k_ledger, n_cross = 4, 4
+    rng = np.random.default_rng(7)
+
+    def _window(width: int) -> DriftWindow:
+        return DriftWindow(
+            feature_counts=jnp.zeros((width, N_FEATURE_BINS), jnp.float32),
+            score_counts=jnp.zeros((N_SCORE_BINS,), jnp.float32),
+            calib_count=jnp.zeros((N_CALIB_BINS,), jnp.float32),
+            calib_conf=jnp.zeros((N_CALIB_BINS,), jnp.float32),
+            calib_label=jnp.zeros((N_CALIB_BINS,), jnp.float32),
+            n_rows=jnp.zeros((), jnp.float32),
+        )
+
+    def _edges(width: int):
+        fe = jnp.asarray(
+            np.sort(rng.normal(size=(width, N_FEATURE_BINS - 1)), axis=1),
+            jnp.float32,
+        )
+        se = jnp.linspace(0.0, 1.0, N_SCORE_BINS - 1, dtype=jnp.float32)
+        return fe, se
+
+    def _classify(fn, args) -> dict:
+        f = jax.jit(fn)
+        ca = f.lower(*args).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+        flops = float(ca.get("flops", 0.0) or 0.0)
+        nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+        jax.block_until_ready(f(*args))
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(*args))
+            best = min(best, time.perf_counter() - t0)
+        return roofline.classify_program(flops, nbytes, best)
+
+    out: dict = {}
+    decay = jnp.float32(0.97)
+    valid = jnp.ones((b,), jnp.float32)
+    xf = jnp.asarray(rng.normal(size=(b, d)), jnp.float32)
+
+    # -- quickwire dequant branch -----------------------------------------
+    fe, se = _edges(d)
+    xq = jnp.asarray(rng.integers(-128, 128, size=(b, d)), jnp.int8)
+    dq = jnp.asarray(np.abs(rng.normal(size=(d,))) + 0.1, jnp.float32)
+    score_args = (
+        jnp.asarray(rng.normal(size=(d,)), jnp.float32),
+        jnp.float32(0.0),
+    )
+    out["quant_dequant"] = _classify(
+        lambda w, xx, vv, dd, f_e, s_e, sa, q: (
+            _fused_flush_quant.__wrapped__(
+                w, xx, vv, dd, f_e, s_e, sa, q,
+                score_fn=_raw_score_linear, score_codes=True,
+                out_dtype=jnp.uint8,
+            )
+        ),
+        (_window(d), xq, valid, decay, fe, se, score_args, dq),
+    )
+
+    # -- ledger scatter chain ---------------------------------------------
+    wide_d = d + k_ledger
+    fe_w, _ = _edges(wide_d)
+    ledger = device_state(None, 1 << 12)
+    score_args_w = (
+        jnp.asarray(rng.normal(size=(wide_d,)), jnp.float32),
+        jnp.float32(0.0),
+    )
+    slot_idx = jnp.asarray(
+        rng.integers(0, 1 << 12, size=(b,)), jnp.int32
+    )
+    fp = jnp.asarray(rng.integers(1, 1 << 31, size=(b,)), jnp.uint32)
+    ts = jnp.asarray(np.cumsum(np.abs(rng.normal(size=(b,)))), jnp.float32)
+    has_entity = jnp.ones((b,), jnp.float32)
+    null_features = jnp.zeros((k_ledger,), jnp.float32)
+    halflife = jnp.float32(3600.0)
+    out["ledger_scatter"] = _classify(
+        lambda w, led, xx, vv, dd, f_e, s_e, sa, si, f_p, t_s, he, nf, hl: (
+            _fused_flush_ledger.__wrapped__(
+                w, led, xx, vv, dd, f_e, s_e, sa, si, f_p, t_s, he, nf, hl,
+                None, None,
+                score_fn=_raw_score_linear, explain_k=0, amount_col=d - 1,
+            )
+        ),
+        (
+            _window(wide_d), ledger, xf, valid, decay, fe_w, se,
+            score_args_w, slot_idx, fp, ts, has_entity, null_features,
+            halflife,
+        ),
+    )
+
+    # -- broadside wide gather body ---------------------------------------
+    cross_d = d + n_cross
+    fe_c, _ = _edges(cross_d)
+    spec = CrossSpec(n_base=d, log2_buckets=12, amount_col=d - 1)
+    score_args_c = (
+        jnp.asarray(rng.normal(size=(cross_d,)), jnp.float32),
+        jnp.float32(0.0),
+    )
+    wide_table = jnp.asarray(
+        rng.normal(size=(spec.buckets,)), jnp.float32
+    )
+    out["wide_gather"] = _classify(
+        lambda w, xx, vv, dd, f_e, s_e, sa, wt, f_p, he: (
+            _fused_flush_wide.__wrapped__(
+                w, xx, vv, dd, f_e, s_e, sa, wt, f_p, he, None, None,
+                cross_spec=spec, explain_k=0, out_dtype=jnp.float32,
+            )
+        ),
+        (
+            _window(cross_d), xf, valid, decay, fe_c, se, score_args_c,
+            wide_table, fp, has_entity,
+        ),
+    )
+
+    out["kernel_candidate_slack"] = roofline.KERNEL_CANDIDATE_SLACK
+    out["peak_flops"] = roofline.ensure_peak()
+    out["peak_bytes_per_s"] = roofline.ensure_membw()
+    return out
 
 
 def bench_mesh_serving() -> dict:
@@ -3085,6 +3300,24 @@ def main() -> None:
             gbt_explain_zero_alloc_ok=bool(
                 ef_res["gbt_explain_staging_steady_allocations"] == 0
             ),
+            # chisel: the explain body's roofline placement before (XLA
+            # dense expansion) and after (Pallas kernel — measured only
+            # where it is a real perf path, i.e. on a TPU; off-TPU the
+            # pair records the honest unmeasured reason)
+            gbt_explain_roofline_before=ef_res["gbt_explain_roofline_before"],
+            gbt_explain_roofline_after=ef_res["gbt_explain_roofline_after"],
+        )
+    ka_res = h.section("kernel_audit", bench_kernel_audit)
+    if ka_res:
+        # chisel: the audited fused bodies' roofline rows — each carries
+        # its ceiling, measured utilization, and verdict (kernel-candidate
+        # vs compiler-wins); docs/KERNELS.md records what the verdicts
+        # decided
+        h.update(
+            kernel_audit_quant_dequant=ka_res["quant_dequant"],
+            kernel_audit_ledger_scatter=ka_res["ledger_scatter"],
+            kernel_audit_wide_gather=ka_res["wide_gather"],
+            kernel_audit_slack=ka_res["kernel_candidate_slack"],
         )
     mesh_res = h.section("mesh_serving", bench_mesh_serving)
     if mesh_res:

@@ -23,6 +23,13 @@ entrypoint carries a declarative :class:`Contract`:
   the raw body).
 - **output dtypes**: the wire contract — e.g. quickwire's uint8 score
   codes, lantern's float16 reason values — pinned per flat output leaf.
+- **pallas budget**: ``pallas_call`` is a first-class primitive in the
+  contract — allowed (with an exact static count) where an entrypoint
+  declares ``pallas_calls``, and counted as a ``forbidden-primitive``
+  where it does not. A hand kernel sneaking into an uncontracted serving
+  body, or a dispatch-gate regression silently dropping a contracted
+  kernel back to the XLA fallback, both fail CI by name (the chisel
+  entrypoints pin the TreeSHAP kernel this way).
 
 The checker reuses meshcheck's registry and virtual CPU meshes: it builds
 each entrypoint at its largest registered mesh size, traces it with
@@ -98,6 +105,9 @@ class Contract:
     forbid: tuple[str, ...] = DEFAULT_FORBID
     #: dtype names of the flat output leaves (None = unpinned)
     out_dtypes: tuple[str, ...] | None = None
+    #: exact static ``pallas_call`` count the program may contain; 0
+    #: (default) makes any pallas_call a forbidden-primitive violation
+    pallas_calls: int = 0
     notes: str = ""
 
 
@@ -167,6 +177,18 @@ def forbidden_hits(closed_jaxpr, forbid: Iterable[str]) -> Counter:
         if eqn.primitive.name in forbid:
             hits[eqn.primitive.name] += 1
     return hits
+
+
+def count_pallas_calls(closed_jaxpr) -> int:
+    """Static ``pallas_call`` occurrences in the whole recursively walked
+    program (the kernel's inner jaxpr rides the eqn's ``jaxpr`` param, so
+    :func:`iter_eqns` also walks INTO kernels — forbidden primitives
+    can't hide inside one)."""
+    return sum(
+        1
+        for eqn in iter_eqns(closed_jaxpr.jaxpr)
+        if eqn.primitive.name == "pallas_call"
+    )
 
 
 # --------------------------------------------------------------------------
@@ -362,6 +384,33 @@ def check_contract(con: Contract, ep=None, root: str | None = None) -> dict:
             "detail": f"{name} appears {n}x (host sync on a serving path)",
         })
 
+    pallas_got = count_pallas_calls(closed)
+    if pallas_got != con.pallas_calls:
+        if con.pallas_calls == 0:
+            res["violations"].append({
+                "diagnostic": "forbidden-primitive",
+                "detail": (
+                    f"pallas_call appears {pallas_got}x — not budgeted in "
+                    "this entrypoint's contract (declare pallas_calls)"
+                ),
+            })
+        elif pallas_got == 0:
+            res["violations"].append({
+                "diagnostic": "missing-pallas",
+                "detail": (
+                    f"contract budgets {con.pallas_calls} pallas_call(s), "
+                    "program has none — the dispatch gate fell back to XLA"
+                ),
+            })
+        else:
+            res["violations"].append({
+                "diagnostic": "pallas-count",
+                "detail": (
+                    f"contract budgets {con.pallas_calls} pallas_call(s), "
+                    f"program has {pallas_got}"
+                ),
+            })
+
     if con.out_dtypes is not None:
         got_dtypes = tuple(str(v.aval.dtype) for v in closed.jaxpr.outvars)
         if got_dtypes != tuple(con.out_dtypes):
@@ -528,6 +577,36 @@ for _con in (
         donate=(0,),
         donate_site=DonateSite(_DRIFT, "_fused_flush_wide", (0,)),
         out_dtypes=("float32", "uint8", "float32") + _WINDOW,
+    ),
+    # -- chisel: the TreeSHAP Pallas-kernel bodies. Exactly ONE pallas_call
+    # budgeted per program (the tree loop rides the kernel grid, not N
+    # calls); zero collectives preserved; wire dtypes identical to the XLA
+    # bodies they replace — a silent fallback to XLA is a missing-pallas
+    # violation, a second kernel creeping in is a count violation ----------
+    Contract(
+        "chisel.tree_shap",
+        out_dtypes=("float32",),
+        pallas_calls=1,
+        notes="the standalone TreeSHAP batch forced onto the chisel "
+        "kernel — same wire as tree_shap.batch",
+    ),
+    Contract(
+        "chisel.lantern_flush",
+        donate=(0,),
+        donate_site=DonateSite(_DRIFT, "_fused_flush_explain", (0,)),
+        out_dtypes=("float32", "uint8", "float32") + _WINDOW,
+        pallas_calls=1,
+        notes="GBT f32-wire explain flush on the kernel body — wire and "
+        "donation identical to lantern.flush",
+    ),
+    Contract(
+        "chisel.evergreen_flush",
+        donate=(0,),
+        donate_site=DonateSite(_DRIFT, "_fused_flush_quant_explain", (0,)),
+        out_dtypes=("uint8", "uint8", "float16") + _WINDOW,
+        pallas_calls=1,
+        notes="GBT quant-wire explain flush on the kernel body — wire and "
+        "donation identical to evergreen.flush",
     ),
     # -- mesh serving flushes: ONE shard_map dispatch, zero collectives
     # (the bitwise N-shard contract), per-shard windows donated ------------

@@ -552,6 +552,138 @@ def _build_evergreen_flush(mesh: Mesh):
     )
 
 
+# -- chisel: the TreeSHAP Pallas-kernel entrypoints -------------------------
+# The same programs as tree_shap.batch / the GBT explain flushes, FORCED
+# onto the chisel kernel body (``force_tree_shap_kernel`` is entered inside
+# the returned fn, so it is live whenever the checker traces it — abstract,
+# nothing executes; off-TPU the body traces in interpret mode). This proves
+# the kernel path composes at every mesh size and lets its contract budget
+# exactly one ``pallas_call`` with zero hot-path collectives — a gate
+# regression that silently falls back to XLA fails as ``missing-pallas``.
+
+
+@register_entrypoint("chisel.tree_shap")
+def _build_chisel_tree_shap(mesh: Mesh):
+    from fraud_detection_tpu.ops import pallas_kernels as pk
+    from fraud_detection_tpu.ops.tree_shap import _raw_tree_shap
+
+    explainer = _abstract_tree_explainer(mesh)
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, P(DATA_AXIS))
+
+    def fn(e, xx):
+        with pk.force_tree_shap_kernel(True):
+            return _raw_tree_shap(e.model, e.bg_table, xx)
+
+    return fn, (explainer, x)
+
+
+@register_entrypoint("chisel.lantern_flush")
+def _build_chisel_lantern_flush(mesh: Mesh):
+    """The GBT lantern flush (f32 wire, TreeSHAP reason codes) on the
+    chisel kernel body — the serve-time program the kernel actually rides,
+    wire and donation identical to ``lantern.flush``."""
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import (
+        N_CALIB_BINS,
+        DriftWindow,
+        _fused_flush_explain,
+    )
+    from fraud_detection_tpu.ops import pallas_kernels as pk
+    from fraud_detection_tpu.ops.scorer import _raw_score_gbt
+
+    window = DriftWindow(
+        feature_counts=sds((_FEATURES, N_FEATURE_BINS), jnp.float32, mesh, P()),
+        score_counts=sds((N_SCORE_BINS,), jnp.float32, mesh, P()),
+        calib_count=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_conf=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_label=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        n_rows=sds((), jnp.float32, mesh, P()),
+    )
+    x = sds((_ROWS, _FEATURES), jnp.float32, mesh, P(DATA_AXIS))
+    valid = sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((_FEATURES, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = _abstract_gbt_model(mesh)
+    explain_args = _abstract_tree_explainer(mesh)
+
+    # trace the UNJITTED body: the jitted wrapper caches its jaxpr by
+    # avals+statics, which are identical to the plain GBT lantern trace —
+    # the force flag is trace-time state the cache key cannot see, so a
+    # cache hit in either direction would swap kernel/XLA bodies silently.
+    # inspect.unwrap, not one .__wrapped__ hop: if the app ever ran in
+    # this process, the compile sentinel has rebound the name to its own
+    # wrapper and a single hop lands back on the jitted (cached) function
+    import inspect
+
+    raw_flush = inspect.unwrap(_fused_flush_explain)
+
+    def fn(w, xx, vv, dd, fe, se, sa, ea):
+        with pk.force_tree_shap_kernel(True):
+            return raw_flush(
+                w, xx, vv, dd, fe, se, sa, ea,
+                score_fn=_raw_score_gbt, explain_k=3,
+            )
+
+    return fn, (
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        explain_args,
+    )
+
+
+@register_entrypoint("chisel.evergreen_flush")
+def _build_chisel_evergreen_flush(mesh: Mesh):
+    """The evergreen quant-wire GBT explain flush on the chisel kernel
+    body — the harshest wire/kernel combo (explicit dequant feeding the
+    kernel, uint8/f16 return wire)."""
+    from fraud_detection_tpu.monitor.baseline import N_FEATURE_BINS, N_SCORE_BINS
+    from fraud_detection_tpu.monitor.drift import (
+        N_CALIB_BINS,
+        DriftWindow,
+        _fused_flush_quant_explain,
+    )
+    from fraud_detection_tpu.ops import pallas_kernels as pk
+    from fraud_detection_tpu.ops.scorer import _raw_score_gbt
+
+    window = DriftWindow(
+        feature_counts=sds((_FEATURES, N_FEATURE_BINS), jnp.float32, mesh, P()),
+        score_counts=sds((N_SCORE_BINS,), jnp.float32, mesh, P()),
+        calib_count=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_conf=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        calib_label=sds((N_CALIB_BINS,), jnp.float32, mesh, P()),
+        n_rows=sds((), jnp.float32, mesh, P()),
+    )
+    x = sds((_ROWS, _FEATURES), jnp.int8, mesh, P(DATA_AXIS))
+    valid = sds((_ROWS,), jnp.float32, mesh, P(DATA_AXIS))
+    decay = sds((), jnp.float32, mesh, P())
+    feature_edges = sds((_FEATURES, N_FEATURE_BINS - 1), jnp.float32, mesh, P())
+    score_edges = sds((N_SCORE_BINS - 1,), jnp.float32, mesh, P())
+    score_args = _abstract_gbt_model(mesh)
+    dq = sds((_FEATURES,), jnp.float32, mesh, P())
+    explain_args = _abstract_tree_explainer(mesh)
+
+    # unjitted body for the same cache-hazard reason as chisel.lantern_flush:
+    # evergreen.flush traces the SAME avals/statics through the jitted
+    # wrapper, and whichever traced first would hand the other its body
+    # (inspect.unwrap to punch through a sentinel wrapper too — see there)
+    import inspect
+
+    raw_flush = inspect.unwrap(_fused_flush_quant_explain)
+
+    def fn(w, xx, vv, dd, fe, se, sa, qs, ea):
+        with pk.force_tree_shap_kernel(True):
+            return raw_flush(
+                w, xx, vv, dd, fe, se, sa, qs, ea,
+                score_fn=_raw_score_gbt, score_codes=False, explain_k=3,
+                out_dtype=jnp.uint8,
+            )
+
+    return fn, (
+        window, x, valid, decay, feature_edges, score_edges, score_args,
+        dq, explain_args,
+    )
+
+
 @register_entrypoint("mesh.evergreen_flush")
 def _build_mesh_evergreen_flush(mesh: Mesh):
     """The evergreen mesh flush: the GBT dequant·score·TreeSHAP·drift

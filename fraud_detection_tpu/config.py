@@ -83,10 +83,12 @@ def mesh_model_axis() -> int:
 
 def use_pallas() -> str:
     """``1``/``0``/``auto`` — hand-written Pallas kernels for the hot ops
-    (ops/pallas_kernels). Per-kernel ``auto``: the blocked SMOTE k-NN is ON
-    for TPU backends (beats the XLA path at scale — see knn_pallas_enabled),
+    (ops/pallas_kernels; per-kernel gate table in docs/KERNELS.md).
+    Per-kernel ``auto``: the blocked SMOTE k-NN and the chisel TreeSHAP
+    kernel are ON for TPU backends (each beat the XLA path — see
+    knn_pallas_enabled / tree_shap_pallas_enabled for the measured notes),
     the scoring GEMV stays OFF (XLA's fusion wins at d=30 — see
-    pallas_enabled). ``1`` forces both on, ``0`` both off."""
+    pallas_enabled). ``1`` forces all on, ``0`` all off."""
     return _get("USE_PALLAS", "auto").lower()
 
 
@@ -646,6 +648,36 @@ def device_peak_flops() -> float:
     probe (an honest achievable-peak proxy on any backend; a TPU
     deployment should pin the datasheet number here)."""
     return _get_float("DEVICE_PEAK_FLOPS", 0.0)
+
+
+def device_peak_bytes_per_s() -> float:
+    """``DEVICE_PEAK_BYTES_PER_S`` — peak memory bandwidth (bytes/s) the
+    roofline audit divides by to place the ridge point. 0 (default) =
+    measure once with a streaming-copy probe (telemetry/roofline
+    ``ensure_membw``); a TPU deployment should pin the datasheet HBM
+    number here (e.g. 8.1e11 for a v5e)."""
+    return _get_float("DEVICE_PEAK_BYTES_PER_S", 0.0)
+
+
+def chisel_interpret() -> bool:
+    """``CHISEL_INTERPRET=1`` — dispatch the chisel TreeSHAP Pallas kernel
+    in interpreter mode on non-TPU backends. The CPU CI kernel-parity job
+    sets this so the kernel body (not just the XLA fallback) runs under
+    tier-1; it is a correctness switch, not a performance one — the
+    interpreter is orders of magnitude slower than the XLA fallback on
+    CPU. Off by default; on a TPU the kernel dispatches natively via
+    ``USE_PALLAS`` (see ops/pallas_kernels.tree_shap_pallas_enabled)."""
+    return env_flag("CHISEL_INTERPRET") is True
+
+
+def explain_background_seed() -> int:
+    """``EXPLAIN_BG_SEED`` — RNG seed for the explainer's background
+    subsample (ops/tree_shap.build_tree_explainer). Threaded from config
+    so a hindsight-style replay of an explainer build is deterministic by
+    construction: the same model + background + seed reproduces the same
+    ``bg_table`` bitwise, and an operator can vary the subsample without
+    code changes."""
+    return _get_int("EXPLAIN_BG_SEED", 0)
 
 
 # --------------------------------------------------------------------------

@@ -103,14 +103,19 @@ class FraudGBTModel(FraudModelBase):
         background, api/worker.py:52-53). Built once and cached; the SAME
         explainer pytree rides ``FusedSpec.explain_args`` into the fused
         serve-time reason codes, so the worker backfill and the fused leg
-        share one background table by construction."""
+        share one background table by construction. The background
+        subsample seed threads from ``config.explain_background_seed()``
+        so the build replays deterministically."""
         if self._raw_explainer is None:
+            from fraud_detection_tpu import config
             from fraud_detection_tpu.ops.tree_shap import build_tree_explainer
 
             bg = self.background
             if bg is None:
                 bg = np.zeros((1, len(self.feature_names)), np.float32)
-            self._raw_explainer = build_tree_explainer(self.model, bg)
+            self._raw_explainer = build_tree_explainer(
+                self.model, bg, seed=config.explain_background_seed()
+            )
         return self._raw_explainer
 
     def explain_batch(self, x: np.ndarray) -> tuple[np.ndarray, float]:

@@ -115,15 +115,28 @@ def _dup_structure(feat):
 
 
 def build_tree_explainer(
-    model: GBTModel, background_x, max_background: int = 128, seed: int = 0
+    model: GBTModel,
+    background_x,
+    max_background: int = 128,
+    seed: int | None = None,
 ) -> TreeShapExplainer:
     """Precompute the background expectation table over a (subsampled)
     background set, in the model's input space (raw if the model's edges are
-    scaler-folded)."""
+    scaler-folded).
+
+    ``seed`` pins the background subsample; ``None`` (default) resolves
+    ``config.explain_background_seed()`` so a hindsight-style replay of an
+    explainer build is deterministic by construction — the same model +
+    background + seed reproduces ``bg_table`` bitwise (pinned by
+    tests/test_tree_shap.py)."""
+    from fraud_detection_tpu import config
+
     bg = np.asarray(background_x, np.float32)
     if bg.ndim == 1:
         bg = bg[None, :]
     if bg.shape[0] > max_background:
+        if seed is None:
+            seed = config.explain_background_seed()
         idx = np.random.default_rng(seed).choice(
             bg.shape[0], max_background, replace=False
         )
@@ -158,7 +171,10 @@ def build_tree_explainer(
 
 
 def _raw_tree_shap(
-    model: GBTModel, bg_table: jax.Array, x: jax.Array
+    model: GBTModel,
+    bg_table: jax.Array,
+    x: jax.Array,
+    use_kernel: bool | None = None,
 ) -> jax.Array:
     """Un-jitted batched TreeSHAP body — the evergreen fusion surface.
 
@@ -171,13 +187,37 @@ def _raw_tree_shap(
     construction. SHAP values are (n, d) in margin (logit) space; exact:
     ``Σ_j φ_j + expected_value == gbt_predict_logits(model, x)``.
 
-    Batched so NO scatter exists (r5 — the previous vmap-over-rows form
-    segment-summed per (row, tree): a batched scatter on the TPU's
-    scatter/gather unit; measured 228k rows/s honest on the chip): the
-    tree scan runs over all-rows tensors and the per-feature scatter is a
-    one-hot matmul on the MXU (HIGHEST precision — exact for these
-    operands' f32 values). The remaining index ops are shared-index
+    Dispatch (chisel): on a TPU backend the body is the Pallas kernel
+    ``ops/pallas_kernels.tree_shap_pallas`` — same decomposition, three
+    chained MXU matmuls per (row-block, tree) with the per-tree tables
+    streamed from HBM (gate + measured numbers:
+    ``tree_shap_pallas_enabled``). Because the dispatch happens INSIDE
+    this shared body, standalone/fused/mesh callers all trace the same
+    branch and the bitwise fused-vs-standalone contract survives the
+    kernel swap; kernel-vs-XLA-fallback parity is tolerance-gated (the
+    matmuls reassociate the f32 sums) with ``tree_shap_topk`` index
+    parity. The gate is read at TRACE time — flipping ``USE_PALLAS``
+    mid-process does not retrace cached executables; ``use_kernel``
+    forces a branch explicitly (tests/bench), or use
+    ``pallas_kernels.force_tree_shap_kernel``.
+
+    XLA fallback: batched so NO scatter exists (r5 — the previous
+    vmap-over-rows form segment-summed per (row, tree): a batched scatter
+    on the TPU's scatter/gather unit; measured 228k rows/s honest on the
+    chip): the tree scan runs over all-rows tensors and the per-feature
+    scatter is a one-hot matmul on the MXU (HIGHEST precision — exact for
+    these operands' f32 values). The remaining index ops are shared-index
     gathers (column permutations), which vectorize."""
+    from fraud_detection_tpu.ops import pallas_kernels as pk
+
+    depth_model = int(np.log2(model.split_feature.shape[1] + 1))
+    if use_kernel is None:
+        use_kernel = pk.tree_shap_pallas_enabled() and depth_model <= 5
+    if use_kernel:
+        return pk.tree_shap_pallas(
+            model, bg_table, x, interpret=jax.default_backend() != "tpu"
+        )
+
     d_features = model.bin_edges.shape[0]
     depth = int(np.log2(model.split_feature.shape[1] + 1))
     anc, direc, bits_np, pair_np = _tree_static(depth)

@@ -37,6 +37,14 @@ gauge is an end-to-end utilization (the number that bounds throughput),
 not a pure-MXU duty cycle — documented in docs/OBSERVABILITY.md. The
 DeviceUtilizationCollapse alert (slo-alerts.yml) fires when a serving
 entrypoint's utilization collapses while flushes keep flowing.
+
+The chisel kernel audit rides the same capture: :func:`audit` places every
+captured fused program on the roofline (arithmetic intensity vs the ridge
+point from :func:`ensure_peak` / :func:`ensure_membw`), computes the
+utilization *ceiling* the roofline permits, and grades measured
+utilization against it — ``kernel-candidate`` where a hand-written kernel
+has headroom, ``compiler-wins`` where XLA already sits near the ceiling.
+docs/KERNELS.md records the method and the decisions it produced.
 """
 
 from __future__ import annotations
@@ -56,6 +64,7 @@ _lock = threading.Lock()
 #: (entrypoint, bucket) → {"flops": float, "bytes": float}
 _costs: dict[tuple[str, int], dict] = {}
 _peak_flops: float = 0.0
+_peak_bytes_per_s: float = 0.0
 #: entrypoint → EWMA'd utilization (mirrors the gauge for /slo/status)
 _util: dict[str, float] = {}
 _util_gauges: dict[str, object] = {}
@@ -221,12 +230,149 @@ def ensure_peak() -> float:
     return _peak_flops
 
 
+def ensure_membw() -> float:
+    """Resolve the peak memory-bandwidth denominator once: the pinned
+    ``DEVICE_PEAK_BYTES_PER_S``, else a streaming add probe (reads + writes
+    a 32 MiB f32 block; like the matmul probe, an *achieved*-peak proxy —
+    the ridge point it places is what this device demonstrably streams,
+    not a datasheet number nobody measured)."""
+    global _peak_bytes_per_s
+    if _peak_bytes_per_s > 0.0:
+        return _peak_bytes_per_s
+    pinned = config.device_peak_bytes_per_s()
+    if pinned > 0.0:
+        _peak_bytes_per_s = pinned
+        return pinned
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        n = 1 << 23  # 8M f32 = 32 MiB; the add moves 2x that per run
+        a = jnp.ones((n,), jnp.float32)
+        f = jax.jit(lambda x: x + 1.0)
+        f(a).block_until_ready()  # compile + first run off the clock
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            f(a).block_until_ready()
+            dt = time.perf_counter() - t0
+            if dt > 0:
+                best = max(best, (2.0 * 4.0 * n) / dt)
+        if best > 0.0:
+            _peak_bytes_per_s = best
+            log.info("roofline: stream-probe membw ≈ %.3g B/s", best)
+    except Exception:
+        log.warning("roofline membw probe failed; audit classification "
+                    "unavailable", exc_info=True)
+    return _peak_bytes_per_s
+
+
+#: A program earning less than this fraction of its roofline ceiling is a
+#: hand-kernel candidate; at or above it the compiler is already close
+#: enough to the ceiling that a kernel's upside is inside measurement
+#: noise (the chisel audit's decision bar — compiler-wins is a recorded
+#: outcome, not a failure).
+KERNEL_CANDIDATE_SLACK = 0.6
+
+
+def classify_program(
+    flops: float,
+    nbytes: float,
+    seconds: float | None = None,
+    *,
+    peak_flops: float | None = None,
+    peak_bytes_per_s: float | None = None,
+) -> dict:
+    """Place one program on the roofline.
+
+    Returns arithmetic intensity (FLOP/byte), the device ridge point
+    (``peak_flops / peak_bytes_per_s``), the utilization *ceiling* the
+    roofline permits (``min(1, AI/ridge)`` — a memory-bound program
+    CANNOT reach 1.0 no matter how good its kernel is), the bound verdict
+    (``memory`` below the ridge, ``compute`` at/above), and — when a
+    measured duration is supplied — the achieved utilization plus the
+    audit verdict: ``kernel-candidate`` when achieved falls below
+    ``KERNEL_CANDIDATE_SLACK × ceiling``, ``compiler-wins`` otherwise.
+    Peaks default to the resolved probe values; pass overrides for
+    deterministic tests."""
+    peak = peak_flops if peak_flops is not None else ensure_peak()
+    bw = (
+        peak_bytes_per_s
+        if peak_bytes_per_s is not None
+        else ensure_membw()
+    )
+    out: dict = {
+        "flops": flops,
+        "bytes": nbytes,
+        "arithmetic_intensity": (flops / nbytes) if nbytes > 0 else None,
+        "ridge": None,
+        "ceiling": None,
+        "bound": None,
+        "utilization": None,
+        "verdict": "unmeasured",
+    }
+    if peak <= 0.0 or bw <= 0.0 or nbytes <= 0.0 or flops <= 0.0:
+        return out
+    ai = flops / nbytes
+    ridge = peak / bw
+    ceiling = min(1.0, ai / ridge)
+    out.update(
+        ridge=ridge,
+        ceiling=ceiling,
+        bound="memory" if ai < ridge else "compute",
+    )
+    if seconds is not None and seconds > 0.0:
+        util = flops / seconds / peak
+        out["utilization"] = util
+        out["verdict"] = (
+            "kernel-candidate"
+            if util < KERNEL_CANDIDATE_SLACK * ceiling
+            else "compiler-wins"
+        )
+    return out
+
+
+def audit() -> dict:
+    """The roofline audit over every captured fused program: classify each
+    ``entrypoint@bucket`` against the measured peaks and — where flushes
+    have flowed — grade the achieved utilization against its ceiling.
+    The EWMA utilization is per *entrypoint* (buckets fold into one
+    gauge), so achieved seconds are reconstructed from it; programs with
+    no measured flushes classify but stay ``unmeasured``. This is the
+    machine-readable form of the chisel kernel audit (bench.py emits it
+    into the bench JSON): ``kernel-candidate`` rows are where a hand
+    kernel has headroom, ``compiler-wins`` rows are the honest negative
+    results."""
+    peak = ensure_peak()
+    bw = ensure_membw()
+    with _lock:
+        items = list(_costs.items())
+        util = dict(_util)
+    programs = {}
+    for (ep, bucket), c in items:
+        u = util.get(ep)
+        seconds = (
+            c["flops"] / (u * peak) if u and peak > 0.0 else None
+        )
+        programs[f"{ep}@{bucket}"] = classify_program(
+            c["flops"], c["bytes"], seconds,
+            peak_flops=peak, peak_bytes_per_s=bw,
+        )
+    return {
+        "peak_flops": peak,
+        "peak_bytes_per_s": bw,
+        "kernel_candidate_slack": KERNEL_CANDIDATE_SLACK,
+        "programs": programs,
+    }
+
+
 def snapshot() -> dict:
-    """Roofline state for ``/slo/status``: peak, per-entrypoint smoothed
+    """Roofline state for ``/slo/status``: peaks, per-entrypoint smoothed
     utilization, and the captured program costs."""
     with _lock:
         return {
             "peak_flops": _peak_flops,
+            "peak_bytes_per_s": _peak_bytes_per_s,
             "utilization": dict(_util),
             "programs": {
                 f"{ep}@{bucket}": dict(c)
@@ -236,11 +382,12 @@ def snapshot() -> dict:
 
 
 def _reset_for_tests() -> None:
-    global _peak_flops
+    global _peak_flops, _peak_bytes_per_s
     with _lock:
         _costs.clear()
         _util.clear()
     _util_gauges.clear()
     _flops_gauges.clear()
     _peak_flops = 0.0
+    _peak_bytes_per_s = 0.0
     _local.last = None

@@ -210,6 +210,103 @@ def test_donate_site_drift_is_caught(tmp_path):
     assert res["ok"], res["violations"]
 
 
+# -- pallas budget ----------------------------------------------------------
+
+
+def _pallas_build(mesh):
+    """A tiny but real pallas_call (interpret mode — traces on CPU)."""
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    def fn(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=True,
+        )(x)
+
+    return fn, (meshcheck.sds((8, 128), jnp.float32),)
+
+
+def test_unbudgeted_pallas_call_is_caught():
+    """A kernel creeping into a program whose contract never declared one
+    is a forbidden primitive — the same severity as a host callback."""
+    con = contracts.Contract("fixture.pallas_smuggled")
+    res = contracts.check_contract(
+        con, ep=_ep("fixture.pallas_smuggled", _pallas_build)
+    )
+    assert _diag_set(res) == {"forbidden-primitive"}
+    assert "pallas_call" in res["violations"][0]["detail"]
+
+
+def test_missing_pallas_call_is_caught():
+    """The dual: a contract that budgets a kernel over a program that fell
+    back to XLA (the chisel dispatch-gate regression) fails loudly."""
+    def build(mesh):
+        return (lambda x: x * 2.0), (meshcheck.sds((8, 4), jnp.float32),)
+
+    con = contracts.Contract("fixture.pallas_dropped", pallas_calls=1)
+    res = contracts.check_contract(
+        con, ep=_ep("fixture.pallas_dropped", build)
+    )
+    assert _diag_set(res) == {"missing-pallas"}
+
+
+def test_budgeted_pallas_call_passes():
+    con = contracts.Contract("fixture.pallas_ok", pallas_calls=1)
+    res = contracts.check_contract(
+        con, ep=_ep("fixture.pallas_ok", _pallas_build)
+    )
+    assert res["ok"], res["violations"]
+
+
+def test_pallas_count_mismatch_is_caught():
+    def build(mesh):
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[:] = x_ref[:] * 2.0
+
+        def fn(x):
+            call = pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=True,
+            )
+            return call(call(x))
+
+        return fn, (meshcheck.sds((8, 128), jnp.float32),)
+
+    con = contracts.Contract("fixture.pallas_twice", pallas_calls=1)
+    res = contracts.check_contract(
+        con, ep=_ep("fixture.pallas_twice", build)
+    )
+    assert _diag_set(res) == {"pallas-count"}
+    assert "1" in res["violations"][0]["detail"]
+
+
+def test_chisel_contract_survives_warm_caches_and_sentinel():
+    """The stale-cache regression, both layers: trace evergreen.flush
+    first (warming the jitted wrapper's cache with the XLA body at the
+    exact avals/statics the chisel entrypoint uses) AND install the
+    compile sentinel (which rebinds the flush names to wrappers whose
+    single ``__wrapped__`` hop lands back on the jitted function) — the
+    chisel contract must still see its pallas_call, because the builder
+    unwraps to the raw body and forces the kernel branch at trace time."""
+    from fraud_detection_tpu.telemetry import compile_sentinel
+
+    compile_sentinel.install()
+    try:
+        for name in ("evergreen.flush", "chisel.evergreen_flush",
+                     "lantern.flush", "chisel.lantern_flush"):
+            res = contracts.check_contract(contracts.get_contract(name))
+            assert res["ok"], (name, res["violations"])
+    finally:
+        compile_sentinel.uninstall()
+
+
 # -- output dtypes ----------------------------------------------------------
 
 

@@ -114,6 +114,80 @@ def test_knn_rejects_non_commensurate_blocks(rng):
         knn_topk(x, 4, block_q=48, block_k=64, interpret=True)
 
 
+def test_tree_shap_gate(monkeypatch):
+    """Chisel dispatch tri-state: auto → ON for TPU (the kernel beat the
+    compiler there — measured numbers in the gate docstring), off
+    everywhere else; USE_PALLAS=0 forces off; CHISEL_INTERPRET=1 turns the
+    interpreter body on off-TPU (CPU CI's kernel-parity job)."""
+    from fraud_detection_tpu.ops.pallas_kernels import tree_shap_pallas_enabled
+
+    monkeypatch.delenv("USE_PALLAS", raising=False)
+    monkeypatch.delenv("CHISEL_INTERPRET", raising=False)
+    assert tree_shap_pallas_enabled("tpu") is True
+    assert tree_shap_pallas_enabled("cpu") is False
+    assert tree_shap_pallas_enabled("gpu") is False
+    monkeypatch.setenv("USE_PALLAS", "0")
+    assert tree_shap_pallas_enabled("tpu") is False
+    monkeypatch.delenv("USE_PALLAS", raising=False)
+    monkeypatch.setenv("CHISEL_INTERPRET", "1")
+    assert tree_shap_pallas_enabled("cpu") is True
+    # the kill switch still wins over the interpret opt-in
+    monkeypatch.setenv("USE_PALLAS", "0")
+    assert tree_shap_pallas_enabled("cpu") is False
+
+
+def test_force_tree_shap_kernel_overrides_and_restores(monkeypatch):
+    """The force context beats every env state in BOTH directions and
+    restores the prior state on exit (including nested use) — it exists so
+    tests/bench/meshcheck can pick a branch without env games, which the
+    trace-time gate would not see through a warm jit cache."""
+    from fraud_detection_tpu.ops.pallas_kernels import (
+        force_tree_shap_kernel,
+        tree_shap_pallas_enabled,
+    )
+
+    monkeypatch.setenv("USE_PALLAS", "0")
+    with force_tree_shap_kernel(True):
+        assert tree_shap_pallas_enabled("cpu") is True
+        with force_tree_shap_kernel(False):
+            assert tree_shap_pallas_enabled("tpu") is False
+        assert tree_shap_pallas_enabled("cpu") is True
+    assert tree_shap_pallas_enabled("cpu") is False
+    monkeypatch.delenv("USE_PALLAS", raising=False)
+    with force_tree_shap_kernel(False):
+        assert tree_shap_pallas_enabled("tpu") is False
+    assert tree_shap_pallas_enabled("tpu") is True
+
+
+@pytest.mark.kernel_parity
+def test_tree_shap_kernel_non_tile_aligned_block(rng):
+    """Direct kernel-vs-XLA check at a block size that forces row padding
+    inside the kernel (block_n smaller than the batch, batch not a
+    multiple of the block)."""
+    import jax.numpy as jnp
+
+    from fraud_detection_tpu.ops.gbt import GBTConfig, gbt_fit
+    from fraud_detection_tpu.ops.pallas_kernels import tree_shap_pallas
+    from fraud_detection_tpu.ops.tree_shap import (
+        _raw_tree_shap,
+        build_tree_explainer,
+    )
+
+    d = 7
+    x = rng.standard_normal((300, d)).astype(np.float32)
+    y = (x[:, 0] - x[:, 3] > 0).astype(np.int32)
+    model = gbt_fit(x, y, GBTConfig(n_trees=6, max_depth=3, n_bins=16))
+    e = build_tree_explainer(model, x[:16])
+    rows = jnp.asarray(x[:37])  # 37 rows over block_n=16 → ragged tail
+    got = np.asarray(
+        tree_shap_pallas(model, e.bg_table, rows, block_n=16, interpret=True)
+    )
+    want = np.asarray(
+        _raw_tree_shap(model, e.bg_table, rows, use_kernel=False)
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=2e-5)
+
+
 def test_knn_gate_flag_normalization(monkeypatch):
     """Both kernels' gates must read USE_PALLAS the same way — 'off' (or any
     disable spelling) disables BOTH."""

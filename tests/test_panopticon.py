@@ -535,6 +535,97 @@ def test_roofline_exports_utilization_for_warmed_fused_flush(
         roofline._reset_for_tests()
 
 
+# -- roofline classification (the chisel kernel audit) ----------------------
+
+
+def test_classify_program_memory_bound_kernel_candidate():
+    """Below the ridge the ceiling caps at AI/ridge, and a program earning
+    less than slack×ceiling is a kernel candidate — the exact shape of the
+    TreeSHAP audit row that justified the chisel kernel."""
+    # peak 1e12 FLOP/s over 1e11 B/s → ridge = 10 FLOP/byte
+    r = roofline.classify_program(
+        flops=1e9, nbytes=1e9, seconds=1.0,
+        peak_flops=1e12, peak_bytes_per_s=1e11,
+    )
+    assert r["arithmetic_intensity"] == pytest.approx(1.0)
+    assert r["ridge"] == pytest.approx(10.0)
+    assert r["ceiling"] == pytest.approx(0.1)  # memory-bound: can't reach 1
+    assert r["bound"] == "memory"
+    # achieved 1e9/1.0/1e12 = 1e-3 « 0.6 * 0.1
+    assert r["utilization"] == pytest.approx(1e-3)
+    assert r["verdict"] == "kernel-candidate"
+
+
+def test_classify_program_compiler_wins_at_the_ceiling():
+    """A memory-bound program already streaming at its bandwidth-implied
+    ceiling gets compiler-wins — a kernel has no headroom to claim."""
+    # AI=1, ridge=10 → ceiling 0.1; seconds chosen so util == ceiling
+    r = roofline.classify_program(
+        flops=1e9, nbytes=1e9, seconds=1e-2,
+        peak_flops=1e12, peak_bytes_per_s=1e11,
+    )
+    assert r["utilization"] == pytest.approx(0.1)
+    assert r["verdict"] == "compiler-wins"
+
+
+def test_classify_program_compute_bound_and_unmeasured():
+    # AI = 100 ≥ ridge 10 → compute-bound, ceiling saturates at 1.0
+    r = roofline.classify_program(
+        flops=1e11, nbytes=1e9,
+        peak_flops=1e12, peak_bytes_per_s=1e11,
+    )
+    assert r["bound"] == "compute"
+    assert r["ceiling"] == pytest.approx(1.0)
+    assert r["utilization"] is None
+    assert r["verdict"] == "unmeasured"
+    # degenerate inputs classify as unmeasured instead of dividing by zero
+    z = roofline.classify_program(0.0, 0.0, 1.0,
+                                  peak_flops=1e12, peak_bytes_per_s=1e11)
+    assert z["verdict"] == "unmeasured" and z["ridge"] is None
+
+
+def test_membw_probe_honors_pinned_config(monkeypatch):
+    roofline._reset_for_tests()
+    monkeypatch.setenv("DEVICE_PEAK_BYTES_PER_S", "2e10")
+    try:
+        assert roofline.ensure_membw() == pytest.approx(2e10)
+        snap = roofline.snapshot()
+        assert snap["peak_bytes_per_s"] == pytest.approx(2e10)
+    finally:
+        roofline._reset_for_tests()
+
+
+def test_audit_reconstructs_seconds_from_ewma_utilization(monkeypatch):
+    """audit() grades every captured program: an entrypoint with a live
+    EWMA utilization gets a verdict, one with no measured flushes stays
+    unmeasured — both on the same pinned peaks."""
+    roofline._reset_for_tests()
+    monkeypatch.setenv("DEVICE_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("DEVICE_PEAK_BYTES_PER_S", "1e11")
+    try:
+        with roofline._lock:
+            roofline._costs[("x.flush", 1024)] = {
+                "flops": 1e9, "bytes": 1e9,
+            }
+            roofline._costs[("cold.flush", 256)] = {
+                "flops": 1e9, "bytes": 1e8,
+            }
+            roofline._util["x.flush"] = 1e-3  # « 0.6 × the 0.1 ceiling
+        rep = roofline.audit()
+        assert rep["peak_flops"] == pytest.approx(1e12)
+        assert rep["peak_bytes_per_s"] == pytest.approx(1e11)
+        assert rep["kernel_candidate_slack"] == roofline.KERNEL_CANDIDATE_SLACK
+        hot = rep["programs"]["x.flush@1024"]
+        assert hot["bound"] == "memory"
+        assert hot["utilization"] == pytest.approx(1e-3)
+        assert hot["verdict"] == "kernel-candidate"
+        cold = rep["programs"]["cold.flush@256"]
+        assert cold["bound"] == "compute"  # AI=10 = ridge → compute side
+        assert cold["verdict"] == "unmeasured"
+    finally:
+        roofline._reset_for_tests()
+
+
 # -- bench trajectory -------------------------------------------------------
 
 

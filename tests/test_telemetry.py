@@ -283,15 +283,27 @@ def test_expected_compiles_never_feed_the_storm_detector():
 
 
 def test_storm_clears_when_the_window_drains(monkeypatch):
+    """Synthetic timestamps through ``_note_compiles(now=...)`` — the old
+    version raced four REAL jit compiles against a 50ms wall-clock window
+    and flaked whenever tracing outran it."""
     compile_sentinel._reset_for_tests()
-    monkeypatch.setattr(config, "recompile_storm_window_s", lambda: 0.05)
+    monkeypatch.setattr(config, "recompile_storm_window_s", lambda: 10.0)
     monkeypatch.setattr(config, "recompile_storm_threshold", lambda: 3)
-    f = jax.jit(lambda x: x * 3.0)
-    wrapped = compile_sentinel.instrument("test_drain", f)
-    for n in range(1, 5):
-        wrapped(jnp.ones((n,), jnp.float32))
+    t0 = time.monotonic()
+    for k in range(4):  # 4 compiles inside one window → storming
+        compile_sentinel._note_compiles("test_drain", 1, now=t0 + k * 0.01)
     assert _gauge_value(metrics.xla_recompile_storm, "test_drain") == 1
-    time.sleep(0.1)
+    # one more event far past the window drains the deque on its way in
+    compile_sentinel._note_compiles("test_drain", 0, now=t0 + 60.0)
+    assert _gauge_value(metrics.xla_recompile_storm, "test_drain") == 0
+    # and the scrape-time prune clears a gauge with NO new events: refill,
+    # then advance the clock the gauge refresher reads
+    for k in range(4):
+        compile_sentinel._note_compiles("test_drain", 1, now=t0 + k * 0.01)
+    assert _gauge_value(metrics.xla_recompile_storm, "test_drain") == 1
+    monkeypatch.setattr(
+        compile_sentinel.time, "monotonic", lambda: t0 + 120.0
+    )
     compile_sentinel.refresh_storm_gauges()  # the scrape-time prune
     assert _gauge_value(metrics.xla_recompile_storm, "test_drain") == 0
 

@@ -89,6 +89,173 @@ def test_expected_value_is_background_mean(imbalanced_data):
     np.testing.assert_allclose(float(explainer.expected_value), want, rtol=1e-4)
 
 
+# ---- chisel: the Pallas TreeSHAP kernel (interpret mode on CPU; the same
+# kernel Mosaic-compiles on TPU). `use_kernel=True` forces the dispatch
+# branch EAGERLY — no jitted wrapper, so no stale-cache hazard — and
+# off-TPU the body runs the Pallas interpreter. Every case asserts both
+# exactness (brute-force subset enumeration / additivity) AND parity vs
+# the XLA `_raw_tree_shap` fallback: tolerance on φ (the kernel's matmuls
+# reassociate the f32 sums), exact top-k index parity through the shared
+# tie-break helper.
+
+import jax.numpy as jnp
+import pytest
+
+from fraud_detection_tpu.ops.gbt import GBTModel, bin_features  # noqa: E402
+from fraud_detection_tpu.ops.linear_shap import topk_reasons  # noqa: E402
+from fraud_detection_tpu.ops.tree_shap import _raw_tree_shap  # noqa: E402
+
+
+def _phi_pair(explainer, rows):
+    kern = np.asarray(
+        _raw_tree_shap(
+            explainer.model, explainer.bg_table, jnp.asarray(rows),
+            use_kernel=True,
+        )
+    )
+    xla = np.asarray(
+        _raw_tree_shap(
+            explainer.model, explainer.bg_table, jnp.asarray(rows),
+            use_kernel=False,
+        )
+    )
+    return kern, xla
+
+
+def _assert_kernel_parity(kern, xla, k=3):
+    np.testing.assert_allclose(kern, xla, rtol=1e-4, atol=2e-5)
+    ki, _ = topk_reasons(jnp.asarray(kern), k)
+    xi, _ = topk_reasons(jnp.asarray(xla), k)
+    np.testing.assert_array_equal(np.asarray(ki), np.asarray(xi))
+
+
+@pytest.mark.kernel_parity
+@pytest.mark.parametrize(
+    "depth,trees,n_rows",
+    [
+        # depths {2,3,5} × tree counts {1,16,100}; every n_rows is NOT a
+        # multiple of the f32 sublane (8), and leaves·depth (8, 24, 160)
+        # is never a multiple of the 128 lane — the padding paths are
+        # always live
+        (2, 1, 9),
+        (3, 16, 33),
+        (5, 100, 9),
+    ],
+)
+def test_chisel_parity_sweep(depth, trees, n_rows):
+    rng = np.random.default_rng(depth * 1000 + trees)
+    d, n = 5, 400
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] * x[:, 2] > 0.2).astype(np.int32)
+    model = gbt_fit(
+        x, y,
+        GBTConfig(n_trees=trees, max_depth=depth, learning_rate=0.3,
+                  n_bins=16),
+    )
+    bg = x[:8]
+    explainer = build_tree_explainer(model, bg)
+    rows = x[100:100 + n_rows]
+    kern, xla = _phi_pair(explainer, rows)
+    _assert_kernel_parity(kern, xla)
+    # additivity on the KERNEL values: Σφ + E[f] == f(x)
+    recon = kern.sum(axis=1) + float(explainer.expected_value)
+    logits = np.asarray(gbt_predict_logits(model, rows))
+    np.testing.assert_allclose(recon, logits, rtol=1e-3, atol=1e-4)
+
+    # exactness vs first-principles subset enumeration (two rows — the
+    # brute force is exponential in d)
+    def predict(z):
+        return np.asarray(gbt_predict_logits(model, z.astype(np.float32)))
+
+    for i in range(2):
+        want = _brute_force_shap(predict, rows[i], bg, d)
+        np.testing.assert_allclose(kern[i], want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.kernel_parity
+def test_chisel_duplicate_feature_on_path():
+    """A forest whose every node splits the SAME feature exercises the
+    dup/canonical level slaving (a mask bit on a duplicate level must
+    follow its canonical level, never count twice): attribution confines
+    to feature 0 and both bodies agree."""
+    rng = np.random.default_rng(11)
+    trees, depth, d = 2, 3, 6
+    nodes, leaves = 2**depth - 1, 2**depth
+    model = GBTModel(
+        split_feature=jnp.zeros((trees, nodes), jnp.int32),
+        split_bin=jnp.asarray(
+            rng.integers(2, 14, size=(trees, nodes)), jnp.int32
+        ),
+        leaf_value=jnp.asarray(
+            rng.standard_normal((trees, leaves)), jnp.float32
+        ),
+        bin_edges=jnp.asarray(
+            np.sort(rng.standard_normal((d, 15)), axis=1), jnp.float32
+        ),
+        base_logit=jnp.float32(0.0),
+    )
+    bg = rng.standard_normal((16, d)).astype(np.float32)
+    explainer = build_tree_explainer(model, bg)
+    rows = rng.standard_normal((9, d)).astype(np.float32)
+    kern, xla = _phi_pair(explainer, rows)
+    _assert_kernel_parity(kern, xla)
+    # only feature 0 ever splits → every other feature's φ is exactly 0
+    assert np.all(kern[:, 1:] == 0.0)
+    recon = kern.sum(axis=1) + float(explainer.expected_value)
+    logits = np.asarray(gbt_predict_logits(model, rows))
+    np.testing.assert_allclose(recon, logits, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.kernel_parity
+def test_chisel_fused_and_standalone_share_kernel_body(imbalanced_data):
+    """The bitwise fused-vs-standalone contract must survive the kernel
+    swap: under ``force_tree_shap_kernel(True)`` the fused reason-code
+    leg (``drift._topk_attributions``, GBT family dispatch) and the
+    standalone kernel body return identical bits."""
+    from fraud_detection_tpu.monitor.drift import _topk_attributions
+    from fraud_detection_tpu.ops.pallas_kernels import force_tree_shap_kernel
+
+    x, y = imbalanced_data
+    model = gbt_fit(x, y, GBTConfig(n_trees=8, max_depth=3, n_bins=32))
+    explainer = build_tree_explainer(model, x[:32])
+    xf = jnp.asarray(x[50:83])  # 33 rows — padding path live
+    with force_tree_shap_kernel(True):
+        fi, fv = _topk_attributions(xf, explainer, 3)
+    ki, kv = topk_reasons(
+        _raw_tree_shap(explainer.model, explainer.bg_table, xf,
+                       use_kernel=True),
+        3,
+    )
+    np.testing.assert_array_equal(np.asarray(fi), np.asarray(ki))
+    np.testing.assert_array_equal(np.asarray(fv), np.asarray(kv))
+
+
+def test_background_subsample_seed_is_deterministic(monkeypatch):
+    """The explainer's background subsample threads its seed from config:
+    same seed → bitwise-identical bg_table (deterministic replay), and
+    ``EXPLAIN_BG_SEED`` reaches the default path."""
+    rng = np.random.default_rng(5)
+    d, n = 6, 300
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    model = gbt_fit(x, y, GBTConfig(n_trees=4, max_depth=3, n_bins=16))
+    # n > max_background → the subsample actually runs
+    e_a = build_tree_explainer(model, x, max_background=64, seed=0)
+    e_b = build_tree_explainer(model, x, max_background=64, seed=0)
+    np.testing.assert_array_equal(
+        np.asarray(e_a.bg_table), np.asarray(e_b.bg_table)
+    )
+    e_c = build_tree_explainer(model, x, max_background=64, seed=1)
+    assert not np.array_equal(
+        np.asarray(e_a.bg_table), np.asarray(e_c.bg_table)
+    )
+    monkeypatch.setenv("EXPLAIN_BG_SEED", "1")
+    e_env = build_tree_explainer(model, x, max_background=64)
+    np.testing.assert_array_equal(
+        np.asarray(e_env.bg_table), np.asarray(e_c.bg_table)
+    )
+
+
 def test_informative_features_get_attribution(imbalanced_data):
     """Features carrying the label signal must receive larger mean |φ| than
     pure-noise features."""
